@@ -1,0 +1,17 @@
+"""fastapriori_tpu — a TPU-native frequent-itemset-mining and
+association-rule-recommendation framework (JAX / XLA / shard_map / Pallas).
+
+Brand-new implementation with the capabilities of relife957/FastApriori
+(Spark-based parallel Apriori; see SURVEY.md for the structural map).  Where
+the reference broadcasts a vertical transaction bitmap to every Spark
+executor and parallelizes support counting over the candidate space
+(FastApriori.scala:97-100, 140-157), this framework shards the bitmap over
+the transaction axis of a TPU mesh and turns counting into weighted int32
+bitmap matmuls on the MXU, reduced with ``jax.lax.psum`` over ICI.
+"""
+
+__version__ = "0.1.0"
+
+from fastapriori_tpu.config import MinerConfig  # noqa: F401
+from fastapriori_tpu.models.apriori import FastApriori  # noqa: F401
+from fastapriori_tpu.models.recommender import AssociationRules  # noqa: F401

@@ -1,0 +1,141 @@
+"""Command-line driver (reference C1, Main.scala:15-41).
+
+Usage mirrors the reference's spark-submit contract:
+
+    python -m fastapriori_tpu <input-prefix> <output-prefix> [tmp] [flags]
+
+- ``args(0)`` input prefix: reads ``<input>D.dat`` and ``<input>U.dat``
+  (path concatenation, Utils.scala:21-23);
+- ``args(1)`` output prefix: writes ``<output>freqItemset`` and
+  ``<output>recommends`` (Utils.scala:39,48);
+- a third positional arg is accepted and ignored, like the reference
+  (README.md promises a temporary path, Main.scala never reads args(2));
+- ``--min-support`` defaults to the reference's hardcoded 0.092
+  (Main.scala:23).
+
+Phase wall-clock is printed in the reference's ``====`` style
+(Main.scala:32,37) alongside structured JSON metrics (``--metrics``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from fastapriori_tpu.config import DEFAULT_MIN_SUPPORT, MinerConfig
+from fastapriori_tpu.io.reader import read_input_dir
+from fastapriori_tpu.io.writer import save_freq_itemsets, save_recommends
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fastapriori_tpu",
+        description="TPU-native Apriori mining + association-rule "
+        "recommendation (reference-compatible CLI)",
+    )
+    p.add_argument("input", help="input prefix containing D.dat and U.dat")
+    p.add_argument("output", help="output prefix for freqItemset/recommends")
+    p.add_argument(
+        "tmp",
+        nargs="?",
+        default=None,
+        help="temporary path (accepted and ignored, like the reference)",
+    )
+    p.add_argument(
+        "--min-support",
+        type=float,
+        default=DEFAULT_MIN_SUPPORT,
+        help=f"minimum support (default {DEFAULT_MIN_SUPPORT}, "
+        "the reference's hardcoded value)",
+    )
+    p.add_argument(
+        "--num-devices",
+        type=int,
+        default=None,
+        help="devices in the mesh (default: all visible)",
+    )
+    p.add_argument(
+        "--metrics",
+        action="store_true",
+        help="emit structured JSON metrics to stderr",
+    )
+    p.add_argument(
+        "--save-counts",
+        action="store_true",
+        help="also write <output>freqItems with [count] suffixes "
+        "(the reference's unused saveFreqItemsetWithCount, "
+        "Utils.scala:51-63) — the resume artifact",
+    )
+    p.add_argument(
+        "--resume-from",
+        default=None,
+        help="prefix holding a previously saved freqItems artifact; "
+        "skips mining and runs recommendation only (reference "
+        "Utils.getAll, Utils.scala:65-81)",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="write a jax.profiler trace for the mining phase here",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    config = MinerConfig(
+        min_support=args.min_support,
+        num_devices=args.num_devices,
+        log_metrics=args.metrics,
+    )
+
+    # Imports deferred so --help works without initializing a backend.
+    from fastapriori_tpu.models.apriori import FastApriori
+    from fastapriori_tpu.models.recommender import AssociationRules
+
+    d_lines, u_lines = read_input_dir(args.input)
+
+    t1 = time.perf_counter()
+    if args.resume_from:
+        from fastapriori_tpu.io.resume import load_phase1
+
+        freq_itemsets, item_to_rank, freq_items = load_phase1(args.resume_from)
+    else:
+        profiler = None
+        if args.profile_dir:
+            import jax.profiler as profiler
+
+            profiler.start_trace(args.profile_dir)
+        miner = FastApriori(args.min_support, config=config)
+        freq_itemsets, item_to_rank, freq_items = miner.run(d_lines)
+        if profiler is not None:
+            profiler.stop_trace()
+        save_freq_itemsets(args.output, freq_itemsets, freq_items)
+        if args.save_counts:
+            from fastapriori_tpu.io.resume import save_phase1
+
+            save_phase1(args.output, freq_itemsets, freq_items, item_to_rank)
+    print(
+        "==== Total time for get freqItemsets "
+        f"{int((time.perf_counter() - t1) * 1e3)}",
+        file=sys.stderr,
+    )
+
+    t2 = time.perf_counter()
+    recommender = AssociationRules(
+        freq_itemsets, freq_items, item_to_rank, config=config
+    )
+    recommends = recommender.run(u_lines)
+    save_recommends(args.output, recommends)
+    print(
+        "==== Total time for get recommends "
+        f"{int((time.perf_counter() - t2) * 1e3)}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""Configuration for the miner and recommender.
+
+The reference hardcodes its knobs (minSupport=0.092 at Main.scala:23, Spark
+parallelism at Main.scala:18-20); here they are real flags with the
+reference's values as defaults (SURVEY.md §5 "Config / flag system").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# Reference default: Main.scala:23.
+DEFAULT_MIN_SUPPORT = 0.092
+
+
+@dataclasses.dataclass
+class MinerConfig:
+    """Knobs for the mining engine and its device kernels."""
+
+    min_support: float = DEFAULT_MIN_SUPPORT
+    # Pad the candidate-prefix axis to powers of two >= this, so the level
+    # kernels compile for a small set of bucket shapes instead of one shape
+    # per level (SURVEY.md §7 "padding/bucketing discipline").
+    min_prefix_bucket: int = 128
+    # Pad the transaction axis to a multiple of this (after sharding the
+    # per-device rows still align to MXU-friendly tiles).
+    txn_tile: int = 8
+    # Pad the item axis (F) to a multiple of this (MXU lane width).
+    item_tile: int = 128
+    # Optional cap on devices used (None = all devices in the mesh).
+    num_devices: Optional[int] = None
+    # Emit per-level structured metrics as JSON lines to stderr.
+    log_metrics: bool = False

@@ -1,0 +1,6 @@
+from fastapriori_tpu.io.reader import read_dat, read_input_dir  # noqa: F401
+from fastapriori_tpu.io.writer import (  # noqa: F401
+    save_freq_itemsets,
+    save_freq_itemsets_with_count,
+    save_recommends,
+)

@@ -1,0 +1,53 @@
+"""Transaction/user-basket file ingestion (reference: Utils.scala:19-27).
+
+The reference reads ``<input>D.dat`` and ``<input>U.dat`` as whitespace-
+tokenized lines via Spark ``textFile`` (note: path *concatenation*, no
+separator — ``path + "D.dat"`` at Utils.scala:21).  This loader reproduces
+the exact tokenization (``trim().split("\\s+")``, which yields a single
+empty token for an empty line — Java split semantics) on the host, with an
+optional fsspec path for remote filesystems (HDFS/GCS) when available and a
+native C++ fast path for large files (see fastapriori_tpu/native).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+_WS = re.compile(r"\s+")
+
+
+def tokenize_line(line: str) -> List[str]:
+    """Java-compatible ``line.trim().split("\\s+")`` (Utils.scala:21).
+
+    ``re.split(r"\\s+", "")`` returns ``[""]``, matching Java's behavior of
+    returning a single empty token for an empty (trimmed) string, which
+    Python's plain ``str.split()`` would not."""
+    return _WS.split(line.strip())
+
+
+def _open(path: str):
+    if "://" in path:
+        try:
+            import fsspec
+
+            return fsspec.open(path, "r").open()
+        except ImportError as e:  # pragma: no cover - environment dependent
+            raise RuntimeError(
+                f"remote path {path!r} requires fsspec, which is not "
+                "installed; copy the file locally instead"
+            ) from e
+    return open(path, "r")
+
+
+def read_dat(path: str) -> List[List[str]]:
+    """Read one ``*.dat`` file into a list of token lists, one per line."""
+    with _open(path) as f:
+        return [tokenize_line(line) for line in f.read().splitlines()]
+
+
+def read_input_dir(input_prefix: str) -> Tuple[List[List[str]], List[List[str]]]:
+    """Read ``<prefix>D.dat`` and ``<prefix>U.dat`` (Utils.scala:21-23 —
+    the reference concatenates without a path separator, so a trailing
+    ``/`` in the prefix is the caller's responsibility, as with Spark)."""
+    return read_dat(input_prefix + "D.dat"), read_dat(input_prefix + "U.dat")

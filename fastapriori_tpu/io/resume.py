@@ -1,0 +1,78 @@
+"""Phase-1 checkpoint/resume (reference C14, Utils.scala:65-81).
+
+The reference has a manual, hardcoded restart hook: ``Utils.getAll`` reloads
+previously saved ``freqItemset``/``FreqItems``/``ItemsToRank`` files from
+fixed HDFS paths and reconstructs the mining result triple so phase 2
+(recommendation) can re-run without re-mining; the matching writer is the
+unused ``saveFreqItemsetWithCount`` (counts embedded as ``...[count]``,
+parsed back at Utils.scala:75-77).  Here it is a first-class
+``--resume-from`` flag: :func:`save_phase1` writes the three artifacts under
+a prefix, :func:`load_phase1` round-trips them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, FrozenSet, List, Sequence, Tuple
+
+from fastapriori_tpu.io.writer import (
+    _ensure_parent,
+    save_freq_itemsets_with_count,
+)
+
+ItemsetWithCount = Tuple[FrozenSet[int], int]
+
+
+def save_phase1(
+    prefix: str,
+    freq_itemsets: Sequence[ItemsetWithCount],
+    freq_items: Sequence[str],
+    item_to_rank: Dict[str, int],
+) -> None:
+    """Write ``<prefix>freqItems`` (itemsets with [count] suffixes,
+    Utils.scala:51-63), ``<prefix>FreqItems`` (one item per line) and
+    ``<prefix>ItemsToRank`` ("item rank" per line, the format
+    Utils.getAll parses at Utils.scala:72)."""
+    save_freq_itemsets_with_count(prefix, freq_itemsets, freq_items)
+    path_items = prefix + "FreqItems"
+    _ensure_parent(path_items)
+    with open(path_items, "w") as f:
+        f.writelines(item + "\n" for item in freq_items)
+    path_ranks = prefix + "ItemsToRank"
+    _ensure_parent(path_ranks)
+    with open(path_ranks, "w") as f:
+        f.writelines(f"{item} {rank}\n" for item, rank in item_to_rank.items())
+
+
+def load_phase1(
+    prefix: str,
+) -> Tuple[List[ItemsetWithCount], Dict[str, int], List[str]]:
+    """Reconstruct ``(freqItemsets, itemToRank, freqItems)`` from saved
+    artifacts (mirrors Utils.getAll, Utils.scala:65-81: rank map parsed
+    from "item rank" lines; items sorted by rank; itemset lines split on
+    ``[`` with the trailing count)."""
+    item_to_rank: Dict[str, int] = {}
+    with open(prefix + "ItemsToRank") as f:
+        for line in f.read().splitlines():
+            if not line:
+                continue
+            item, rank = line.split(" ")
+            item_to_rank[item] = int(rank)
+
+    with open(prefix + "FreqItems") as f:
+        freq_items = [l for l in f.read().splitlines() if l != ""]
+    freq_items.sort(key=lambda i: item_to_rank[i])
+
+    freq_itemsets: List[ItemsetWithCount] = []
+    with open(prefix + "freqItems") as f:
+        for line in f.read().splitlines():
+            if not line:
+                continue
+            # "<item> <item> ...[count]" (Utils.scala:60,75-77)
+            body = line.replace("[", " ").replace("]", "")
+            parts = body.split(" ")
+            items, count = parts[:-1], int(parts[-1])
+            freq_itemsets.append(
+                (frozenset(item_to_rank[i] for i in items), count)
+            )
+    return freq_itemsets, item_to_rank, freq_items

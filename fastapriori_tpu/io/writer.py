@@ -1,0 +1,78 @@
+"""Output writers (reference: Utils.scala:29-63).
+
+The reference writes through Spark ``saveAsTextFile``, producing a directory
+(``<output>freqItemset/part-00000``).  This framework writes a single plain
+file at ``<output>freqItemset`` / ``<output>recommends`` with byte-identical
+*content*: itemset lines print ranks in descending order mapped back to item
+strings, the whole file sorted lexicographically (Utils.scala:36-39);
+recommends are sorted by row index, one item per line (Utils.scala:48).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Sequence, Tuple
+
+
+def _ensure_parent(path: str) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+
+
+def format_itemset_line(ranks: Iterable[int], freq_items: Sequence[str]) -> str:
+    """One itemset: ranks sorted descending, mapped to item strings, joined
+    by a single space (Utils.scala:38 — ``sortBy(-_)``)."""
+    return " ".join(freq_items[r] for r in sorted(ranks, reverse=True))
+
+
+def save_freq_itemsets(
+    output_prefix: str,
+    freq_itemsets: Sequence[Tuple[frozenset, int]],
+    freq_items: Sequence[str],
+) -> str:
+    """Write ``<output>freqItemset`` (Utils.scala:29-41).  Lines sorted
+    lexicographically (``sortBy(x => x)`` on strings — code-unit order,
+    which equals Python ``str`` sort for ASCII data)."""
+    lines = [format_itemset_line(s, freq_items) for s, _ in freq_itemsets]
+    lines.sort()
+    path = output_prefix + "freqItemset"
+    _ensure_parent(path)
+    with open(path, "w") as f:
+        f.writelines(line + "\n" for line in lines)
+    return path
+
+
+def save_freq_itemsets_with_count(
+    output_prefix: str,
+    freq_itemsets: Sequence[Tuple[frozenset, int]],
+    freq_items: Sequence[str],
+) -> str:
+    """Write ``<output>freqItems`` with counts embedded as ``...[count]``
+    (Utils.scala:51-63) — the resume artifact parsed back by
+    :func:`fastapriori_tpu.io.resume.load_freq_itemsets_with_count`
+    (reference parser: Utils.scala:75-77)."""
+    lines = [
+        format_itemset_line(s, freq_items) + "[" + str(c) + "]"
+        for s, c in freq_itemsets
+    ]
+    lines.sort()
+    path = output_prefix + "freqItems"
+    _ensure_parent(path)
+    with open(path, "w") as f:
+        f.writelines(line + "\n" for line in lines)
+    return path
+
+
+def save_recommends(
+    output_prefix: str, recommends: Sequence[Tuple[int, str]]
+) -> str:
+    """Write ``<output>recommends``: sorted by original row index, one
+    recommended item (or "0") per line (Utils.scala:43-49)."""
+    path = output_prefix + "recommends"
+    _ensure_parent(path)
+    with open(path, "w") as f:
+        f.writelines(
+            item + "\n" for _, item in sorted(recommends, key=lambda x: x[0])
+        )
+    return path

@@ -1,0 +1,2 @@
+from fastapriori_tpu.models.apriori import FastApriori  # noqa: F401
+from fastapriori_tpu.models.recommender import AssociationRules  # noqa: F401

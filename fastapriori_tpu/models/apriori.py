@@ -1,0 +1,206 @@
+"""The mining engine: level-synchronous Apriori with TPU counting kernels
+(reference C9, FastApriori.scala:31-44, 88-130).
+
+Control flow mirrors the reference exactly — a host-driven level loop with
+the same termination rule (``while kItems.length >= k``,
+FastApriori.scala:111) and the same minCount semantics
+(``ceil(minSupport × rawCount)``, :38-39) — but each level's counting runs
+as sharded MXU matmuls instead of Spark candidate-space tasks:
+
+- level 2: one weighted Gram matmul over the whole bitmap (ops/count.py);
+- level k>=3: candidate prefixes are padded into power-of-two buckets
+  (static shapes for jit; SURVEY.md §7 "padding/bucketing discipline"),
+  each bucket one prefix-product + matmul kernel launch, extension
+  validity applied as a host-side mask on the returned counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.models.candidates import gen_candidates
+from fastapriori_tpu.ops.bitmap import build_bitmap, weight_digits
+from fastapriori_tpu.parallel.mesh import DeviceContext
+from fastapriori_tpu.preprocess import CompressedData, preprocess
+from fastapriori_tpu.utils.logging import MetricsLogger
+
+ItemsetWithCount = Tuple[FrozenSet[int], int]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class FastApriori:
+    """Mining engine.  API mirrors the reference class
+    (``FastApriori(minSupport, numPartitions).run(...)`` →
+    ``FastApriori(min_support, num_devices).run(...)``), with fluent
+    setters for parity with FastApriori.scala:21-29."""
+
+    def __init__(
+        self,
+        min_support: Optional[float] = None,
+        num_devices: Optional[int] = None,
+        config: Optional[MinerConfig] = None,
+        context: Optional[DeviceContext] = None,
+    ):
+        # Copy the config so explicit arguments never mutate the caller's
+        # object; explicit arguments win over config fields.
+        self.config = (
+            dataclasses.replace(config) if config is not None else MinerConfig()
+        )
+        if min_support is not None:
+            self.config.min_support = min_support
+        if num_devices is not None:
+            self.config.num_devices = num_devices
+        self._context = context
+        self.metrics = MetricsLogger(enabled=self.config.log_metrics)
+
+    # Fluent setters (FastApriori.scala:21-29).
+    def set_min_support(self, min_support: float) -> "FastApriori":
+        self.config.min_support = min_support
+        return self
+
+    def set_num_devices(self, num_devices: Optional[int]) -> "FastApriori":
+        self.config.num_devices = num_devices
+        self._context = None
+        return self
+
+    @property
+    def context(self) -> DeviceContext:
+        if self._context is None:
+            self._context = DeviceContext(num_devices=self.config.num_devices)
+        return self._context
+
+    # ------------------------------------------------------------------
+    def run(
+        self, transactions: Sequence[Sequence[str]]
+    ) -> Tuple[List[ItemsetWithCount], Dict[str, int], List[str]]:
+        """Full mining (FastApriori.run, :31-44).
+
+        Returns ``(freqItemsets with counts, itemToRank, freqItems)`` —
+        levels >=2 first, then the 1-itemsets with their raw occurrence
+        counts (:41,83)."""
+        with self.metrics.timed("preprocess") as m:
+            data = preprocess(transactions, self.config.min_support)
+            m.update(
+                n_raw=data.n_raw,
+                min_count=data.min_count,
+                num_items=data.num_items,
+                total_count=data.total_count,
+            )
+        freq_itemsets = self.mine_compressed(data)
+        return freq_itemsets, data.item_to_rank, data.freq_items
+
+    def mine_compressed(self, data: CompressedData) -> List[ItemsetWithCount]:
+        """Levels >=2 via device kernels, then 1-itemsets appended."""
+        one_itemsets: List[ItemsetWithCount] = [
+            (frozenset((r,)), int(c)) for r, c in enumerate(data.item_counts)
+        ]
+        f = data.num_items
+        freq_itemsets: List[ItemsetWithCount] = []
+        if f >= 2 and data.total_count > 0:
+            freq_itemsets = self._mine_levels(data)
+        return freq_itemsets + one_itemsets
+
+    # ------------------------------------------------------------------
+    def _mine_levels(self, data: CompressedData) -> List[ItemsetWithCount]:
+        cfg = self.config
+        ctx = self.context
+        f = data.num_items
+        min_count = data.min_count
+
+        with self.metrics.timed("bitmap_build") as m:
+            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices
+            bitmap_np = build_bitmap(
+                data.baskets, f, txn_multiple, cfg.item_tile
+            )
+            t_pad = bitmap_np.shape[0]
+            w_digits_np, scales = weight_digits(data.weights, t_pad)
+            bitmap = ctx.shard_bitmap(bitmap_np)
+            w_digits = ctx.shard_weight_digits(w_digits_np)
+            m.update(shape=list(bitmap_np.shape), digits=len(scales))
+
+        freq_itemsets: List[ItemsetWithCount] = []
+
+        # Level 2 (C6): one Gram matmul, upper triangle thresholded on host.
+        with self.metrics.timed("level", k=2) as m:
+            pair = np.asarray(ctx.pair_counts(bitmap, w_digits, scales))
+            iu, ju = np.triu_indices(f, k=1)
+            counts = pair[iu, ju]
+            keep = counts >= min_count
+            level = [
+                (frozenset((int(i), int(j))), int(c))
+                for i, j, c in zip(iu[keep], ju[keep], counts[keep])
+            ]
+            m.update(candidates=len(iu), frequent=len(level))
+        freq_itemsets.extend(level)
+        k_items = [s for s, _ in level]
+
+        # Levels >=3 (C7 + C8), reference termination rule
+        # (FastApriori.scala:111).
+        k = 3
+        while len(k_items) >= k:
+            with self.metrics.timed("level", k=k) as m:
+                cands = gen_candidates(k_items, f)
+                n_cand = sum(len(e) for _, e in cands)
+                level = self._count_level(
+                    ctx, bitmap, w_digits, scales, cands, f, min_count
+                )
+                m.update(
+                    prefixes=len(cands), candidates=n_cand, frequent=len(level)
+                )
+            freq_itemsets.extend(level)
+            k_items = [s for s, _ in level]
+            k += 1
+
+        return freq_itemsets
+
+    def _count_level(
+        self,
+        ctx: DeviceContext,
+        bitmap,
+        w_digits,
+        scales,
+        cands: List[Tuple[Tuple[int, ...], List[int]]],
+        f: int,
+        min_count: int,
+    ) -> List[ItemsetWithCount]:
+        """C8 for one level: bucket prefixes to static shapes, launch the
+        prefix-product matmul kernel per bucket, mask+threshold on host."""
+        cfg = self.config
+        out: List[ItemsetWithCount] = []
+        if not cands:
+            return out
+        f_pad = bitmap.shape[1]
+        zero_col = f  # guaranteed all-zero padding column (ops/bitmap.py)
+        chunk = max(cfg.min_prefix_bucket, 1)
+        max_chunk = 4096
+        i = 0
+        while i < len(cands):
+            batch = cands[i : i + max_chunk]
+            i += max_chunk
+            p = len(batch)
+            p_pad = min(max(_next_pow2(p), chunk), max_chunk)
+            k1 = len(batch[0][0])
+            prefix_cols = np.full((p_pad, k1), zero_col, dtype=np.int32)
+            for row, (prefix, _exts) in enumerate(batch):
+                prefix_cols[row] = prefix
+            counts = np.asarray(
+                ctx.level_counts(bitmap, w_digits, scales, prefix_cols)
+            )  # [p_pad, f_pad] int32
+            for row, (prefix, exts) in enumerate(batch):
+                row_counts = counts[row]
+                ps = frozenset(prefix)
+                for y in exts:
+                    c = int(row_counts[y])
+                    if c >= min_count:
+                        out.append((ps | {y}, c))
+        return out

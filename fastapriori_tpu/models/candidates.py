@@ -1,0 +1,37 @@
+"""Apriori candidate generation (reference C7, FastApriori.scala:167-193).
+
+Host-side: the candidate table is tiny next to counting (SURVEY.md §2 C7).
+Semantics reproduced exactly:
+
+- extensions of a frequent (k-1)-set ``x`` are drawn from ranks
+  ``max(x)+1 .. F-1`` not in ``x`` (ordered-extension dedup, :176-177);
+- classic Apriori prune: extension ``y`` survives iff for EVERY element
+  ``e`` of ``x``, ``(x - {e}) ∪ {y}`` is a frequent (k-1)-set (:181-188 —
+  the reference's early exit when the candidate set empties does not change
+  the result, the prune conditions are order-independent);
+- prefixes with no surviving extension are dropped (:190).
+"""
+
+from __future__ import annotations
+
+from typing import FrozenSet, List, Sequence, Tuple
+
+Prefix = Tuple[int, ...]  # sorted ranks
+
+
+def gen_candidates(
+    k_items: Sequence[FrozenSet[int]], num_items: int
+) -> List[Tuple[Prefix, List[int]]]:
+    """Return ``(sorted prefix, sorted surviving extensions)`` per prefix."""
+    k_set = frozenset(k_items)
+    out: List[Tuple[Prefix, List[int]]] = []
+    for x in k_items:
+        cands = set(range(max(x) + 1, num_items)) - x
+        for elem in x:
+            if not cands:
+                break
+            sub = x - {elem}
+            cands = {y for y in cands if (sub | {y}) in k_set}
+        if cands:
+            out.append((tuple(sorted(x)), sorted(cands)))
+    return out

@@ -1,0 +1,143 @@
+"""Association-rule recommender (reference C10 + C12,
+AssociationRules.scala:17-113).
+
+API mirrors the reference class:
+``AssociationRules(freqItemsets, freqItems, itemToRank).run(user_lines)``
+returns ``[(original row index, recommended item string or "0"), ...]``.
+
+Pipeline (run, :23-31): dedupe user baskets keeping original row indexes
+(C10, preprocess.dedup_user_baskets); generate + prune rules (C11,
+rules/gen.py); sort by (confidence desc, consequent-as-int asc) (:74);
+first-match per distinct basket (C12) on device via the containment matmul
+kernel (ops/contain.py) or a host loop for tiny inputs; fan results out to
+all original rows (:104-105); empty baskets get "0" (:49).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.ops.bitmap import build_bitmap, pad_axis
+from fastapriori_tpu.parallel.mesh import DeviceContext
+from fastapriori_tpu.preprocess import dedup_user_baskets
+from fastapriori_tpu.rules.gen import Rule, gen_rules, sort_rules
+from fastapriori_tpu.utils.logging import MetricsLogger
+
+
+class AssociationRules:
+    def __init__(
+        self,
+        freq_itemsets: Sequence[Tuple[FrozenSet[int], int]],
+        freq_items: Sequence[str],
+        item_to_rank: Dict[str, int],
+        config: Optional[MinerConfig] = None,
+        context: Optional[DeviceContext] = None,
+    ):
+        self.freq_itemsets = list(freq_itemsets)
+        self.freq_items = list(freq_items)
+        self.item_to_rank = dict(item_to_rank)
+        self.config = config or MinerConfig()
+        self._context = context
+        self.metrics = MetricsLogger(enabled=self.config.log_metrics)
+
+    @property
+    def context(self) -> DeviceContext:
+        if self._context is None:
+            self._context = DeviceContext(num_devices=self.config.num_devices)
+        return self._context
+
+    # ------------------------------------------------------------------
+    def run(
+        self, user_lines: Sequence[Sequence[str]], use_device: bool = True
+    ) -> List[Tuple[int, str]]:
+        with self.metrics.timed("user_dedup") as m:
+            baskets, indexes, empty = dedup_user_baskets(
+                user_lines, self.item_to_rank
+            )
+            m.update(
+                users=len(user_lines), distinct=len(baskets), empty=len(empty)
+            )
+        with self.metrics.timed("gen_rules") as m:
+            rules = sort_rules(gen_rules(self.freq_itemsets), self.freq_items)
+            m.update(rules=len(rules))
+
+        out: List[Tuple[int, str]] = [(i, "0") for i in empty]
+        if not baskets:
+            return out
+        if not rules:
+            for rows in indexes:
+                out.extend((i, "0") for i in rows)
+            return out
+
+        with self.metrics.timed("first_match", device=use_device):
+            if use_device:
+                recs = self._device_first_match(baskets, rules)
+            else:
+                recs = self._host_first_match(baskets, rules)
+
+        for rows, rec in zip(indexes, recs):
+            item = self.freq_items[rec] if rec >= 0 else "0"
+            out.extend((i, item) for i in rows)
+        return out
+
+    # ------------------------------------------------------------------
+    def _host_first_match(
+        self, baskets: List[np.ndarray], rules: List[Rule]
+    ) -> List[int]:
+        """Reference-shaped scan (AssociationRules.scala:88-102); used for
+        tiny inputs and as the device kernel's cross-check in tests."""
+        prepared = [(frozenset(a), c, len(a)) for a, c, _ in rules]
+        recs = []
+        for b in baskets:
+            basket = frozenset(int(x) for x in b)
+            n = len(basket)
+            rec = -1
+            for ant, cons, size in prepared:
+                if size <= n and cons not in basket and ant <= basket:
+                    rec = cons
+                    break
+            recs.append(rec)
+        return recs
+
+    def _device_first_match(
+        self, baskets: List[np.ndarray], rules: List[Rule]
+    ) -> List[int]:
+        """Containment-matmul path (ops/contain.py), baskets sharded over
+        the mesh, rule tables replicated."""
+        ctx = self.context
+        f = len(self.freq_items)
+        nb = len(baskets)
+        cfg = self.config
+
+        basket_mat = build_bitmap(
+            baskets, f, max(cfg.txn_tile, 32) * ctx.n_devices, cfg.item_tile
+        )
+        nb_pad, f_pad = basket_mat.shape
+        basket_len = np.zeros(nb_pad, dtype=np.int32)
+        basket_len[:nb] = [len(b) for b in baskets]
+
+        r = len(rules)
+        r_pad = pad_axis(r, 128)
+        ant_rows = [np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules]
+        ant_mat = np.zeros((r_pad, f_pad), dtype=np.int8)
+        lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
+        rows = np.repeat(np.arange(r, dtype=np.int64), lens)
+        ant_mat[rows, np.concatenate(ant_rows)] = 1
+        ant_size = np.full(r_pad, f + 1, dtype=np.int32)  # pad: never eligible
+        ant_size[:r] = lens
+        consequent = np.zeros(r_pad, dtype=np.int32)
+        consequent[:r] = [c for _, c, _ in rules]
+
+        rec = np.asarray(
+            ctx.first_match(
+                ctx.shard_bitmap(basket_mat),
+                ctx.shard_weights_like(basket_len),
+                ctx.replicate(ant_mat),
+                ctx.replicate(ant_size),
+                ctx.replicate(consequent),
+            )
+        )
+        return [int(x) for x in rec[:nb]]

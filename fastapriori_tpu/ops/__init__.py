@@ -1,0 +1,9 @@
+from fastapriori_tpu.ops.bitmap import (  # noqa: F401
+    build_bitmap,
+    pad_axis,
+    weight_digits,
+)
+from fastapriori_tpu.ops.count import (  # noqa: F401
+    local_level_counts,
+    local_pair_counts,
+)

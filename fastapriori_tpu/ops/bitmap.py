@@ -1,0 +1,88 @@
+"""Vertical-bitmap construction and padding discipline (reference C5).
+
+The reference builds its Boolean item->transactions table with one full
+Spark scan per item (FastApriori.scala:195-210 — O(F) jobs, its worst
+inefficiency) and then broadcasts the whole table to every executor.  Here
+the bitmap is built in a single host pass as a dense ``B ∈ {0,1}^{T'×F}``
+int8 matrix and *sharded over the transaction axis* across the device mesh —
+inverting the reference's replicate-bitmap / shard-candidates layout
+(SURVEY.md §7).
+
+Weighted counting stays on the int8 MXU path via base-128 digit
+decomposition of the multiplicity weights: ``w = Σ_d 128^d · w_d`` with
+``w_d ∈ [0, 128)``, so ``B ⊙ w_d`` still fits in int8 and every support
+count is a sum of int8×int8→int32 matmuls scaled by ``128^d``.  Real
+datasets almost always need a single digit (most baskets are unique).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def pad_axis(n: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` that is >= max(n, 1)."""
+    n = max(n, 1)
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def build_bitmap(
+    baskets: Sequence[np.ndarray],
+    num_items: int,
+    txn_multiple: int = 8,
+    item_multiple: int = 128,
+) -> np.ndarray:
+    """Build the dense transaction×item bitmap, padded to device-friendly
+    tiles.  Padding rows/columns are all-zero, so they contribute nothing to
+    any count (a padded column's support is 0 < minCount; a padded row has
+    weight 0).
+
+    One vectorized pass over the ragged baskets replaces the reference's
+    per-item filter jobs (FastApriori.scala:199-200).
+
+    The item axis is padded to fit at least one all-zero column beyond the
+    real items (``f_pad >= num_items + 1``): padded candidate-prefix rows
+    point their column indexes at it, making their counts exactly 0.
+    """
+    t = len(baskets)
+    t_pad = pad_axis(t, txn_multiple)
+    f_pad = pad_axis(num_items + 1, item_multiple)
+    if t == 0:
+        return np.zeros((t_pad, f_pad), dtype=np.int8)
+    lens = np.fromiter((len(b) for b in baskets), dtype=np.int64, count=t)
+    rows = np.repeat(np.arange(t, dtype=np.int64), lens)
+    cols = np.concatenate(baskets) if len(baskets) else np.empty(0, np.int64)
+    b = np.zeros((t_pad, f_pad), dtype=np.int8)
+    b[rows, cols] = 1
+    return b
+
+
+def pad_weights(weights: np.ndarray, txn_pad: int) -> np.ndarray:
+    """Zero-pad the multiplicity vector to the padded transaction count."""
+    out = np.zeros(txn_pad, dtype=np.int32)
+    out[: len(weights)] = weights
+    return out
+
+
+def weight_digits(weights: np.ndarray, txn_pad: int) -> Tuple[np.ndarray, List[int]]:
+    """Decompose int32 weights into base-128 int8 digits.
+
+    Returns ``(digits int8[D, T_pad], scales)`` with
+    ``weights == Σ_d scales[d] * digits[d]`` and ``scales[d] = 128**d``.
+    D is data-dependent but tiny (1 unless some basket repeats >= 128
+    times), and static per compilation.
+    """
+    w = pad_weights(weights, txn_pad).astype(np.int64)
+    digits: List[np.ndarray] = []
+    scales: List[int] = []
+    scale = 1
+    while True:
+        digits.append((w % 128).astype(np.int8))
+        scales.append(scale)
+        w //= 128
+        scale *= 128
+        if not (w > 0).any():
+            break
+    return np.stack(digits, axis=0), scales

@@ -1,0 +1,66 @@
+"""First-match rule containment kernel (reference C12's hot loop,
+AssociationRules.scala:88-102) as one matmul + argmin.
+
+The reference scans the confidence-sorted rule list per user basket until
+the first rule whose antecedent is a subset of the basket fires (:95-102).
+On TPU, for a batch of (deduplicated) baskets U ∈ {0,1}^{Nb×F} and rule
+antecedents A ∈ {0,1}^{R×F} sorted by priority:
+
+- containment:  ``U · Aᵀ == |antecedent|``  (int8 matmul, int32 acc);
+- eligibility:  ``|antecedent| <= |basket|`` and consequent ∉ basket
+  (:90 — the reference pre-filters, we mask);
+- first match:  argmin over rule index with ineligible rows mapped to R.
+
+Baskets are sharded over the mesh axis (data parallelism over users —
+each device answers its own slice; no reduction needed); the rule tables
+are replicated, the analog of the reference's rule broadcast (:76-78).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "txn"
+
+
+def local_first_match(
+    baskets: jnp.ndarray,  # [Nb_local, F] int8
+    basket_len: jnp.ndarray,  # [Nb_local] int32  (distinct frequent items)
+    antecedents: jnp.ndarray,  # [R, F] int8, priority-sorted
+    ant_size: jnp.ndarray,  # [R] int32 (padded rules: F+1 => never eligible)
+    consequent: jnp.ndarray,  # [R] int32 rank of the consequent
+) -> jnp.ndarray:
+    """Per basket: rank of the recommended item, or -1 for no match."""
+    r = antecedents.shape[0]
+    overlap = lax.dot_general(
+        baskets,
+        antecedents,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [Nb, R]
+    contained = overlap == ant_size[None, :]
+    size_ok = ant_size[None, :] <= basket_len[:, None]
+    # consequent ∉ basket: gather each basket's bit at the consequent's rank.
+    cons_in_basket = jnp.take(baskets, consequent, axis=1) > 0  # [Nb, R]
+    eligible = contained & size_ok & ~cons_in_basket
+    idx = jnp.where(eligible, jnp.arange(r, dtype=jnp.int32)[None, :], r)
+    first = jnp.min(idx, axis=1)  # [Nb]
+    found = first < r
+    rec = jnp.take(consequent, jnp.where(found, first, 0))
+    return jnp.where(found, rec, -1)
+
+
+def make_sharded_first_match(mesh: Mesh):
+    """shard_map-wrapped, jitted first-match kernel: baskets sharded over
+    the mesh axis, rule tables replicated."""
+    return jax.jit(
+        jax.shard_map(
+            local_first_match,
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P(None, None), P(None), P(None)),
+            out_specs=P(AXIS),
+        )
+    )

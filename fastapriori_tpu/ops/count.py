@@ -1,0 +1,114 @@
+"""Support-counting kernels (reference C6/C8) as MXU matmuls.
+
+The reference counts candidate support by scanning Boolean arrays per
+candidate on Spark executors (the hot loops at FastApriori.scala:145,
+149-151, 233-235).  Here every level is a handful of int8×int8→int32
+matmuls:
+
+- pair counts (C6):   ``C2[f,g] = Σ_t w_t B[t,f] B[t,g]`` — one matmul
+  replaces all of genTwoFreqItems (FastApriori.scala:212-241);
+- level-k counts (C8): per candidate prefix S (a frequent (k-1)-set),
+  ``common[t,p] = Π_{i∈S_p} B[t,i]`` (k-1 gathers + elementwise products),
+  then ``counts[p,f] = Σ_t w_t common[t,p] B[t,f]`` for ALL possible
+  extensions f at once — one (P×T)·(T×F) matmul replaces
+  genNextFreqItemsets (FastApriori.scala:132-160).
+
+These functions compute *local* (per-shard) partial counts over the
+transaction axis and finish with ``lax.psum`` over the mesh axis when one
+is given — the TPU-native replacement for the reference's
+``reduceByKey``+``collect`` (SURVEY.md C15).  Weights enter via base-128
+int8 digits (see ops/bitmap.py) so the MXU path stays int8.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _psum_if(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
+    return lax.psum(x, axis_name) if axis_name is not None else x
+
+
+def _weighted_matmul(
+    lhs_int8: jnp.ndarray,  # [T, P] int8 (0/1)
+    bitmap: jnp.ndarray,  # [T, F] int8 (0/1)
+    w_digits: jnp.ndarray,  # [D, T] int8
+    scales: Sequence[int],  # python ints, len D (static)
+) -> jnp.ndarray:
+    """``out[p,f] = Σ_t w_t lhs[t,p] bitmap[t,f]`` via per-digit int8
+    matmuls with int32 accumulation (exact for counts < 2^31)."""
+    total = None
+    for d, scale in enumerate(scales):
+        scaled = lhs_int8 * w_digits[d][:, None]  # int8 in [0,127]
+        part = lax.dot_general(
+            scaled,
+            bitmap,
+            (((0,), (0,)), ((), ())),  # contract over T
+            preferred_element_type=jnp.int32,
+        )
+        part = part if scale == 1 else part * jnp.int32(scale)
+        total = part if total is None else total + part
+    return total
+
+
+def local_pair_counts(
+    bitmap: jnp.ndarray,  # [T_local, F] int8
+    w_digits: jnp.ndarray,  # [D, T_local] int8
+    scales: Sequence[int],
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """C6: weighted co-occurrence counts for all item pairs.
+
+    Returns int32[F, F]; entry (f, g) is the weighted number of distinct
+    baskets containing both f and g (diagonal = weighted item support over
+    size>=2 baskets; callers read the upper triangle).
+    """
+    counts = _weighted_matmul(bitmap, bitmap, w_digits, scales)
+    return _psum_if(counts, axis_name)
+
+
+def local_level_counts(
+    bitmap: jnp.ndarray,  # [T_local, F] int8
+    w_digits: jnp.ndarray,  # [D, T_local] int8
+    scales: Sequence[int],
+    prefix_cols: jnp.ndarray,  # [P, K] int32 column indexes (K = k-1, static)
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """C8: weighted support of (prefix ∪ {f}) for every prefix row and every
+    item f simultaneously.
+
+    ``prefix_cols`` rows are the k-1 item ranks of each candidate prefix;
+    padding rows must point at an all-zero padded column so their counts
+    are 0.  Returns int32[P, F].
+    """
+    k = prefix_cols.shape[1]
+    common = jnp.take(bitmap, prefix_cols[:, 0], axis=1)  # [T, P] int8
+    for i in range(1, k):
+        common = common * jnp.take(bitmap, prefix_cols[:, i], axis=1)
+    counts = _weighted_matmul(common, bitmap, w_digits, scales)
+    return _psum_if(counts, axis_name)
+
+
+def local_item_supports(
+    bitmap: jnp.ndarray,  # [T_local, F] int8
+    w_digits: jnp.ndarray,  # [D, T_local] int8
+    scales: Sequence[int],
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Weighted per-item support over the compressed baskets (int32[F]).
+
+    Not a reference component (the reference's 1-item counts are raw
+    occurrence counts from C3) — used by tests and diagnostics."""
+    total = None
+    for d, scale in enumerate(scales):
+        part = jnp.sum(
+            bitmap.astype(jnp.int32) * w_digits[d].astype(jnp.int32)[:, None],
+            axis=0,
+        )
+        part = part if scale == 1 else part * jnp.int32(scale)
+        total = part if total is None else total + part
+    return _psum_if(total, axis_name)

@@ -1,0 +1,335 @@
+"""Pure-Python oracle: an exact, self-contained reimplementation of the
+reference pipeline's semantics, used as the golden model in tests.
+
+Every function mirrors a reference component (see SURVEY.md §2) and cites the
+behavior it reproduces:
+
+- tokenization:           Utils.scala:21,23  (``trim().split("\\s+")``)
+- minCount:               FastApriori.scala:38-39 (``ceil(minSupport * N)``)
+- item occurrence counts: FastApriori.scala:55-58 (``flatMap(_.map((_,1)))``
+  — duplicates *within* a line each count)
+- rank assignment:        FastApriori.scala:60-62 (descending count; the
+  reference's tie order is Spark-nondeterministic, we fix it deterministically
+  — see :func:`item_sort_key`)
+- basket filter + dedup:  FastApriori.scala:66-79 (``toSet``; drop size<=1;
+  dedupe identical baskets with multiplicity)
+- pair counting:          FastApriori.scala:212-241
+- candidate generation:   FastApriori.scala:167-193
+- level counting:         FastApriori.scala:132-160
+- level-loop termination: FastApriori.scala:111 (``while kItems.length >= k``)
+- rule generation:        AssociationRules.scala:122-145
+- dominance prune:        AssociationRules.scala:147-182
+- rule ordering:          AssociationRules.scala:116-120 (confidence desc,
+  consequent-as-int asc)
+- recommendation:         AssociationRules.scala:80-106
+- output formats:         Utils.scala:29-49
+
+This module deliberately shares NO code with the framework proper so that
+framework-vs-oracle golden tests are meaningful.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+ItemSet = frozenset  # of int ranks
+
+
+def tokenize(line: str) -> List[str]:
+    """Java-compatible ``line.trim().split("\\s+")``.
+
+    Java's split on an empty (trimmed) string returns ``[""]`` — a single
+    empty token — which Python's ``str.split()`` would drop.  ``re.split``
+    reproduces the Java behavior exactly (Utils.scala:21).
+    """
+    return re.split(r"\s+", line.strip())
+
+
+def read_lines(path: str) -> List[List[str]]:
+    with open(path, "r") as f:
+        return [tokenize(line) for line in f.read().splitlines()]
+
+
+def item_sort_key(item_count: Tuple[str, int]):
+    """Deterministic stand-in for the reference's ``sortBy(-_._2)``
+    (FastApriori.scala:60), whose tie order is Spark-collect
+    nondeterministic.  Ties broken by numeric value of the item token
+    ascending (items are integer strings in this domain), falling back to the
+    raw token."""
+    item, count = item_count
+    try:
+        num = int(item)
+        return (-count, 0, num, item)
+    except ValueError:
+        return (-count, 1, 0, item)
+
+
+def count_items(transactions: Sequence[Sequence[str]]) -> Counter:
+    """C3 first half: global occurrence counts (within-line duplicates each
+    count — ``flatMap(_.map((_,1)))``, FastApriori.scala:55)."""
+    c: Counter = Counter()
+    for t in transactions:
+        c.update(t)
+    return c
+
+
+def freq_items_and_ranks(
+    counts: Counter, min_count: int
+) -> Tuple[List[str], Dict[str, int]]:
+    """C3 second half: frequent items sorted by descending count, dense ranks
+    0..F-1 (FastApriori.scala:57-62)."""
+    freq = [(i, c) for i, c in counts.items() if c >= min_count]
+    freq.sort(key=item_sort_key)
+    freq_items = [i for i, _ in freq]
+    item_to_rank = {item: r for r, item in enumerate(freq_items)}
+    return freq_items, item_to_rank
+
+
+def dedup_transactions(
+    transactions: Sequence[Sequence[str]], item_to_rank: Dict[str, int]
+) -> Tuple[List[ItemSet], List[int]]:
+    """C4: filter to frequent items, map to ranks, drop baskets of size <= 1,
+    dedupe identical baskets with multiplicity (FastApriori.scala:66-79).
+
+    Returns (distinct baskets in first-seen order, multiplicity weights)."""
+    order: List[ItemSet] = []
+    mult: Dict[ItemSet, int] = {}
+    for t in transactions:
+        basket = frozenset(item_to_rank[i] for i in t if i in item_to_rank)
+        if len(basket) <= 1:
+            continue
+        if basket in mult:
+            mult[basket] += 1
+        else:
+            mult[basket] = 1
+            order.append(basket)
+    return order, [mult[b] for b in order]
+
+
+def _support(baskets: List[ItemSet], weights: List[int], s: ItemSet) -> int:
+    return sum(w for b, w in zip(baskets, weights) if s <= b)
+
+
+def gen_pairs(
+    baskets: List[ItemSet], weights: List[int], F: int, min_count: int
+) -> List[Tuple[ItemSet, int]]:
+    """C6: all C(F,2) pairs, weighted support, threshold
+    (FastApriori.scala:212-241)."""
+    out = []
+    for i in range(F - 1):
+        for j in range(i + 1, F):
+            c = _support(baskets, weights, frozenset((i, j)))
+            if c >= min_count:
+                out.append((frozenset((i, j)), c))
+    return out
+
+
+def gen_candidates(
+    k_items: List[ItemSet], F: int
+) -> List[Tuple[ItemSet, List[int]]]:
+    """C7: ordered-extension candidate generation with classic Apriori subset
+    prune (FastApriori.scala:167-193).  Result order of extensions is
+    ascending rank (the reference uses a HashSet, order-irrelevant there)."""
+    k_set = set(k_items)
+    out = []
+    for x in k_items:
+        cands = set(range(max(x) + 1, F)) - x
+        for elem in x:
+            if not cands:
+                break
+            sub = x - {elem}
+            cands = {y for y in cands if (sub | {y}) in k_set}
+        if cands:
+            out.append((x, sorted(cands)))
+    return out
+
+
+def gen_next_level(
+    candidates: List[Tuple[ItemSet, List[int]]],
+    baskets: List[ItemSet],
+    weights: List[int],
+    min_count: int,
+) -> List[Tuple[ItemSet, int]]:
+    """C8: per (prefix, extensions) group, weighted support of prefix+ext
+    (FastApriori.scala:132-160)."""
+    out = []
+    for sub, items in candidates:
+        for i in items:
+            s = sub | {i}
+            c = _support(baskets, weights, s)
+            if c >= min_count:
+                out.append((s, c))
+    return out
+
+
+def mine(
+    transactions: Sequence[Sequence[str]], min_support: float
+) -> Tuple[List[Tuple[ItemSet, int]], Dict[str, int], List[str]]:
+    """C9 + FastApriori.run (FastApriori.scala:31-44, 88-130): full mining.
+
+    Returns (freqItemsets with counts — levels >=2 first then 1-itemsets,
+    itemToRank, freqItems), mirroring the reference's result triple."""
+    n = len(transactions)
+    min_count = math.ceil(min_support * n)
+    counts = count_items(transactions)
+    freq_items, item_to_rank = freq_items_and_ranks(counts, min_count)
+    F = len(freq_items)
+    baskets, weights = dedup_transactions(transactions, item_to_rank)
+
+    freq_itemsets: List[Tuple[ItemSet, int]] = []
+    k_items_with_count = gen_pairs(baskets, weights, F, min_count)
+    freq_itemsets.extend(k_items_with_count)
+    k_items = [s for s, _ in k_items_with_count]
+    k = 3
+    while len(k_items) >= k:
+        cands = gen_candidates(k_items, F)
+        k_items_with_count = gen_next_level(cands, baskets, weights, min_count)
+        freq_itemsets.extend(k_items_with_count)
+        k_items = [s for s, _ in k_items_with_count]
+        k += 1
+
+    # 1-itemsets appended last with their raw occurrence counts
+    # (FastApriori.scala:41,83).
+    freq_itemsets.extend(
+        (frozenset((item_to_rank[i],)), counts[i]) for i in freq_items
+    )
+    return freq_itemsets, item_to_rank, freq_items
+
+
+# ---------------------------------------------------------------------------
+# Rules + recommendation (AssociationRules.scala)
+# ---------------------------------------------------------------------------
+
+Rule = Tuple[ItemSet, int, float]  # (antecedent, consequent rank, confidence)
+
+
+def gen_rules(freq_itemsets: List[Tuple[ItemSet, int]]) -> List[Rule]:
+    """C11: rule generation (AssociationRules.scala:122-145) followed by the
+    level-wise "cut leaves" dominance prune (:147-182).
+
+    A rule at antecedent-size i survives iff ALL of its
+    (antecedent-minus-one-element → same consequent) rules survived level
+    i-1 AND every one of them has strictly lower confidence."""
+    support = {s: c for s, c in freq_itemsets}
+    by_size: Dict[int, List[Tuple[ItemSet, int]]] = {}
+    for s, c in freq_itemsets:
+        by_size.setdefault(len(s), []).append((s, c))
+
+    rules_by_len: Dict[int, List[Rule]] = {}
+    for s, c in freq_itemsets:
+        if len(s) == 1:
+            continue
+        for item in s:
+            ant = s - {item}
+            conf = c / support[ant]
+            rules_by_len.setdefault(len(ant), []).append((ant, item, conf))
+
+    if not rules_by_len:
+        return []
+    min_len = min(rules_by_len)
+    max_len = max(rules_by_len)
+    real_rules: List[Rule] = list(rules_by_len[min_len])
+    low_level = list(rules_by_len[min_len])
+    for i in range(min_len + 1, max_len + 1):
+        by_consequent: Dict[int, List[Rule]] = {}
+        for r in low_level:
+            by_consequent.setdefault(r[1], []).append(r)
+        survivors = []
+        for ant, consequent, conf in rules_by_len[i]:
+            if consequent not in by_consequent:
+                continue
+            subs = {r[0]: r[2] for r in by_consequent[consequent]}
+            ok = True
+            for elem in ant:
+                sub = ant - {elem}
+                if sub not in subs:
+                    ok = False  # subset rule did not survive (:173)
+                    break
+                if subs[sub] >= conf:
+                    ok = False  # not strictly confidence-increasing (:168)
+                    break
+            if ok:
+                survivors.append((ant, consequent, conf))
+        real_rules.extend(survivors)
+        low_level = survivors
+    return real_rules
+
+
+def sort_rules(rules: List[Rule], freq_items: List[str]) -> List[Rule]:
+    """C12 ordering: confidence desc, consequent-as-int asc
+    (AssociationRules.scala:116-120)."""
+    return sorted(rules, key=lambda r: (-r[2], int(freq_items[r[1]])))
+
+
+def recommend(
+    user_lines: Sequence[Sequence[str]],
+    rules: List[Rule],
+    freq_items: List[str],
+    item_to_rank: Dict[str, int],
+) -> List[Tuple[int, str]]:
+    """C10 + C12: dedupe user baskets, first-match recommendation
+    (AssociationRules.scala:33-113).  Returns (row index, item or "0")."""
+    sorted_rules = [
+        (ant, cons, len(ant)) for ant, cons, _ in sort_rules(rules, freq_items)
+    ]
+    out: List[Tuple[int, str]] = []
+    cache: Dict[ItemSet, str] = {}
+    for idx, line in enumerate(user_lines):
+        basket = frozenset(item_to_rank[i] for i in line if i in item_to_rank)
+        if not basket:
+            out.append((idx, "0"))
+            continue
+        if basket in cache:
+            out.append((idx, cache[basket]))
+            continue
+        rec = "0"
+        n = len(basket)
+        for ant, cons, size in sorted_rules:
+            if size <= n and cons not in basket and ant <= basket:
+                rec = freq_items[cons]
+                break
+        cache[basket] = rec
+        out.append((idx, rec))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Output formatting (Utils.scala:29-49)
+# ---------------------------------------------------------------------------
+
+def format_freq_itemsets(
+    freq_itemsets: List[Tuple[ItemSet, int]], freq_items: List[str]
+) -> str:
+    """Ranks sorted descending within a line, lines sorted lexicographically
+    (Utils.scala:36-39)."""
+    lines = [
+        " ".join(freq_items[r] for r in sorted(s, reverse=True))
+        for s, _ in freq_itemsets
+    ]
+    lines.sort()
+    return "".join(line + "\n" for line in lines)
+
+
+def format_recommends(recommends: List[Tuple[int, str]]) -> str:
+    """Sorted by row index, one item per line (Utils.scala:48)."""
+    return "".join(
+        item + "\n" for _, item in sorted(recommends, key=lambda x: x[0])
+    )
+
+
+def run_pipeline(
+    d_lines: Sequence[Sequence[str]],
+    u_lines: Sequence[Sequence[str]],
+    min_support: float,
+) -> Tuple[str, str]:
+    """End-to-end: returns (freqItemset file text, recommends file text)."""
+    freq_itemsets, item_to_rank, freq_items = mine(d_lines, min_support)
+    rules = gen_rules(freq_itemsets)
+    recs = recommend(u_lines, rules, freq_items, item_to_rank)
+    return (
+        format_freq_itemsets(freq_itemsets, freq_items),
+        format_recommends(recs),
+    )

@@ -1,0 +1,1 @@
+from fastapriori_tpu.parallel.mesh import DeviceContext  # noqa: F401

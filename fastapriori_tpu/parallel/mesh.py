@@ -1,0 +1,158 @@
+"""Device mesh + SPMD execution layer (reference C15, SURVEY.md §1 L1).
+
+This is the TPU-native replacement for the reference's Spark substrate:
+
+- Spark ``reduceByKey`` + ``collect`` counting rounds  → ``lax.psum`` over
+  the 1-D transaction mesh axis inside ``shard_map``;
+- ``sc.broadcast`` of candidate/itemset tables         → replicated specs
+  (``P(None)``) — XLA broadcasts once over ICI;
+- ``sc.parallelize`` scatter of candidates             → replicated device
+  arrays (candidates are small; the *data* is what is sharded);
+- executors holding the full bitmap (FastApriori.scala:100) → each device
+  holds only ``T'/n`` rows of the bitmap.
+
+Multi-host: call :func:`initialize_distributed` first (wraps
+``jax.distributed.initialize``); the mesh then spans all processes' devices
+and the same ``shard_map`` code drives ICI within a host and DCN across
+hosts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fastapriori_tpu.ops import count as count_ops
+
+AXIS = "txn"
+
+
+def initialize_distributed(**kwargs) -> None:
+    """Multi-host init (the analog of standing up the Spark cluster,
+    README.md:22-35).  No-op on a single process."""
+    jax.distributed.initialize(**kwargs)
+
+
+class DeviceContext:
+    """Owns the 1-D transaction mesh and the jitted counting kernels.
+
+    ``num_devices=None`` uses every visible device; ``1`` gives the
+    single-chip path (same code — a 1-device mesh; psum is the identity).
+    """
+
+    def __init__(
+        self,
+        num_devices: Optional[int] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        devs = list(devices if devices is not None else jax.devices())
+        if num_devices is not None:
+            devs = devs[:num_devices]
+        self.mesh = Mesh(np.array(devs), (AXIS,))
+        self.n_devices = len(devs)
+        self._fns: Dict[Tuple[int, ...], Tuple] = {}
+        self._first_match = None
+
+    # -- data placement ----------------------------------------------------
+    def shard_bitmap(self, bitmap: np.ndarray) -> jax.Array:
+        """Place B with rows sharded over the txn axis."""
+        assert bitmap.shape[0] % self.n_devices == 0, (
+            bitmap.shape,
+            self.n_devices,
+        )
+        return jax.device_put(
+            bitmap, NamedSharding(self.mesh, P(AXIS, None))
+        )
+
+    def shard_weight_digits(self, w_digits: np.ndarray) -> jax.Array:
+        """Place the [D, T] digit matrix with T sharded."""
+        return jax.device_put(
+            w_digits, NamedSharding(self.mesh, P(None, AXIS))
+        )
+
+    def shard_weights_like(self, x: np.ndarray) -> jax.Array:
+        """Place a 1-D per-transaction (or per-basket) vector sharded over
+        the txn axis."""
+        return jax.device_put(x, NamedSharding(self.mesh, P(AXIS)))
+
+    def replicate(self, x: np.ndarray) -> jax.Array:
+        spec = P(*([None] * x.ndim))
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    # -- kernels -----------------------------------------------------------
+    def _get_fns(self, scales: Tuple[int, ...]):
+        """Jitted shard_map-wrapped kernels for a given (static) digit-scale
+        tuple.  One compilation per distinct input shape, cached by jax."""
+        if scales in self._fns:
+            return self._fns[scales]
+        mesh = self.mesh
+
+        pair = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    count_ops.local_pair_counts,
+                    scales=scales,
+                    axis_name=AXIS,
+                ),
+                mesh=mesh,
+                in_specs=(P(AXIS, None), P(None, AXIS)),
+                out_specs=P(None, None),
+            )
+        )
+
+        def _level(bitmap, w_digits, prefix_cols):
+            return count_ops.local_level_counts(
+                bitmap, w_digits, scales, prefix_cols, axis_name=AXIS
+            )
+
+        level = jax.jit(
+            jax.shard_map(
+                _level,
+                mesh=mesh,
+                in_specs=(P(AXIS, None), P(None, AXIS), P(None, None)),
+                out_specs=P(None, None),
+            )
+        )
+
+        item = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    count_ops.local_item_supports,
+                    scales=scales,
+                    axis_name=AXIS,
+                ),
+                mesh=mesh,
+                in_specs=(P(AXIS, None), P(None, AXIS)),
+                out_specs=P(None),
+            )
+        )
+
+        self._fns[scales] = (pair, level, item)
+        return self._fns[scales]
+
+    def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
+        pair, _, _ = self._get_fns(tuple(scales))
+        return pair(bitmap, w_digits)
+
+    def level_counts(self, bitmap, w_digits, scales, prefix_cols) -> jax.Array:
+        _, level, _ = self._get_fns(tuple(scales))
+        return level(bitmap, w_digits, prefix_cols)
+
+    def item_supports(self, bitmap, w_digits, scales) -> jax.Array:
+        _, _, item = self._get_fns(tuple(scales))
+        return item(bitmap, w_digits)
+
+    def first_match(self, baskets, basket_len, antecedents, ant_size, consequent):
+        """Recommender containment kernel (ops/contain.py), jitted once per
+        context so repeated run() calls reuse the compilation cache."""
+        if self._first_match is None:
+            from fastapriori_tpu.ops.contain import make_sharded_first_match
+
+            self._first_match = make_sharded_first_match(self.mesh)
+        return self._first_match(
+            baskets, basket_len, antecedents, ant_size, consequent
+        )

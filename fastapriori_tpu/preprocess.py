@@ -1,0 +1,158 @@
+"""Host-side preprocessing: frequent-item discovery and transaction
+compression (reference components C3/C4/C10, SURVEY.md §2).
+
+The reference runs these as Spark shuffle passes (FastApriori.scala:52-85,
+AssociationRules.scala:33-64).  On TPU the mining kernels want a dense
+weighted bitmap, so preprocessing runs on the host (numpy + dict hashing;
+a native C++ fast path lives in fastapriori_tpu/native) and produces:
+
+- ``freq_items``: item strings sorted by descending occurrence count
+  (rank 0 = most frequent — FastApriori.scala:60-62);
+- ``item_counts``: occurrence counts aligned to rank.  Occurrences, not
+  transaction support: the reference counts via ``flatMap(_.map((_,1)))``
+  (FastApriori.scala:55) so duplicates *within* a line each count;
+- deduplicated baskets with multiplicity weights (FastApriori.scala:66-79):
+  per transaction, keep frequent items, map to ranks, drop baskets of size
+  <= 1, merge identical baskets into one row with an int32 weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fastapriori_tpu.utils.order import item_sort_key
+
+
+@dataclasses.dataclass
+class CompressedData:
+    """Output of phase 1 preprocessing — the miner's entire input."""
+
+    n_raw: int  # raw transaction count N (FastApriori.scala:38)
+    min_count: int  # ceil(minSupport * N)   (FastApriori.scala:39)
+    freq_items: List[str]  # rank -> item string
+    item_to_rank: Dict[str, int]
+    item_counts: np.ndarray  # int64[F] occurrence counts by rank
+    baskets: List[np.ndarray]  # T' ragged rows of sorted ranks, len >= 2
+    weights: np.ndarray  # int32[T'] multiplicities
+
+    @property
+    def num_items(self) -> int:
+        return len(self.freq_items)
+
+    @property
+    def total_count(self) -> int:  # T' (FastApriori.scala:79)
+        return len(self.baskets)
+
+
+def count_item_occurrences(
+    transactions: Sequence[Sequence[str]],
+) -> Counter:
+    """C3 first half (FastApriori.scala:55-56): global occurrence counts."""
+    counts: Counter = Counter()
+    for t in transactions:
+        counts.update(t)
+    return counts
+
+
+def build_rank_map(
+    counts: Counter, min_count: int
+) -> Tuple[List[str], Dict[str, int], np.ndarray]:
+    """C3 second half (FastApriori.scala:57-62): threshold, sort by
+    descending count (deterministic tie-break — utils/order.py), dense
+    ranks."""
+    freq = [(i, c) for i, c in counts.items() if c >= min_count]
+    freq.sort(key=item_sort_key)
+    freq_items = [i for i, _ in freq]
+    item_counts = np.asarray([c for _, c in freq], dtype=np.int64)
+    item_to_rank = {item: r for r, item in enumerate(freq_items)}
+    return freq_items, item_to_rank, item_counts
+
+
+def dedup_baskets(
+    transactions: Sequence[Sequence[str]],
+    item_to_rank: Dict[str, int],
+    min_size: int = 2,
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """C4 (FastApriori.scala:66-79): filter to frequent items, rank-map,
+    ``toSet`` dedupe within a line, drop baskets smaller than ``min_size``,
+    merge identical baskets with multiplicity.  Basket identity is the
+    sorted rank tuple.  Returns (baskets in first-seen order, weights)."""
+    mult: Dict[Tuple[int, ...], int] = {}
+    for t in transactions:
+        ranks = {item_to_rank[i] for i in t if i in item_to_rank}
+        if len(ranks) < min_size:
+            continue
+        key = tuple(sorted(ranks))
+        mult[key] = mult.get(key, 0) + 1
+    baskets = [np.asarray(k, dtype=np.int32) for k in mult.keys()]
+    weights = np.asarray(list(mult.values()), dtype=np.int32)
+    return baskets, weights
+
+
+def preprocess(
+    transactions: Sequence[Sequence[str]],
+    min_support: float,
+    native: Optional[bool] = None,
+) -> CompressedData:
+    """Full phase-1 preprocessing (mirrors genFreqItems,
+    FastApriori.scala:46-86).
+
+    ``native``: force (True) or forbid (False) the C++ fast path; None
+    auto-selects it when the extension is built and input is large.
+    """
+    from fastapriori_tpu.native import maybe_native_preprocess
+
+    n_raw = len(transactions)
+    min_count = int(math.ceil(min_support * n_raw))
+
+    result = maybe_native_preprocess(transactions, min_count, native)
+    if result is not None:
+        freq_items, item_to_rank, item_counts, baskets, weights = result
+    else:
+        counts = count_item_occurrences(transactions)
+        freq_items, item_to_rank, item_counts = build_rank_map(counts, min_count)
+        baskets, weights = dedup_baskets(transactions, item_to_rank)
+
+    return CompressedData(
+        n_raw=n_raw,
+        min_count=min_count,
+        freq_items=freq_items,
+        item_to_rank=item_to_rank,
+        item_counts=item_counts,
+        baskets=baskets,
+        weights=weights,
+    )
+
+
+def dedup_user_baskets(
+    user_lines: Sequence[Sequence[str]], item_to_rank: Dict[str, int]
+) -> Tuple[List[np.ndarray], List[List[int]], List[int]]:
+    """C10 (AssociationRules.scala:33-64): filter users to frequent items,
+    dedupe identical baskets keeping the original row indexes per distinct
+    basket; empty baskets are returned separately (they recommend "0"
+    immediately — AssociationRules.scala:49).
+
+    Returns (distinct baskets, per-basket original row-index lists,
+    empty-row indexes)."""
+    index_map: Dict[Tuple[int, ...], List[int]] = {}
+    order: List[Tuple[int, ...]] = []
+    empty: List[int] = []
+    for idx, line in enumerate(user_lines):
+        ranks = {item_to_rank[i] for i in line if i in item_to_rank}
+        if not ranks:
+            empty.append(idx)
+            continue
+        key = tuple(sorted(ranks))
+        if key in index_map:
+            index_map[key].append(idx)
+        else:
+            index_map[key] = [idx]
+            order.append(key)
+    baskets = [np.asarray(k, dtype=np.int32) for k in order]
+    indexes = [index_map[k] for k in order]
+    return baskets, indexes, empty

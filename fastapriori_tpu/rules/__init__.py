@@ -1,0 +1,1 @@
+from fastapriori_tpu.rules.gen import Rule, gen_rules, sort_rules  # noqa: F401

@@ -1,0 +1,87 @@
+"""Association-rule generation with the reference's dominance prune
+(component C11, AssociationRules.scala:122-188).
+
+Host-side: the rule table is tiny next to counting (SURVEY.md §2 C11).
+Semantics — the part that defines output parity — reproduced exactly:
+
+1. For every frequent itemset S with |S| >= 2 and every item i in S, a raw
+   rule ``(S - {i}) → i`` with confidence ``count(S)/count(S - {i})``
+   (:129-145).  Note the denominator for size-1 antecedents is the raw
+   *occurrence* count from phase C3, not a basket support — the reference
+   feeds its 1-itemset table straight into the lookup (:130).
+2. Level-wise "cut leaves" prune (:147-182): every rule at the minimum
+   antecedent size survives; a rule at antecedent size i survives iff for
+   EACH element e of its antecedent A, the rule ``(A - {e}) → consequent``
+   survived level i-1 (:173 via targets.nonEmpty, and the consequent-group
+   lookup :159) AND has strictly lower confidence (:168 — any
+   ``subset.conf >= conf`` kills the rule).  Net: only rules on strictly
+   confidence-increasing chains survive.
+
+Confidence is an IEEE double division of two ints, identical in Python and
+on the JVM, so the >=-comparisons agree bit-for-bit with the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Sequence, Tuple
+
+Rule = Tuple[FrozenSet[int], int, float]  # (antecedent, consequent, confidence)
+
+
+def gen_rules(
+    freq_itemsets: Sequence[Tuple[FrozenSet[int], int]]
+) -> List[Rule]:
+    support: Dict[FrozenSet[int], int] = dict(freq_itemsets)
+
+    raw_by_len: Dict[int, List[Rule]] = {}
+    for s, c in freq_itemsets:
+        if len(s) < 2:
+            continue
+        for item in s:
+            ant = s - {item}
+            raw_by_len.setdefault(len(ant), []).append(
+                (ant, item, c / support[ant])
+            )
+
+    if not raw_by_len:
+        return []
+
+    min_len = min(raw_by_len)
+    max_len = max(raw_by_len)
+    survivors: List[Rule] = list(raw_by_len[min_len])
+    low_level = survivors
+    for i in range(min_len + 1, max_len + 1):
+        # Surviving lower-level rules indexed by (antecedent, consequent).
+        low_conf: Dict[Tuple[FrozenSet[int], int], float] = {
+            (ant, cons): conf for ant, cons, conf in low_level
+        }
+        level: List[Rule] = []
+        for ant, cons, conf in raw_by_len.get(i, ()):
+            ok = True
+            for e in ant:
+                sub_conf = low_conf.get((ant - {e}, cons))
+                if sub_conf is None or sub_conf >= conf:
+                    ok = False
+                    break
+            if ok:
+                level.append((ant, cons, conf))
+        survivors.extend(level)
+        low_level = level
+    return survivors
+
+
+def sort_rules(rules: Sequence[Rule], freq_items: Sequence[str]) -> List[Rule]:
+    """Recommendation priority order: confidence desc, consequent item
+    parsed as an integer asc (associationRulesSort,
+    AssociationRules.scala:116-120 — the reference assumes integer item
+    strings there; non-integer items would crash it, we fall back to the
+    string)."""
+
+    def key(r: Rule):
+        item = freq_items[r[1]]
+        try:
+            return (-r[2], 0, int(item), item)
+        except ValueError:
+            return (-r[2], 1, 0, item)
+
+    return sorted(rules, key=key)

@@ -1,0 +1,2 @@
+from fastapriori_tpu.utils.order import item_sort_key  # noqa: F401
+from fastapriori_tpu.utils.logging import MetricsLogger, phase_timer  # noqa: F401

@@ -1,0 +1,69 @@
+"""Synthetic transaction datasets in the style of the IBM Quest generator
+(T10I4D100K et al., the benchmark family in BASELINE.md).
+
+Transactions are drawn from a pool of correlated "patterns" (frequent
+itemsets planted in the data) plus noise, giving realistic support
+distributions: a tail of infrequent items and a core of correlated frequent
+ones.  Deterministic for a given seed.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List
+
+
+def generate_transactions(
+    n_txns: int = 100_000,
+    n_items: int = 1000,
+    avg_txn_len: int = 10,
+    n_patterns: int = 100,
+    avg_pattern_len: int = 4,
+    corruption: float = 0.25,
+    seed: int = 2017,
+) -> List[str]:
+    """Return raw transaction lines (space-separated 1-based item ids)."""
+    rng = random.Random(seed)
+    # Pattern pool: random subsets, exponentially decaying pick weights.
+    patterns = []
+    for _ in range(n_patterns):
+        size = max(1, int(rng.expovariate(1.0 / avg_pattern_len)))
+        size = min(size, 3 * avg_pattern_len)
+        patterns.append(rng.sample(range(1, n_items + 1), min(size, n_items)))
+    weights = [rng.expovariate(1.0) for _ in range(n_patterns)]
+
+    lines = []
+    for _ in range(n_txns):
+        target = max(1, int(rng.expovariate(1.0 / avg_txn_len)))
+        target = min(target, 3 * avg_txn_len)
+        txn: set = set()
+        while len(txn) < target:
+            p = rng.choices(patterns, weights=weights, k=1)[0]
+            for item in p:
+                if len(txn) >= target:
+                    break
+                # corruption: drop items from the pattern at random
+                if rng.random() > corruption:
+                    txn.add(item)
+            else:
+                # occasionally inject uniform noise so the tail exists
+                if rng.random() < 0.1:
+                    txn.add(rng.randint(1, n_items))
+        lines.append(" ".join(str(i) for i in sorted(txn)))
+    return lines
+
+
+def generate_user_baskets(
+    n_users: int = 10_000,
+    n_items: int = 1000,
+    avg_len: int = 5,
+    seed: int = 2018,
+) -> List[str]:
+    """User baskets for the recommendation phase (U.dat analog)."""
+    rng = random.Random(seed)
+    lines = []
+    for _ in range(n_users):
+        size = max(1, min(int(rng.expovariate(1.0 / avg_len)), 3 * avg_len))
+        basket = rng.sample(range(1, n_items + 1), min(size, n_items))
+        lines.append(" ".join(str(i) for i in basket))
+    return lines

@@ -1,0 +1,56 @@
+"""Structured observability.
+
+The reference's entire observability surface is ``====``-prefixed wall-clock
+prints around phases and Apriori levels (Main.scala:28-37,
+FastApriori.scala:103-119, AssociationRules.scala:73-181 — SURVEY.md §5).
+Here the same events are emitted as structured JSON lines, plus the
+reference-style human line for familiarity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    """Per-level / per-phase metrics as JSON lines.
+
+    Each record carries an ``event`` name plus arbitrary fields; records go
+    to ``stream`` (default stderr) so stdout stays clean for data output.
+    """
+
+    def __init__(self, enabled: bool = True, stream=None):
+        self.enabled = enabled
+        self.stream = stream if stream is not None else sys.stderr
+        self.records: list[Dict[str, Any]] = []
+
+    def emit(self, event: str, **fields: Any) -> None:
+        rec = {"event": event, **fields}
+        self.records.append(rec)
+        if self.enabled:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
+    @contextlib.contextmanager
+    def timed(self, event: str, **fields: Any):
+        t0 = time.perf_counter()
+        holder: Dict[str, Any] = {}
+        try:
+            yield holder
+        finally:
+            holder.setdefault("wall_ms", round((time.perf_counter() - t0) * 1e3, 3))
+            self.emit(event, **fields, **holder)
+
+
+@contextlib.contextmanager
+def phase_timer(label: str, enabled: bool = True):
+    """Reference-style ``==== Use Time <label> <ms>`` print
+    (e.g. FastApriori.scala:108)."""
+    t0 = time.perf_counter()
+    yield
+    if enabled:
+        ms = int((time.perf_counter() - t0) * 1e3)
+        print(f"==== Use Time {label} {ms}", file=sys.stderr)

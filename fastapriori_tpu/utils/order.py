@@ -1,0 +1,24 @@
+"""Deterministic orderings standing in for the reference's
+Spark-nondeterministic collect orders."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def item_sort_key(item_count: Tuple[str, int]):
+    """Sort key for frequent-item rank assignment: descending count
+    (FastApriori.scala:60 ``sortBy(-_._2)``), ties broken by numeric value
+    of the item token ascending (items are integer strings in this domain),
+    falling back to the raw token.
+
+    The reference's tie order is whatever Spark's ``collect()`` returned
+    that run; a deterministic tie-break changes only which of two equal-count
+    items gets the lower rank, which can permute item order *within* an
+    output line for equal-count items — the itemset *sets* are identical.
+    """
+    item, count = item_count
+    try:
+        return (-count, 0, int(item), item)
+    except ValueError:
+        return (-count, 1, 0, item)

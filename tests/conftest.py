@@ -1,0 +1,88 @@
+"""Test harness: run everything on 8 fake CPU devices so the real
+Mesh/shard_map code paths execute without TPU hardware (SURVEY.md §4 —
+``--xla_force_host_platform_device_count``).  Counting is int32-exact, so
+single-device vs multi-device equality assertions are strict."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment may pre-import jax with a hardware backend selected
+# (e.g. the axon TPU tunnel registers itself from sitecustomize before
+# pytest starts), so env vars alone are not enough — force the CPU
+# platform and the 8-device split through the live config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import random  # noqa: E402
+from typing import List  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def random_dataset(
+    seed: int,
+    n_items: int = 12,
+    n_txns: int = 80,
+    max_len: int = 6,
+    with_edge_cases: bool = True,
+) -> List[str]:
+    """Skewed random transaction lines exercising the reference's edge
+    semantics: duplicate items within a line, duplicate lines, empty lines,
+    extra whitespace."""
+    rng = random.Random(seed)
+    items = [str(i) for i in range(1, n_items + 1)]
+    # Zipf-ish weights so some items are frequent and some are not.
+    weights = [1.0 / (i + 1) for i in range(n_items)]
+    lines = []
+    for _ in range(n_txns):
+        k = rng.randint(1, max_len)
+        txn = rng.choices(items, weights=weights, k=k)
+        lines.append(" ".join(txn))
+    if with_edge_cases:
+        lines.append("")  # empty line -> single empty token (Java split)
+        lines.append("  3   1  3 ")  # duplicate item + stray whitespace
+        if lines:
+            lines.append(lines[0])  # duplicate transaction
+    return lines
+
+
+@pytest.fixture
+def tiny_d_lines() -> List[List[str]]:
+    """Hand-written dataset with known frequent itemsets."""
+    raw = [
+        "1 2 3",
+        "1 2",
+        "1 3",
+        "2 3",
+        "1 2 3 4",
+        "4 5",
+        "1 2 4",
+        "2 3 4",
+        "1 2 3",
+        "5",
+    ]
+    from fastapriori_tpu.io.reader import tokenize_line
+
+    return [tokenize_line(l) for l in raw]
+
+
+@pytest.fixture
+def tiny_u_lines() -> List[List[str]]:
+    raw = ["1 2", "3", "1 2 3", "", "5 9", "2 4", "1 2"]
+    from fastapriori_tpu.io.reader import tokenize_line
+
+    return [tokenize_line(l) for l in raw]
+
+
+def tokenized(lines: List[str]) -> List[List[str]]:
+    from fastapriori_tpu.io.reader import tokenize_line
+
+    return [tokenize_line(l) for l in lines]

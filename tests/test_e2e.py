@@ -1,0 +1,62 @@
+"""End-to-end golden tests: CLI output files byte-identical to the oracle
+pipeline (SURVEY.md §4), plus resume round-trip."""
+
+import pytest
+
+from conftest import random_dataset
+from fastapriori_tpu import oracle
+from fastapriori_tpu.cli import main
+from fastapriori_tpu.io.reader import read_input_dir, tokenize_line
+
+
+def _write_inputs(tmp_path, d_raw, u_raw):
+    (tmp_path / "in").mkdir()
+    (tmp_path / "out").mkdir()
+    (tmp_path / "in" / "D.dat").write_text(
+        "".join(l + "\n" for l in d_raw)
+    )
+    (tmp_path / "in" / "U.dat").write_text(
+        "".join(l + "\n" for l in u_raw)
+    )
+    return str(tmp_path / "in") + "/", str(tmp_path / "out") + "/"
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_cli_end_to_end_matches_oracle(tmp_path, seed):
+    d_raw = random_dataset(seed)
+    u_raw = random_dataset(seed + 10, n_txns=25)
+    inp, outp = _write_inputs(tmp_path, d_raw, u_raw)
+
+    rc = main([inp, outp, "ignored-tmp-arg", "--min-support", "0.08"])
+    assert rc == 0
+
+    d_lines = [tokenize_line(l) for l in d_raw]
+    u_lines = [tokenize_line(l) for l in u_raw]
+    exp_freq, exp_rec = oracle.run_pipeline(d_lines, u_lines, 0.08)
+
+    assert (tmp_path / "out" / "freqItemset").read_text() == exp_freq
+    assert (tmp_path / "out" / "recommends").read_text() == exp_rec
+
+
+def test_cli_resume_round_trip(tmp_path):
+    d_raw = random_dataset(1)
+    u_raw = random_dataset(11, n_txns=20)
+    inp, outp = _write_inputs(tmp_path, d_raw, u_raw)
+
+    rc = main([inp, outp, "--min-support", "0.08", "--save-counts"])
+    assert rc == 0
+    rec_first = (tmp_path / "out" / "recommends").read_text()
+
+    # Re-run phase 2 only from the saved artifacts into a fresh output dir.
+    (tmp_path / "out2").mkdir()
+    outp2 = str(tmp_path / "out2") + "/"
+    rc = main([inp, outp2, "--resume-from", outp])
+    assert rc == 0
+    assert (tmp_path / "out2" / "recommends").read_text() == rec_first
+
+
+def test_reader_round_trip(tmp_path):
+    inp, _ = _write_inputs(tmp_path, ["1 2", "", " 3 "], ["7"])
+    d, u = read_input_dir(inp)
+    assert d == [["1", "2"], [""], ["3"]]
+    assert u == [["7"]]

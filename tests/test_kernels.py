@@ -1,0 +1,122 @@
+"""Device counting kernels (C5/C6/C8) vs numpy references, including the
+base-128 weight-digit decomposition and padding discipline."""
+
+import numpy as np
+import pytest
+
+from fastapriori_tpu.ops.bitmap import (
+    build_bitmap,
+    pad_axis,
+    weight_digits,
+)
+from fastapriori_tpu.parallel.mesh import DeviceContext
+
+
+def _random_bitmap_case(seed, t=37, f=23, max_w=1):
+    rng = np.random.default_rng(seed)
+    baskets = []
+    for _ in range(t):
+        size = rng.integers(2, min(f, 6) + 1)
+        baskets.append(
+            np.sort(rng.choice(f, size=size, replace=False)).astype(np.int32)
+        )
+    weights = rng.integers(1, max_w + 1, size=t).astype(np.int32)
+    return baskets, weights
+
+
+def test_pad_axis():
+    assert pad_axis(0, 8) == 8
+    assert pad_axis(1, 8) == 8
+    assert pad_axis(8, 8) == 8
+    assert pad_axis(9, 8) == 16
+
+
+def test_build_bitmap_padding_and_content():
+    baskets = [np.array([0, 2], np.int32), np.array([1, 2, 3], np.int32)]
+    b = build_bitmap(baskets, 4, txn_multiple=8, item_multiple=128)
+    assert b.shape == (8, 128)
+    assert b[0, 0] == 1 and b[0, 2] == 1 and b[0, 1] == 0
+    assert b[1, 1] == 1 and b[1, 2] == 1 and b[1, 3] == 1
+    # guaranteed zero column at index F and all padding zero
+    assert b[:, 4:].sum() == 0 and b[2:].sum() == 0
+
+
+@pytest.mark.parametrize("max_w", [1, 5, 130, 40000])
+def test_weight_digits_roundtrip(max_w):
+    rng = np.random.default_rng(0)
+    w = rng.integers(1, max_w + 1, size=50).astype(np.int32)
+    digits, scales = weight_digits(w, 64)
+    recon = sum(s * digits[d].astype(np.int64) for d, s in enumerate(scales))
+    assert (recon[:50] == w).all()
+    assert (recon[50:] == 0).all()
+    assert digits.dtype == np.int8 and (digits >= 0).all()
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+@pytest.mark.parametrize("max_w", [1, 300])
+def test_pair_counts_match_numpy(n_devices, max_w):
+    baskets, weights = _random_bitmap_case(1, max_w=max_w)
+    f = 23
+    ctx = DeviceContext(num_devices=n_devices)
+    b = build_bitmap(baskets, f, txn_multiple=32 * ctx.n_devices)
+    digits, scales = weight_digits(weights, b.shape[0])
+
+    got = np.asarray(
+        ctx.pair_counts(
+            ctx.shard_bitmap(b), ctx.shard_weight_digits(digits), scales
+        )
+    )
+    dense = b.astype(np.int64)
+    w_pad = np.zeros(b.shape[0], np.int64)
+    w_pad[: len(weights)] = weights
+    expected = (dense * w_pad[:, None]).T @ dense
+    assert got.shape == expected.shape
+    assert (got == expected).all()
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_level_counts_match_numpy(n_devices):
+    baskets, weights = _random_bitmap_case(2, max_w=7)
+    f = 23
+    ctx = DeviceContext(num_devices=n_devices)
+    b = build_bitmap(baskets, f, txn_multiple=32 * ctx.n_devices)
+    digits, scales = weight_digits(weights, b.shape[0])
+
+    # prefixes of width 2, incl. a padded row pointing at the zero column.
+    prefix_cols = np.array(
+        [[0, 1], [2, 5], [7, 8], [f, f]], dtype=np.int32
+    )
+    got = np.asarray(
+        ctx.level_counts(
+            ctx.shard_bitmap(b),
+            ctx.shard_weight_digits(digits),
+            scales,
+            prefix_cols,
+        )
+    )
+    dense = b.astype(np.int64)
+    w_pad = np.zeros(b.shape[0], np.int64)
+    w_pad[: len(weights)] = weights
+    for row, cols in enumerate(prefix_cols):
+        common = dense[:, cols[0]] * dense[:, cols[1]]
+        expected = (common * w_pad) @ dense
+        assert (got[row] == expected).all()
+    assert (got[3] == 0).all(), "padded prefix row must count zero"
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_item_supports(n_devices):
+    baskets, weights = _random_bitmap_case(3, max_w=3)
+    f = 23
+    ctx = DeviceContext(num_devices=n_devices)
+    b = build_bitmap(baskets, f, txn_multiple=32 * ctx.n_devices)
+    digits, scales = weight_digits(weights, b.shape[0])
+    got = np.asarray(
+        ctx.item_supports(
+            ctx.shard_bitmap(b), ctx.shard_weight_digits(digits), scales
+        )
+    )
+    w_pad = np.zeros(b.shape[0], np.int64)
+    w_pad[: len(weights)] = weights
+    expected = (b.astype(np.int64) * w_pad[:, None]).sum(axis=0)
+    assert (got == expected).all()

@@ -1,0 +1,81 @@
+"""The full mining engine vs the oracle (C6-C9)."""
+
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu import oracle
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.models.apriori import FastApriori
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("min_support", [0.05, 0.1, 0.2])
+def test_miner_matches_oracle(seed, min_support):
+    lines = tokenized(random_dataset(seed))
+    expected, exp_rank, exp_items = oracle.mine(lines, min_support)
+
+    miner = FastApriori(min_support, num_devices=1)
+    got, item_to_rank, freq_items = miner.run(lines)
+
+    assert freq_items == exp_items
+    assert item_to_rank == exp_rank
+    assert dict(got) == dict(expected)
+    assert len(got) == len(expected)
+
+
+def test_miner_dense_data_many_levels():
+    # Highly correlated baskets force levels >= 4.
+    lines = tokenized(
+        ["1 2 3 4 5"] * 10
+        + ["1 2 3 4"] * 5
+        + ["2 3 4 5"] * 5
+        + ["6 7"] * 3
+        + ["1", "8 9"]
+    )
+    expected, _, _ = oracle.mine(lines, 0.2)
+    miner = FastApriori(0.2, num_devices=1)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    assert max(len(s) for s, _ in got) >= 4
+
+
+def test_miner_no_frequent_pairs():
+    lines = tokenized(["1 2", "3 4", "5 6", "7 8"])
+    miner = FastApriori(0.5, num_devices=1)
+    got, _, freq_items = miner.run(lines)
+    assert got == [] and freq_items == []
+
+
+def test_miner_only_singletons():
+    lines = tokenized(["1", "1", "2", "1 2"])
+    expected, _, _ = oracle.mine(lines, 0.5)
+    miner = FastApriori(0.5, num_devices=1)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    assert all(len(s) == 1 for s, _ in got)
+
+
+def test_miner_repeated_baskets_weighting():
+    # 60 identical baskets dedupe to one row with weight 60; exercises the
+    # weighted counting path (weight < 128, single digit).
+    lines = tokenized(["10 20 30"] * 60 + ["10 20"] * 5 + ["40"] * 3)
+    expected, _, _ = oracle.mine(lines, 0.1)
+    got, _, _ = FastApriori(0.1, num_devices=1).run(lines)
+    assert dict(got) == dict(expected)
+
+
+def test_miner_weight_overflow_digit():
+    # >128 identical baskets forces a second base-128 digit.
+    lines = tokenized(["1 2 3"] * 300 + ["4 5"] * 10)
+    expected, _, _ = oracle.mine(lines, 0.05)
+    got, _, _ = FastApriori(0.05, num_devices=1).run(lines)
+    assert dict(got) == dict(expected)
+
+
+def test_miner_small_prefix_bucket():
+    # Tiny bucket forces multi-chunk level counting.
+    lines = tokenized(random_dataset(7, n_items=10, n_txns=100))
+    cfg = MinerConfig(min_support=0.05, min_prefix_bucket=2, num_devices=1)
+    expected, _, _ = oracle.mine(lines, 0.05)
+    got, _, _ = FastApriori(0.05, config=cfg).run(lines)
+    assert dict(got) == dict(expected)

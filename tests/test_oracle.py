@@ -1,0 +1,156 @@
+"""Validate the pure-Python oracle against independent brute-force
+implementations on small random datasets (SURVEY.md §4: framework output
+must equal naive O(2^F) enumeration).  The oracle is then trusted as the
+golden model for the framework tests."""
+
+import itertools
+import math
+from collections import Counter
+
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu import oracle
+
+
+def brute_force_itemsets(lines, min_support):
+    """Independent: enumerate ALL subsets of frequent items, count support
+    by direct containment over raw transactions."""
+    n = len(lines)
+    min_count = math.ceil(min_support * n)
+    occ = Counter()
+    for t in lines:
+        occ.update(t)
+    freq = sorted(
+        [i for i, c in occ.items() if c >= min_count],
+        key=lambda i: (-occ[i], int(i) if i.isdigit() else i),
+    )
+    rank = {i: r for r, i in enumerate(freq)}
+    filtered = [frozenset(rank[i] for i in t if i in rank) for t in lines]
+
+    expected = {}
+    for r, item in enumerate(freq):
+        expected[frozenset((r,))] = occ[item]
+    for size in range(2, len(freq) + 1):
+        found_any = False
+        for combo in itertools.combinations(range(len(freq)), size):
+            s = frozenset(combo)
+            support = sum(1 for t in filtered if s <= t)
+            if support >= min_count:
+                expected[s] = support
+                found_any = True
+        if not found_any:
+            break
+    return expected, freq, rank
+
+
+def brute_force_rules(freq_itemsets):
+    """Independent recursive formulation of the dominance prune: a rule
+    survives iff every (antecedent-minus-one -> same consequent) rule
+    survives with strictly lower confidence."""
+    support = dict(freq_itemsets)
+    raw = {}
+    for s, c in freq_itemsets:
+        if len(s) < 2:
+            continue
+        for i in s:
+            raw[(s - {i}, i)] = c / support[s - {i}]
+    if not raw:
+        return []
+    min_len = min(len(a) for a, _ in raw)
+    memo = {}
+
+    def survives(ant, cons):
+        key = (ant, cons)
+        if key in memo:
+            return memo[key]
+        if len(ant) == min_len:
+            memo[key] = True
+            return True
+        conf = raw[key]
+        ok = all(
+            (ant - {e}, cons) in raw
+            and survives(ant - {e}, cons)
+            and raw[(ant - {e}, cons)] < conf
+            for e in ant
+        )
+        memo[key] = ok
+        return ok
+
+    return [(a, c, conf) for (a, c), conf in raw.items() if survives(a, c)]
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("min_support", [0.05, 0.1, 0.2])
+def test_oracle_mine_matches_brute_force(seed, min_support):
+    lines = tokenized(random_dataset(seed))
+    expected, freq, rank = brute_force_itemsets(lines, min_support)
+
+    itemsets, item_to_rank, freq_items = oracle.mine(lines, min_support)
+    got = {s: c for s, c in itemsets}
+    assert len(got) == len(itemsets), "duplicate itemsets in oracle output"
+    assert freq_items == freq
+    assert item_to_rank == rank
+    assert got == expected
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_oracle_rules_match_brute_force(seed):
+    lines = tokenized(random_dataset(seed, n_txns=60))
+    itemsets, _, _ = oracle.mine(lines, 0.08)
+    got = oracle.gen_rules(itemsets)
+    expected = brute_force_rules(itemsets)
+    assert sorted(got, key=repr) == sorted(expected, key=repr)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_oracle_recommend_first_match(seed):
+    lines = tokenized(random_dataset(seed))
+    u_lines = tokenized(random_dataset(seed + 100, n_txns=30))
+    itemsets, item_to_rank, freq_items = oracle.mine(lines, 0.08)
+    rules = oracle.gen_rules(itemsets)
+    recs = oracle.recommend(u_lines, rules, freq_items, item_to_rank)
+
+    # Independent check: direct scan per user over independently sorted rules.
+    sorted_rules = sorted(
+        rules, key=lambda r: (-r[2], int(freq_items[r[1]]))
+    )
+    assert [i for i, _ in recs] == list(range(len(u_lines)))
+    for idx, item in recs:
+        basket = frozenset(
+            item_to_rank[i] for i in u_lines[idx] if i in item_to_rank
+        )
+        expected = "0"
+        for ant, cons, _ in sorted_rules:
+            if (
+                basket
+                and len(ant) <= len(basket)
+                and cons not in basket
+                and ant <= basket
+            ):
+                expected = freq_items[cons]
+                break
+        assert item == expected
+
+
+def test_oracle_known_tiny_case():
+    # 8 txns, minSupport 0.25 -> minCount 2.
+    lines = tokenized(["1 2", "1 2", "1 3", "2 3", "1 2 3", "4", "4", "1"])
+    itemsets, item_to_rank, freq_items = oracle.mine(lines, 0.25)
+    got = dict(itemsets)
+    # occurrence counts: 1->5, 2->4, 3->3, 4->2
+    assert freq_items == ["1", "2", "3", "4"]
+    r = item_to_rank
+    assert got[frozenset((r["1"],))] == 5
+    assert got[frozenset((r["4"],))] == 2
+    assert got[frozenset((r["1"], r["2"]))] == 3
+    assert got[frozenset((r["1"], r["3"]))] == 2
+    assert got[frozenset((r["2"], r["3"]))] == 2
+    # {1,2,3} appears once only -> not frequent.
+    assert frozenset((r["1"], r["2"], r["3"])) not in got
+
+
+def test_tokenize_matches_java_semantics():
+    assert oracle.tokenize("") == [""]
+    assert oracle.tokenize("   ") == [""]
+    assert oracle.tokenize(" 1  2\t3 ") == ["1", "2", "3"]

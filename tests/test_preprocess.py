@@ -1,0 +1,57 @@
+"""Host preprocessing (C3/C4/C10) vs the oracle."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu import oracle
+from fastapriori_tpu.preprocess import dedup_user_baskets, preprocess
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("min_support", [0.05, 0.15])
+def test_preprocess_matches_oracle(seed, min_support):
+    lines = tokenized(random_dataset(seed))
+    data = preprocess(lines, min_support, native=False)
+
+    import math
+
+    min_count = math.ceil(min_support * len(lines))
+    counts = oracle.count_items(lines)
+    freq_items, item_to_rank = oracle.freq_items_and_ranks(counts, min_count)
+    baskets, weights = oracle.dedup_transactions(lines, item_to_rank)
+
+    assert data.n_raw == len(lines)
+    assert data.min_count == min_count
+    assert data.freq_items == freq_items
+    assert data.item_to_rank == item_to_rank
+    assert [counts[i] for i in freq_items] == list(data.item_counts)
+
+    got = {tuple(b): int(w) for b, w in zip(data.baskets, data.weights)}
+    expected = {
+        tuple(sorted(b)): w for b, w in zip(baskets, weights)
+    }
+    assert got == expected
+    assert all(len(b) >= 2 for b in data.baskets)
+
+
+def test_dedup_user_baskets(tiny_u_lines):
+    item_to_rank = {"1": 0, "2": 1, "3": 2}
+    baskets, indexes, empty = dedup_user_baskets(tiny_u_lines, item_to_rank)
+    # rows: "1 2"->{0,1}; "3"->{2}; "1 2 3"->{0,1,2}; ""->empty;
+    # "5 9"->empty; "2 4"->{1}; "1 2"->{0,1} (dup of row 0)
+    assert empty == [3, 4]
+    got = {tuple(b): idxs for b, idxs in zip(baskets, indexes)}
+    assert got == {
+        (0, 1): [0, 6],
+        (2,): [1],
+        (0, 1, 2): [2],
+        (1,): [5],
+    }
+
+
+def test_empty_dataset():
+    data = preprocess([], 0.1, native=False)
+    assert data.num_items == 0
+    assert data.total_count == 0
+    assert data.n_raw == 0

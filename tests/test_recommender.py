@@ -1,0 +1,57 @@
+"""Recommender (C10+C12): device containment kernel vs host scan vs oracle."""
+
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu import oracle
+from fastapriori_tpu.models.recommender import AssociationRules
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("use_device", [False, True])
+def test_recommender_matches_oracle(seed, use_device):
+    d_lines = tokenized(random_dataset(seed))
+    u_lines = tokenized(random_dataset(seed + 50, n_txns=40))
+    itemsets, item_to_rank, freq_items = oracle.mine(d_lines, 0.08)
+    rules = oracle.gen_rules(itemsets)
+    expected = oracle.recommend(u_lines, rules, freq_items, item_to_rank)
+
+    rec = AssociationRules(itemsets, freq_items, item_to_rank)
+    got = rec.run(u_lines, use_device=use_device)
+    assert sorted(got) == sorted(expected)
+
+
+def test_recommender_empty_rules(tiny_u_lines):
+    # No frequent itemsets of size >= 2 -> no rules -> all "0".
+    itemsets = [(frozenset((0,)), 5), (frozenset((1,)), 4)]
+    rec = AssociationRules(itemsets, ["1", "2"], {"1": 0, "2": 1})
+    got = rec.run(tiny_u_lines)
+    assert got == [(i, "0") for i in range(len(tiny_u_lines))] or sorted(
+        got
+    ) == sorted((i, "0") for i in range(len(tiny_u_lines)))
+
+
+def test_recommender_no_users():
+    itemsets = [
+        (frozenset((0,)), 5),
+        (frozenset((1,)), 4),
+        (frozenset((0, 1)), 3),
+    ]
+    rec = AssociationRules(itemsets, ["1", "2"], {"1": 0, "2": 1})
+    assert rec.run([]) == []
+
+
+def test_recommender_dedup_fanout():
+    # Identical baskets must all receive the fanned-out recommendation
+    # (AssociationRules.scala:104-105).
+    itemsets = [
+        (frozenset((0,)), 6),
+        (frozenset((1,)), 5),
+        (frozenset((0, 1)), 4),
+    ]
+    u_lines = tokenized(["1", "1", "2", "zzz"])
+    rec = AssociationRules(itemsets, ["1", "2"], {"1": 0, "2": 1})
+    got = dict(rec.run(u_lines))
+    # basket {1} -> rule {0}->1 fires -> item "2"; basket {2} -> item "1";
+    # unknown item -> "0".
+    assert got == {0: "2", 1: "2", 2: "1", 3: "0"}

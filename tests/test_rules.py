@@ -1,0 +1,70 @@
+"""Rule generation + dominance prune (C11) and ordering vs the oracle."""
+
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu import oracle
+from fastapriori_tpu.rules.gen import gen_rules, sort_rules
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("min_support", [0.05, 0.1])
+def test_rules_match_oracle(seed, min_support):
+    lines = tokenized(random_dataset(seed))
+    itemsets, _, freq_items = oracle.mine(lines, min_support)
+    expected = oracle.gen_rules(itemsets)
+    got = gen_rules(itemsets)
+    assert sorted(got, key=repr) == sorted(expected, key=repr)
+
+    exp_sorted = oracle.sort_rules(expected, freq_items)
+    got_sorted = sort_rules(got, freq_items)
+    # Priority keys must agree pairwise (ties beyond the key are
+    # output-equivalent: same consequent).
+    assert [(r[2], freq_items[r[1]]) for r in got_sorted] == [
+        (r[2], freq_items[r[1]]) for r in exp_sorted
+    ]
+
+
+def test_rules_strictly_increasing_chain_semantics():
+    # Hand-built table: {0},{1},{2} singletons; pairs; one triple.
+    # Confidence chain checks the strict < requirement.
+    itemsets = [
+        (frozenset((0,)), 10),
+        (frozenset((1,)), 10),
+        (frozenset((2,)), 8),
+        (frozenset((0, 1)), 6),
+        (frozenset((0, 2)), 6),
+        (frozenset((1, 2)), 6),
+        (frozenset((0, 1, 2)), 5),
+    ]
+    rules = gen_rules(itemsets)
+    as_set = {(a, c): conf for a, c, conf in rules}
+    # level-1 rules all kept
+    assert as_set[(frozenset((0,)), 1)] == 6 / 10
+    # rule {0,1}->2: subsets {0}->2 (6/10) and {1}->2 (6/10); conf 5/6.
+    # 5/6 > 6/10 strictly for both -> survives.
+    assert (frozenset((0, 1)), 2) in as_set
+    # rule {0,2}->1: conf 5/6 vs {0}->1 (6/10), {2}->1 (6/8)=0.75 < 5/6 -> ok
+    assert (frozenset((0, 2)), 1) in as_set
+
+
+def test_rules_prune_kills_non_increasing():
+    # {0,1}->2 with confidence equal to {0}->2 must be pruned (>= kills).
+    itemsets = [
+        (frozenset((0,)), 10),
+        (frozenset((1,)), 10),
+        (frozenset((2,)), 10),
+        (frozenset((0, 1)), 10),
+        (frozenset((0, 2)), 6),
+        (frozenset((1, 2)), 6),
+        (frozenset((0, 1, 2)), 6),
+    ]
+    rules = gen_rules(itemsets)
+    as_set = {(a, c) for a, c, _ in rules}
+    # {0,1}->2 conf 6/10; {0}->2 conf 6/10 -> equal -> pruned.
+    assert (frozenset((0, 1)), 2) not in as_set
+
+
+def test_rules_empty_when_no_pairs():
+    assert gen_rules([(frozenset((0,)), 5)]) == []
+    assert gen_rules([]) == []
