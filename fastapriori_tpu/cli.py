@@ -25,7 +25,6 @@ import time
 from typing import List, Optional
 
 from fastapriori_tpu.config import DEFAULT_MIN_SUPPORT, MinerConfig
-from fastapriori_tpu.io.reader import read_input_dir
 from fastapriori_tpu.io.writer import save_freq_itemsets, save_recommends
 
 
@@ -95,7 +94,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fastapriori_tpu.models.apriori import FastApriori
     from fastapriori_tpu.models.recommender import AssociationRules
 
-    d_lines, u_lines = read_input_dir(args.input)
+    from fastapriori_tpu.io.reader import read_dat
+
+    u_lines = read_dat(args.input + "U.dat")
 
     t1 = time.perf_counter()
     if args.resume_from:
@@ -109,7 +110,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             profiler.start_trace(args.profile_dir)
         miner = FastApriori(args.min_support, config=config)
-        freq_itemsets, item_to_rank, freq_items = miner.run(d_lines)
+        freq_itemsets, item_to_rank, freq_items = miner.run_file(
+            args.input + "D.dat"
+        )
         if profiler is not None:
             profiler.stop_trace()
         save_freq_itemsets(args.output, freq_itemsets, freq_items)

@@ -23,7 +23,7 @@ import numpy as np
 
 from fastapriori_tpu.config import MinerConfig
 from fastapriori_tpu.models.candidates import gen_candidates
-from fastapriori_tpu.ops.bitmap import build_bitmap, weight_digits
+from fastapriori_tpu.ops.bitmap import build_bitmap_csr, weight_digits
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import CompressedData, preprocess
 from fastapriori_tpu.utils.logging import MetricsLogger
@@ -99,6 +99,25 @@ class FastApriori:
         freq_itemsets = self.mine_compressed(data)
         return freq_itemsets, data.item_to_rank, data.freq_items
 
+    def run_file(
+        self, d_path: str
+    ) -> Tuple[List[ItemsetWithCount], Dict[str, int], List[str]]:
+        """Like :meth:`run` but ingesting ``D.dat`` directly from disk, so
+        the native preprocessor (when built) parses raw bytes without
+        Python tokenization (reference ingest: Utils.scala:21)."""
+        from fastapriori_tpu.preprocess import preprocess_file
+
+        with self.metrics.timed("preprocess", path=d_path) as m:
+            data = preprocess_file(d_path, self.config.min_support)
+            m.update(
+                n_raw=data.n_raw,
+                min_count=data.min_count,
+                num_items=data.num_items,
+                total_count=data.total_count,
+            )
+        freq_itemsets = self.mine_compressed(data)
+        return freq_itemsets, data.item_to_rank, data.freq_items
+
     def mine_compressed(self, data: CompressedData) -> List[ItemsetWithCount]:
         """Levels >=2 via device kernels, then 1-itemsets appended."""
         one_itemsets: List[ItemsetWithCount] = [
@@ -119,8 +138,12 @@ class FastApriori:
 
         with self.metrics.timed("bitmap_build") as m:
             txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices
-            bitmap_np = build_bitmap(
-                data.baskets, f, txn_multiple, cfg.item_tile
+            bitmap_np = build_bitmap_csr(
+                data.basket_indices,
+                data.basket_offsets,
+                f,
+                txn_multiple,
+                cfg.item_tile,
             )
             t_pad = bitmap_np.shape[0]
             w_digits_np, scales = weight_digits(data.weights, t_pad)

@@ -1,16 +1,14 @@
 """Native (C++) fast paths for host-side preprocessing.
 
 The reference's host-side work runs on the JVM inside Spark; here the
-Python fallback is numpy/dicts and the fast path is a C++ extension
-(tokenize + count + dedup in one pass) built by ``make -C
-fastapriori_tpu/native`` and loaded via ctypes.  Import never fails: if the
-shared library is absent, ``maybe_native_preprocess`` returns None and the
-Python path runs.
-"""
+Python fallback is numpy/dicts (fastapriori_tpu/preprocess.py) and the
+fast path is a C++ shared library (tokenize + count + dedup in one pass
+over the raw bytes — preprocess.cc) built by ``make -C
+fastapriori_tpu/native`` (attempted automatically on first use) and loaded
+via ctypes.  Selection logic lives in preprocess._use_native; this module
+only answers availability."""
 
 from __future__ import annotations
-
-from typing import Optional, Sequence, Tuple
 
 
 def native_available() -> bool:
@@ -20,33 +18,3 @@ def native_available() -> bool:
         return get_lib() is not None
     except Exception:
         return False
-
-
-def maybe_native_preprocess(
-    transactions: Sequence[Sequence[str]],
-    min_count: int,
-    force: Optional[bool],
-):
-    """Return preprocess results from the C++ path, or None to use Python.
-
-    ``force``: True = require native (raise if unavailable); False = never
-    use native; None = use native when built and the input is large enough
-    to amortize the FFI boundary."""
-    if force is False:
-        return None
-    try:
-        from fastapriori_tpu.native.loader import preprocess_native, get_lib
-
-        available = get_lib() is not None
-    except ImportError:
-        available = False
-    if not available:
-        if force:
-            raise RuntimeError(
-                "native preprocessing requested but the extension is not "
-                "built; run `make -C fastapriori_tpu/native`"
-            )
-        return None
-    if force is None and len(transactions) < 50_000:
-        return None
-    return preprocess_native(transactions, min_count)

@@ -1,0 +1,146 @@
+"""ctypes bindings for the native preprocessing library.
+
+Builds lazily on first use if g++ is available (``make -C
+fastapriori_tpu/native``); absence is non-fatal — callers fall back to the
+Python path (see fastapriori_tpu/native/__init__.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libfa_native.so")
+_lib = None
+_build_attempted = False
+
+
+class _FaResult(ctypes.Structure):
+    _fields_ = [
+        ("n_raw", ctypes.c_int64),
+        ("min_count", ctypes.c_int64),
+        ("n_items", ctypes.c_int32),
+        # void* (not c_char_p): the buffer is length-delimited, not
+        # NUL-terminated, and c_char_p field access would scan for NUL.
+        ("items_buf", ctypes.c_void_p),
+        ("items_buf_len", ctypes.c_int64),
+        ("item_counts", ctypes.POINTER(ctypes.c_int64)),
+        ("n_baskets", ctypes.c_int64),
+        ("basket_offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("basket_items", ctypes.POINTER(ctypes.c_int32)),
+        ("weights", ctypes.POINTER(ctypes.c_int32)),
+    ]
+
+
+def _try_build() -> None:
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        pass
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        _try_build()
+    if not os.path.exists(_SO):
+        return None
+    lib = ctypes.CDLL(_SO)
+    lib.fa_preprocess_buffer.restype = ctypes.POINTER(_FaResult)
+    lib.fa_preprocess_buffer.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_double,
+    ]
+    lib.fa_free_result.argtypes = [ctypes.POINTER(_FaResult)]
+    lib.fa_free_result.restype = None
+    _lib = lib
+    return _lib
+
+
+NativeResult = Tuple[
+    int,  # n_raw
+    int,  # min_count
+    List[str],  # freq_items
+    np.ndarray,  # item_counts int64[F]
+    np.ndarray,  # basket_indices int32 (CSR data)
+    np.ndarray,  # basket_offsets int64[T'+1]
+    np.ndarray,  # weights int32[T']
+]
+
+
+def preprocess_buffer(data: bytes, min_support: float) -> NativeResult:
+    """Run the one-pass native preprocessing over raw file bytes."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "native preprocessing library is not built; run "
+            "`make -C fastapriori_tpu/native`"
+        )
+    res_ptr = lib.fa_preprocess_buffer(
+        data, len(data), ctypes.c_double(min_support)
+    )
+    if not res_ptr:
+        raise MemoryError("fa_preprocess_buffer failed")
+    try:
+        res = res_ptr.contents
+        f = int(res.n_items)
+        t = int(res.n_baskets)
+        items_raw = ctypes.string_at(res.items_buf, res.items_buf_len)
+        freq_items = (
+            items_raw.decode("utf-8").split("\n") if res.items_buf_len else []
+        )
+        if f == 0:
+            freq_items = []
+        assert len(freq_items) == f, (len(freq_items), f)
+        item_counts = np.ctypeslib.as_array(res.item_counts, shape=(max(f, 1),))[
+            :f
+        ].copy()
+        offsets = np.ctypeslib.as_array(
+            res.basket_offsets, shape=(t + 1,)
+        ).copy()
+        nnz = int(offsets[-1]) if t else 0
+        indices = np.ctypeslib.as_array(
+            res.basket_items, shape=(max(nnz, 1),)
+        )[:nnz].copy()
+        weights = np.ctypeslib.as_array(res.weights, shape=(max(t, 1),))[
+            :t
+        ].copy()
+        return (
+            int(res.n_raw),
+            int(res.min_count),
+            freq_items,
+            item_counts,
+            indices,
+            offsets,
+            weights,
+        )
+    finally:
+        lib.fa_free_result(res_ptr)
+
+
+def preprocess_file(path: str, min_support: float) -> NativeResult:
+    with open(path, "rb") as fh:
+        return preprocess_buffer(fh.read(), min_support)
+
+
+def join_transactions(transactions: Sequence[Sequence[str]]) -> bytes:
+    """Re-serialize token lists so the buffer path can run on in-memory
+    data (tokens contain no whitespace, so this round-trips exactly)."""
+    return "\n".join(" ".join(t) for t in transactions).encode("utf-8")

@@ -1,0 +1,268 @@
+// Native preprocessing: tokenize + item count + rank + basket dedup in one
+// pass over the raw bytes (reference components C3/C4, FastApriori.scala:
+// 52-85 — there they are Spark shuffle passes; here a single C++ scan).
+//
+// Semantics contract (must match fastapriori_tpu/preprocess.py exactly;
+// tests/test_native.py enforces equality):
+//   - lines split on '\n'; each line trimmed then split on ASCII whitespace
+//     runs; an empty (trimmed) line yields ONE empty token (Java
+//     String.split("\\s+") semantics, Utils.scala:21);
+//   - item occurrence counts: every token occurrence counts, duplicates
+//     within a line included (FastApriori.scala:55);
+//   - minCount = ceil(min_support * raw_line_count) (FastApriori.scala:39);
+//   - frequent items sorted by (-count, numeric-if-integer asc, token asc)
+//     (utils/order.py item_sort_key), dense ranks 0..F-1;
+//   - baskets: per line, frequent tokens -> ranks, dedup within line, drop
+//     size <= 1, dedupe identical baskets with int32 multiplicity
+//     (FastApriori.scala:66-79); first-seen order.
+//
+// C ABI only (loaded via ctypes): fa_preprocess_buffer / fa_free_result.
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct VecHash {
+  size_t operator()(const std::vector<int32_t>& v) const {
+    // FNV-1a over the rank bytes.
+    uint64_t h = 1469598103934665603ull;
+    for (int32_t x : v) {
+      for (int i = 0; i < 4; ++i) {
+        h ^= static_cast<uint8_t>(x >> (i * 8));
+        h *= 1099511628211ull;
+      }
+    }
+    return static_cast<size_t>(h);
+  }
+};
+
+inline bool is_ws(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
+         c == '\r';
+}
+
+// Matches Python int(token) on ASCII: optional sign, all digits.  Python
+// ints are arbitrary precision, so the value is kept as a normalized
+// (negative, digits-without-leading-zeros) pair and compared by
+// (sign, magnitude-length, magnitude-lexical) — exact for any size.
+struct BigInt {
+  bool negative = false;
+  std::string_view digits;  // no leading zeros; empty means 0
+};
+
+bool parse_int(std::string_view s, BigInt* out) {
+  if (s.empty()) return false;
+  size_t i = 0;
+  bool neg = false;
+  if (s[0] == '+' || s[0] == '-') {
+    neg = s[0] == '-';
+    if (s.size() == 1) return false;
+    i = 1;
+  }
+  size_t first = i;
+  for (; i < s.size(); ++i) {
+    if (s[i] < '0' || s[i] > '9') return false;
+  }
+  while (first < s.size() - 1 && s[first] == '0') ++first;
+  std::string_view digits = s.substr(first);
+  if (digits == "0") digits = std::string_view();
+  out->negative = neg && !digits.empty();  // -0 == 0
+  out->digits = digits;
+  return true;
+}
+
+// v < w as integers.
+bool bigint_less(const BigInt& v, const BigInt& w) {
+  if (v.negative != w.negative) return v.negative;
+  bool less;
+  if (v.digits.size() != w.digits.size()) {
+    less = v.digits.size() < w.digits.size();
+  } else {
+    less = v.digits < w.digits;
+  }
+  return v.negative ? (v.digits != w.digits && !less) : less;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct FaResult {
+  int64_t n_raw;      // raw transaction (line) count
+  int64_t min_count;  // ceil(min_support * n_raw)
+  int32_t n_items;    // F
+  // Frequent item tokens in rank order, '\n'-joined (no trailing newline).
+  char* items_buf;
+  int64_t items_buf_len;
+  int64_t* item_counts;  // [F] occurrence counts by rank
+  int64_t n_baskets;     // T'
+  int64_t* basket_offsets;  // [T'+1] CSR offsets into basket_items
+  int32_t* basket_items;    // flattened sorted ranks
+  int32_t* weights;         // [T'] multiplicities
+};
+
+// data/len: raw file bytes.  Not nul-terminated.  Returns a heap-allocated
+// result (free with fa_free_result) or nullptr on allocation failure.
+FaResult* fa_preprocess_buffer(const char* data, int64_t len,
+                               double min_support) {
+  std::string_view buf(data, static_cast<size_t>(len));
+
+  // ---- split into trimmed lines (last line may lack '\n') --------------
+  std::vector<std::string_view> lines;
+  {
+    size_t pos = 0;
+    while (pos <= buf.size()) {
+      size_t nl = buf.find('\n', pos);
+      size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
+      if (nl == std::string_view::npos && pos == buf.size()) break;
+      std::string_view line = buf.substr(pos, end - pos);
+      // trim (Java String.trim: chars <= 0x20)
+      size_t b = 0, e = line.size();
+      while (b < e && static_cast<unsigned char>(line[b]) <= 0x20) ++b;
+      while (e > b && static_cast<unsigned char>(line[e - 1]) <= 0x20) --e;
+      lines.push_back(line.substr(b, e - b));
+      if (nl == std::string_view::npos) break;
+      pos = nl + 1;
+    }
+  }
+  const int64_t n_raw = static_cast<int64_t>(lines.size());
+  const int64_t min_count =
+      static_cast<int64_t>(std::ceil(min_support * static_cast<double>(n_raw)));
+
+  // ---- pass 1: occurrence counts ---------------------------------------
+  std::unordered_map<std::string_view, int64_t> counts;
+  counts.reserve(1 << 16);
+  auto for_each_token = [](std::string_view line, auto&& fn) {
+    if (line.empty()) {
+      fn(std::string_view(""));  // Java split("") -> [""]
+      return;
+    }
+    size_t i = 0;
+    while (i < line.size()) {
+      while (i < line.size() && is_ws(line[i])) ++i;
+      size_t start = i;
+      while (i < line.size() && !is_ws(line[i])) ++i;
+      if (i > start) fn(line.substr(start, i - start));
+    }
+  };
+  for (auto line : lines) {
+    for_each_token(line, [&](std::string_view tok) { ++counts[tok]; });
+  }
+
+  // ---- rank assignment -------------------------------------------------
+  struct Item {
+    std::string_view tok;
+    int64_t count;
+    bool numeric;
+    BigInt value;
+  };
+  std::vector<Item> freq;
+  for (const auto& [tok, c] : counts) {
+    if (c >= min_count) {
+      BigInt v;
+      bool num = parse_int(tok, &v);
+      freq.push_back({tok, c, num, v});
+    }
+  }
+  std::sort(freq.begin(), freq.end(), [](const Item& a, const Item& b) {
+    if (a.count != b.count) return a.count > b.count;
+    if (a.numeric != b.numeric) return a.numeric;  // numeric first
+    if (a.numeric) {
+      if (bigint_less(a.value, b.value)) return true;
+      if (bigint_less(b.value, a.value)) return false;
+    }
+    return a.tok < b.tok;
+  });
+  const int32_t f = static_cast<int32_t>(freq.size());
+  std::unordered_map<std::string_view, int32_t> rank;
+  rank.reserve(freq.size() * 2);
+  for (int32_t r = 0; r < f; ++r) rank.emplace(freq[r].tok, r);
+
+  // ---- pass 2: basket dedup with multiplicity --------------------------
+  std::unordered_map<std::vector<int32_t>, int32_t, VecHash> mult;
+  mult.reserve(1 << 16);
+  std::vector<const std::vector<int32_t>*> order;
+  std::vector<int32_t> scratch;
+  int64_t total_items = 0;
+  for (auto line : lines) {
+    scratch.clear();
+    for_each_token(line, [&](std::string_view tok) {
+      auto it = rank.find(tok);
+      if (it != rank.end()) scratch.push_back(it->second);
+    });
+    std::sort(scratch.begin(), scratch.end());
+    scratch.erase(std::unique(scratch.begin(), scratch.end()), scratch.end());
+    if (scratch.size() <= 1) continue;
+    auto [it, inserted] = mult.emplace(scratch, 1);
+    if (inserted) {
+      order.push_back(&it->first);
+      total_items += static_cast<int64_t>(scratch.size());
+    } else {
+      ++it->second;
+    }
+  }
+  const int64_t t = static_cast<int64_t>(order.size());
+
+  // ---- marshal ---------------------------------------------------------
+  auto* res = static_cast<FaResult*>(std::calloc(1, sizeof(FaResult)));
+  if (!res) return nullptr;
+  res->n_raw = n_raw;
+  res->min_count = min_count;
+  res->n_items = f;
+
+  int64_t items_len = 0;
+  for (const auto& item : freq) items_len += item.tok.size() + 1;
+  res->items_buf = static_cast<char*>(std::malloc(items_len ? items_len : 1));
+  res->items_buf_len = items_len ? items_len - 1 : 0;  // drop trailing '\n'
+  {
+    char* p = res->items_buf;
+    for (const auto& item : freq) {
+      std::memcpy(p, item.tok.data(), item.tok.size());
+      p += item.tok.size();
+      *p++ = '\n';
+    }
+  }
+  res->item_counts =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (f ? f : 1)));
+  for (int32_t r = 0; r < f; ++r) res->item_counts[r] = freq[r].count;
+
+  res->n_baskets = t;
+  res->basket_offsets =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (t + 1)));
+  res->basket_items = static_cast<int32_t*>(
+      std::malloc(sizeof(int32_t) * (total_items ? total_items : 1)));
+  res->weights =
+      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (t ? t : 1)));
+  int64_t off = 0;
+  for (int64_t i = 0; i < t; ++i) {
+    const auto& basket = *order[i];
+    res->basket_offsets[i] = off;
+    std::memcpy(res->basket_items + off, basket.data(),
+                basket.size() * sizeof(int32_t));
+    off += static_cast<int64_t>(basket.size());
+    res->weights[i] = mult.find(basket)->second;
+  }
+  res->basket_offsets[t] = off;
+  return res;
+}
+
+void fa_free_result(FaResult* res) {
+  if (!res) return;
+  std::free(res->items_buf);
+  std::free(res->item_counts);
+  std::free(res->basket_offsets);
+  std::free(res->basket_items);
+  std::free(res->weights);
+  std::free(res);
+}
+
+}  // extern "C"

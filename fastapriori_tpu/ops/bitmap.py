@@ -59,6 +59,28 @@ def build_bitmap(
     return b
 
 
+def build_bitmap_csr(
+    indices: np.ndarray,
+    offsets: np.ndarray,
+    num_items: int,
+    txn_multiple: int = 8,
+    item_multiple: int = 128,
+) -> np.ndarray:
+    """CSR variant of :func:`build_bitmap` (basket ``i`` =
+    ``indices[offsets[i]:offsets[i+1]]``) — the zero-copy path from the
+    native preprocessor."""
+    t = len(offsets) - 1
+    t_pad = pad_axis(t, txn_multiple)
+    f_pad = pad_axis(num_items + 1, item_multiple)
+    b = np.zeros((t_pad, f_pad), dtype=np.int8)
+    if t > 0 and len(indices) > 0:
+        rows = np.repeat(
+            np.arange(t, dtype=np.int64), np.diff(offsets).astype(np.int64)
+        )
+        b[rows, indices] = 1
+    return b
+
+
 def pad_weights(weights: np.ndarray, txn_pad: int) -> np.ndarray:
     """Zero-pad the multiplicity vector to the padded transaction count."""
     out = np.zeros(txn_pad, dtype=np.int32)

@@ -3,17 +3,21 @@ compression (reference components C3/C4/C10, SURVEY.md §2).
 
 The reference runs these as Spark shuffle passes (FastApriori.scala:52-85,
 AssociationRules.scala:33-64).  On TPU the mining kernels want a dense
-weighted bitmap, so preprocessing runs on the host (numpy + dict hashing;
-a native C++ fast path lives in fastapriori_tpu/native) and produces:
+weighted bitmap, so preprocessing runs on the host and produces:
 
 - ``freq_items``: item strings sorted by descending occurrence count
   (rank 0 = most frequent — FastApriori.scala:60-62);
 - ``item_counts``: occurrence counts aligned to rank.  Occurrences, not
   transaction support: the reference counts via ``flatMap(_.map((_,1)))``
   (FastApriori.scala:55) so duplicates *within* a line each count;
-- deduplicated baskets with multiplicity weights (FastApriori.scala:66-79):
-  per transaction, keep frequent items, map to ranks, drop baskets of size
-  <= 1, merge identical baskets into one row with an int32 weight.
+- deduplicated baskets with multiplicity weights (FastApriori.scala:66-79)
+  in CSR form: per transaction, keep frequent items, map to ranks, drop
+  baskets of size <= 1, merge identical baskets into one row with an int32
+  weight.
+
+Two interchangeable engines: the pure-Python/numpy path below, and the
+native C++ one-pass scanner (fastapriori_tpu/native) used automatically for
+large inputs when built — equality is enforced by tests/test_native.py.
 """
 
 from __future__ import annotations
@@ -30,14 +34,20 @@ from fastapriori_tpu.utils.order import item_sort_key
 
 @dataclasses.dataclass
 class CompressedData:
-    """Output of phase 1 preprocessing — the miner's entire input."""
+    """Output of phase 1 preprocessing — the miner's entire input.
+
+    Baskets are stored CSR-style: ``basket_indices`` holds the sorted item
+    ranks of every basket back-to-back; basket ``i`` spans
+    ``basket_indices[basket_offsets[i]:basket_offsets[i+1]]``.
+    """
 
     n_raw: int  # raw transaction count N (FastApriori.scala:38)
     min_count: int  # ceil(minSupport * N)   (FastApriori.scala:39)
     freq_items: List[str]  # rank -> item string
     item_to_rank: Dict[str, int]
     item_counts: np.ndarray  # int64[F] occurrence counts by rank
-    baskets: List[np.ndarray]  # T' ragged rows of sorted ranks, len >= 2
+    basket_indices: np.ndarray  # int32[nnz] flattened sorted ranks
+    basket_offsets: np.ndarray  # int64[T'+1]
     weights: np.ndarray  # int32[T'] multiplicities
 
     @property
@@ -46,7 +56,15 @@ class CompressedData:
 
     @property
     def total_count(self) -> int:  # T' (FastApriori.scala:79)
-        return len(self.baskets)
+        return len(self.weights)
+
+    @property
+    def baskets(self) -> List[np.ndarray]:
+        """Ragged view (one array per basket); prefer the CSR fields."""
+        return [
+            self.basket_indices[self.basket_offsets[i] : self.basket_offsets[i + 1]]
+            for i in range(self.total_count)
+        ]
 
 
 def count_item_occurrences(
@@ -77,11 +95,11 @@ def dedup_baskets(
     transactions: Sequence[Sequence[str]],
     item_to_rank: Dict[str, int],
     min_size: int = 2,
-) -> Tuple[List[np.ndarray], np.ndarray]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """C4 (FastApriori.scala:66-79): filter to frequent items, rank-map,
     ``toSet`` dedupe within a line, drop baskets smaller than ``min_size``,
-    merge identical baskets with multiplicity.  Basket identity is the
-    sorted rank tuple.  Returns (baskets in first-seen order, weights)."""
+    merge identical baskets with multiplicity.  Returns CSR
+    ``(indices, offsets, weights)`` with baskets in first-seen order."""
     mult: Dict[Tuple[int, ...], int] = {}
     for t in transactions:
         ranks = {item_to_rank[i] for i in t if i in item_to_rank}
@@ -89,9 +107,68 @@ def dedup_baskets(
             continue
         key = tuple(sorted(ranks))
         mult[key] = mult.get(key, 0) + 1
-    baskets = [np.asarray(k, dtype=np.int32) for k in mult.keys()]
-    weights = np.asarray(list(mult.values()), dtype=np.int32)
-    return baskets, weights
+    offsets = np.zeros(len(mult) + 1, dtype=np.int64)
+    sizes = [len(k) for k in mult.keys()]
+    offsets[1:] = np.cumsum(sizes, dtype=np.int64) if sizes else 0
+    indices = (
+        np.concatenate([np.asarray(k, dtype=np.int32) for k in mult.keys()])
+        if mult
+        else np.empty(0, dtype=np.int32)
+    )
+    weights = np.fromiter(mult.values(), dtype=np.int32, count=len(mult))
+    return indices, offsets, weights
+
+
+def _python_preprocess(
+    transactions: Sequence[Sequence[str]], min_support: float
+) -> CompressedData:
+    n_raw = len(transactions)
+    min_count = int(math.ceil(min_support * n_raw))
+    counts = count_item_occurrences(transactions)
+    freq_items, item_to_rank, item_counts = build_rank_map(counts, min_count)
+    indices, offsets, weights = dedup_baskets(transactions, item_to_rank)
+    return CompressedData(
+        n_raw=n_raw,
+        min_count=min_count,
+        freq_items=freq_items,
+        item_to_rank=item_to_rank,
+        item_counts=item_counts,
+        basket_indices=indices,
+        basket_offsets=offsets,
+        weights=weights,
+    )
+
+
+def _native_result_to_data(result) -> CompressedData:
+    n_raw, min_count, freq_items, item_counts, indices, offsets, weights = (
+        result
+    )
+    return CompressedData(
+        n_raw=n_raw,
+        min_count=min_count,
+        freq_items=freq_items,
+        item_to_rank={item: r for r, item in enumerate(freq_items)},
+        item_counts=item_counts,
+        basket_indices=indices,
+        basket_offsets=offsets,
+        weights=weights,
+    )
+
+
+def _use_native(native: Optional[bool], size_hint: int) -> bool:
+    if native is False:
+        return False
+    from fastapriori_tpu.native import native_available
+
+    available = native_available()
+    if native is True:
+        if not available:
+            raise RuntimeError(
+                "native preprocessing requested but the extension is not "
+                "built; run `make -C fastapriori_tpu/native`"
+            )
+        return True
+    return available and size_hint >= 50_000
 
 
 def preprocess(
@@ -100,33 +177,37 @@ def preprocess(
     native: Optional[bool] = None,
 ) -> CompressedData:
     """Full phase-1 preprocessing (mirrors genFreqItems,
-    FastApriori.scala:46-86).
+    FastApriori.scala:46-86) from already-tokenized lines.
 
     ``native``: force (True) or forbid (False) the C++ fast path; None
-    auto-selects it when the extension is built and input is large.
+    auto-selects it when the extension is built and the input is large.
     """
-    from fastapriori_tpu.native import maybe_native_preprocess
+    if _use_native(native, len(transactions)):
+        from fastapriori_tpu.native.loader import (
+            join_transactions,
+            preprocess_buffer,
+        )
 
-    n_raw = len(transactions)
-    min_count = int(math.ceil(min_support * n_raw))
+        return _native_result_to_data(
+            preprocess_buffer(join_transactions(transactions), min_support)
+        )
+    return _python_preprocess(transactions, min_support)
 
-    result = maybe_native_preprocess(transactions, min_count, native)
-    if result is not None:
-        freq_items, item_to_rank, item_counts, baskets, weights = result
-    else:
-        counts = count_item_occurrences(transactions)
-        freq_items, item_to_rank, item_counts = build_rank_map(counts, min_count)
-        baskets, weights = dedup_baskets(transactions, item_to_rank)
 
-    return CompressedData(
-        n_raw=n_raw,
-        min_count=min_count,
-        freq_items=freq_items,
-        item_to_rank=item_to_rank,
-        item_counts=item_counts,
-        baskets=baskets,
-        weights=weights,
-    )
+def preprocess_file(
+    path: str, min_support: float, native: Optional[bool] = None
+) -> CompressedData:
+    """Phase-1 preprocessing straight from a ``D.dat`` file — the native
+    path parses the raw bytes without ever materializing Python token
+    lists (the reference's ingest+first-shuffle, Utils.scala:21 +
+    FastApriori.scala:52-85, as one C++ scan)."""
+    if _use_native(native, 1 << 62):  # file path: prefer native when built
+        from fastapriori_tpu.native.loader import preprocess_file as nat_file
+
+        return _native_result_to_data(nat_file(path, min_support))
+    from fastapriori_tpu.io.reader import read_dat
+
+    return _python_preprocess(read_dat(path), min_support)
 
 
 def dedup_user_baskets(

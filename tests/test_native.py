@@ -1,0 +1,107 @@
+"""Native C++ preprocessor vs the Python path: exact equality on the same
+inputs (tokenization, tie-breaks, dedup, CSR layout)."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.native import native_available
+from fastapriori_tpu.preprocess import preprocess, preprocess_file
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native extension not built"
+)
+
+
+def _assert_equal(a, b):
+    assert a.n_raw == b.n_raw
+    assert a.min_count == b.min_count
+    assert a.freq_items == b.freq_items
+    assert a.item_to_rank == b.item_to_rank
+    assert (a.item_counts == b.item_counts).all()
+    # Basket order may differ (Python dict order vs C++ first-seen — both
+    # are first-seen, but compare as a multiset to be robust).
+    got = {
+        tuple(x): int(w)
+        for x, w in zip(a.baskets, a.weights)
+    }
+    expected = {
+        tuple(x): int(w)
+        for x, w in zip(b.baskets, b.weights)
+    }
+    assert got == expected
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("min_support", [0.03, 0.1, 0.25])
+def test_native_matches_python_random(seed, min_support):
+    lines = tokenized(random_dataset(seed))
+    _assert_equal(
+        preprocess(lines, min_support, native=True),
+        preprocess(lines, min_support, native=False),
+    )
+
+
+def test_native_edge_tokens(tmp_path):
+    raw = (
+        "007 7 7 007\n"  # numeric ties with distinct tokens
+        "\n"  # empty line -> single empty token
+        "\t  x\t y  \n"  # tabs, non-numeric tokens
+        "-3 +4 -3\n"  # signed integers
+        "x 007 7\n"
+        "99999999999999999999999 1\n"  # > int64: lexical fallback
+        "99999999999999999999999 1"
+    )
+    p = tmp_path / "D.dat"
+    p.write_text(raw)
+    a = preprocess_file(str(p), 0.2, native=True)
+    b = preprocess_file(str(p), 0.2, native=False)
+    _assert_equal(a, b)
+
+
+def test_native_file_no_trailing_newline(tmp_path):
+    p = tmp_path / "D.dat"
+    p.write_text("1 2\n1 2\n1 3")
+    a = preprocess_file(str(p), 0.3, native=True)
+    b = preprocess_file(str(p), 0.3, native=False)
+    assert a.n_raw == 3
+    _assert_equal(a, b)
+
+
+def test_native_crlf(tmp_path):
+    p = tmp_path / "D.dat"
+    p.write_bytes(b"1 2\r\n1 2\r\n2 3\r\n")
+    a = preprocess_file(str(p), 0.3, native=True)
+    b = preprocess_file(str(p), 0.3, native=False)
+    _assert_equal(a, b)
+
+
+def test_native_empty_file(tmp_path):
+    p = tmp_path / "D.dat"
+    p.write_text("")
+    a = preprocess_file(str(p), 0.5, native=True)
+    assert a.n_raw == 0 and a.num_items == 0 and a.total_count == 0
+
+
+def test_native_large_weights():
+    # >128 duplicate baskets (two weight digits downstream) and >=2^15 rows.
+    lines = tokenized(["1 2 3"] * 300 + ["4 5"] * 2 + ["1 2"] * 40000)
+    _assert_equal(
+        preprocess(lines, 0.001, native=True),
+        preprocess(lines, 0.001, native=False),
+    )
+
+
+def test_miner_with_native_preprocess_end_to_end(tmp_path):
+    from fastapriori_tpu import oracle
+    from fastapriori_tpu.models.apriori import FastApriori
+
+    raw = random_dataset(9, n_txns=300)
+    p = tmp_path / "D.dat"
+    p.write_text("\n".join(raw) + "\n")
+    expected, _, _ = oracle.mine(tokenized(raw), 0.05)
+
+    miner = FastApriori(0.05, num_devices=1)
+    data = preprocess_file(str(p), 0.05, native=True)
+    got = miner.mine_compressed(data)
+    assert dict(got) == dict(expected)
