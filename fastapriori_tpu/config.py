@@ -32,3 +32,14 @@ class MinerConfig:
     num_devices: Optional[int] = None
     # Emit per-level structured metrics as JSON lines to stderr.
     log_metrics: bool = False
+    # Mining engine: "fused" = whole level loop as one on-device program
+    # (ops/fused.py), falling back to "level" (one kernel launch per level,
+    # host candidate generation) on row-budget overflow; "level" forces the
+    # per-level engine.
+    engine: str = "fused"
+    # Fused engine: static per-level frequent-set row budget (padded).
+    # Doubled up to fused_m_cap_max on overflow before falling back.
+    fused_m_cap: int = 4096
+    fused_m_cap_max: int = 32768
+    # Fused engine: max Apriori levels held in the output buffers.
+    fused_l_max: int = 24

@@ -19,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from fastapriori_tpu.config import MinerConfig
@@ -126,8 +128,69 @@ class FastApriori:
         f = data.num_items
         freq_itemsets: List[ItemsetWithCount] = []
         if f >= 2 and data.total_count > 0:
-            freq_itemsets = self._mine_levels(data)
+            if self.config.engine == "fused":
+                freq_itemsets = self._mine_fused(data)
+                if freq_itemsets is None:  # row budget exhausted
+                    self.metrics.emit("fused_fallback")
+                    freq_itemsets = self._mine_levels(data)
+            else:
+                freq_itemsets = self._mine_levels(data)
         return freq_itemsets + one_itemsets
+
+    # ------------------------------------------------------------------
+    def _mine_fused(
+        self, data: CompressedData
+    ) -> Optional[List[ItemsetWithCount]]:
+        """Whole-loop on-device engine (ops/fused.py): one dispatch mines
+        every level; retries with a doubled row budget on overflow, returns
+        None when the budget cap is exhausted (caller falls back)."""
+        from fastapriori_tpu.ops import fused
+
+        cfg = self.config
+        ctx = self.context
+        f = data.num_items
+
+        with self.metrics.timed("bitmap_pack") as m:
+            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices
+            bitmap_np = build_bitmap_csr(
+                data.basket_indices,
+                data.basket_offsets,
+                f,
+                txn_multiple,
+                cfg.item_tile,
+            )
+            packed_np = fused.pack_bitmap(bitmap_np)
+            t_pad = bitmap_np.shape[0]
+            w_np = np.zeros(t_pad, dtype=np.int32)
+            w_np[: data.total_count] = data.weights
+            max_w = int(data.weights.max()) if data.total_count else 1
+            n_digits = 1
+            while 128**n_digits <= max_w:
+                n_digits += 1
+            packed = jax.device_put(
+                packed_np, ctx.sharding_rows()
+            )
+            w = jax.device_put(w_np, ctx.sharding_vector())
+            m.update(shape=list(bitmap_np.shape), digits=n_digits)
+
+        m_cap = cfg.fused_m_cap
+        while m_cap <= cfg.fused_m_cap_max:
+            with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
+                fn = ctx.fused_miner(m_cap, cfg.fused_l_max, n_digits)
+                out_rows, out_cols, out_counts, out_n, incomplete = fn(
+                    packed, w, jnp.int32(data.min_count)
+                )
+                incomplete = bool(incomplete)
+                met.update(incomplete=incomplete)
+            if not incomplete:
+                return fused.decode_fused_result(
+                    np.asarray(out_rows),
+                    np.asarray(out_cols),
+                    np.asarray(out_counts),
+                    np.asarray(out_n),
+                )
+            m_cap *= 2
+        return None
 
     # ------------------------------------------------------------------
     def _mine_levels(self, data: CompressedData) -> List[ItemsetWithCount]:

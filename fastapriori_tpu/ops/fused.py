@@ -1,0 +1,251 @@
+"""Fully-fused on-device Apriori: the entire level loop as ONE XLA program
+(reference C6+C7+C8+C9 — FastApriori.scala:88-241 — without any per-level
+host round trip).
+
+The level-synchronous loop runs as a ``lax.while_loop`` on device.  Each
+iteration mines level k from the frequent (k-1)-set matrix
+``S ∈ {0,1}^{M_cap×F}`` (one row per frequent set, padded to a static row
+budget) using only matmuls:
+
+- **candidate generation as matmuls** (replaces the reference's driver-side
+  set algebra, FastApriori.scala:167-193): a pair ``(x, y)`` with
+  ``y > max(x)`` is a candidate iff ALL k-1 of the (k-1)-subsets of
+  ``x ∪ {y}`` containing y are frequent.  Those subsets are exactly the
+  frequent rows r with ``|r ∩ x| = k-2`` and ``y ∈ r``, so with
+  ``D = S Sᵀ`` and ``E = (D == k-2)``:  ``cand_cnt = E S`` counts them and
+  ``cand[x,y] = (cand_cnt[x,y] == k-1)``;
+- **support counting as matmuls** (replaces the per-candidate Boolean scans,
+  FastApriori.scala:140-157): ``common = (B Sᵀ == k-1)`` marks baskets
+  containing each prefix, ``counts = Σ_d 128^d (common ⊙ w_d)ᵀ B`` the
+  weighted supports of every extension, ``psum`` over the transaction mesh
+  axis;
+- **compaction**: survivors ``(row, col)`` via size-bounded ``jnp.nonzero``
+  into the next level's S.  The program returns only (row, col, count)
+  triples per level — the host reconstructs itemsets by chaining rows
+  through levels, so the device→host transfer is a few MB regardless of
+  bitmap size.
+
+The bitmap crosses host→device bit-packed (uint8, 8 items/byte — an 8x
+transfer saving) and is unpacked on device.
+
+Static row budget ``m_cap`` bounds the per-level frequent-set count; if a
+level overflows (or the loop exceeds ``l_max`` levels), the program reports
+it and the caller falls back (larger m_cap or the chunked level-at-a-time
+engine).  Termination rule is the reference's ``while (kItems.length >= k)``
+(FastApriori.scala:111).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "txn"
+
+
+def pack_bitmap(bitmap: np.ndarray) -> np.ndarray:
+    """Host-side bit packing along the item axis (MSB-first, matching
+    jnp unpack in ``_unpack``).  F must be a multiple of 8."""
+    assert bitmap.shape[1] % 8 == 0
+    return np.packbits(bitmap.astype(bool), axis=1)
+
+
+def _unpack(packed: jnp.ndarray) -> jnp.ndarray:
+    """[T, F//8] uint8 -> [T, F] int8 (MSB-first per byte)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[:, :, None] >> shifts[None, None, :]) & 1
+    return bits.reshape(packed.shape[0], packed.shape[1] * 8).astype(jnp.int8)
+
+
+def _weighted_counts(common, bitmap, w, n_digits: int):
+    """counts[m, f] = Σ_t w_t common[t, m] bitmap[t, f] via base-128 int8
+    digit matmuls (ops/bitmap.py weight_digits, but on device)."""
+    total = None
+    for d in range(n_digits):
+        w_d = ((w // (128**d)) % 128).astype(jnp.int8)
+        scaled = common * w_d[:, None]
+        part = lax.dot_general(
+            scaled,
+            bitmap,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        part = part if d == 0 else part * jnp.int32(128**d)
+        total = part if total is None else total + part
+    return total
+
+
+def _fused_mine_local(
+    packed,  # [T_local, F//8] uint8
+    w,  # [T_local] int32
+    min_count,  # scalar int32
+    *,
+    m_cap: int,
+    l_max: int,
+    n_digits: int,
+    axis_name: Optional[str],
+):
+    f = packed.shape[1] * 8
+    bitmap = _unpack(packed)  # [T, F] int8, stays in HBM
+    col_ids = jnp.arange(f, dtype=jnp.int32)
+
+    def psum(x):
+        return lax.psum(x, axis_name) if axis_name is not None else x
+
+    # ---- level 2: weighted Gram matmul (C6) ---------------------------
+    pair = psum(
+        _weighted_counts(bitmap, bitmap, w, n_digits)
+    )  # [F, F] int32
+    mask2 = (pair >= min_count) & (col_ids[None, :] > col_ids[:, None])
+    n2 = jnp.sum(mask2, dtype=jnp.int32)
+    r2, c2 = jnp.nonzero(mask2, size=m_cap, fill_value=0)
+    valid2 = (jnp.arange(m_cap, dtype=jnp.int32) < n2)[:, None]
+    s2 = (
+        (jax.nn.one_hot(r2, f, dtype=jnp.int8)
+         | jax.nn.one_hot(c2, f, dtype=jnp.int8))
+        * valid2.astype(jnp.int8)
+    )
+    counts2 = pair[r2, c2] * valid2[:, 0].astype(jnp.int32)
+
+    out_rows = jnp.zeros((l_max, m_cap), dtype=jnp.int32).at[0].set(r2)
+    out_cols = jnp.zeros((l_max, m_cap), dtype=jnp.int32).at[0].set(c2)
+    out_counts = jnp.zeros((l_max, m_cap), dtype=jnp.int32).at[0].set(counts2)
+    out_n = jnp.zeros((l_max,), dtype=jnp.int32).at[0].set(n2)
+    overflow = n2 > m_cap
+
+    # ---- levels >= 3 (C7 + C8 + C9) -----------------------------------
+    def cond(state):
+        s, m, k, *_rest, ovf = state
+        return (~ovf) & (m >= k) & (k <= l_max + 1)
+
+    def body(state):
+        s, m, k, o_rows, o_cols, o_counts, o_n, ovf = state
+        valid_row = (jnp.arange(m_cap, dtype=jnp.int32) < m)[:, None]
+
+        # Candidate generation: E = (S Sᵀ == k-2); cand_cnt = E S.
+        d_mat = lax.dot_general(
+            s, s, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [M, M] pairwise intersection sizes
+        e_mat = (d_mat == (k - 2)).astype(jnp.int8)
+        cand_cnt = lax.dot_general(
+            e_mat, s, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [M, F]
+        rowmax = jnp.max(
+            jnp.where(s > 0, col_ids[None, :], -1), axis=1
+        )  # [M] int32
+        cand = (
+            (cand_cnt == (k - 1))
+            & (col_ids[None, :] > rowmax[:, None])
+            & valid_row
+        )
+
+        # Support counting: common = (B Sᵀ == k-1); weighted matmul; psum.
+        overlap = lax.dot_general(
+            bitmap, s, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [T, M]
+        common = (overlap == (k - 1)).astype(jnp.int8)
+        counts = psum(_weighted_counts(common, bitmap, w, n_digits))
+
+        surv = cand & (counts >= min_count)
+        n = jnp.sum(surv, dtype=jnp.int32)
+        rows, cols = jnp.nonzero(surv, size=m_cap, fill_value=0)
+        valid = (jnp.arange(m_cap, dtype=jnp.int32) < n)[:, None]
+        s_next = (
+            (s[rows] | jax.nn.one_hot(cols, f, dtype=jnp.int8))
+            * valid.astype(jnp.int8)
+        )
+        level_counts = counts[rows, cols] * valid[:, 0].astype(jnp.int32)
+
+        idx = k - 2  # level k stored at slot k-2 (level 2 is slot 0)
+        o_rows = o_rows.at[idx].set(rows)
+        o_cols = o_cols.at[idx].set(cols)
+        o_counts = o_counts.at[idx].set(level_counts)
+        o_n = o_n.at[idx].set(n)
+        ovf = ovf | (n > m_cap)
+        return (s_next, n, k + 1, o_rows, o_cols, o_counts, o_n, ovf)
+
+    state = (
+        s2,
+        n2,
+        jnp.int32(3),
+        out_rows,
+        out_cols,
+        out_counts,
+        out_n,
+        overflow,
+    )
+    s, m, k, out_rows, out_cols, out_counts, out_n, overflow = (
+        lax.while_loop(cond, body, state)
+    )
+    # incomplete: loop stopped by the l_max bound while still converging.
+    incomplete = overflow | ((m >= k) & (k > l_max + 1))
+    return out_rows, out_cols, out_counts, out_n, incomplete
+
+
+def make_fused_miner(
+    mesh: Optional[Mesh],
+    m_cap: int,
+    l_max: int,
+    n_digits: int,
+):
+    """Build the jitted fused mining program.  With a mesh, the bitmap and
+    weights are sharded over the txn axis inside shard_map (psum
+    reductions); without one, a plain single-device jit."""
+    kernel = functools.partial(
+        _fused_mine_local,
+        m_cap=m_cap,
+        l_max=l_max,
+        n_digits=n_digits,
+        axis_name=AXIS if mesh is not None else None,
+    )
+    if mesh is None:
+        return jax.jit(kernel)
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+        )
+    )
+
+
+def decode_fused_result(
+    out_rows: np.ndarray,
+    out_cols: np.ndarray,
+    out_counts: np.ndarray,
+    out_n: np.ndarray,
+) -> list:
+    """Host-side reconstruction: chain (row, col) through levels.
+    Level 2's rows/cols are item ranks; level k's row indexes the previous
+    level's survivor list.  Returns [(frozenset, count), ...] in level
+    order (the order the reference appends, FastApriori.scala:105,116)."""
+    out = []
+    prev: list = []
+    for lvl in range(len(out_n)):
+        n = int(out_n[lvl])
+        if n == 0:
+            break
+        cur = []
+        rows, cols, counts = out_rows[lvl], out_cols[lvl], out_counts[lvl]
+        if lvl == 0:
+            for i in range(n):
+                s = frozenset((int(rows[i]), int(cols[i])))
+                cur.append(s)
+                out.append((s, int(counts[i])))
+        else:
+            for i in range(n):
+                s = prev[int(rows[i])] | {int(cols[i])}
+                cur.append(s)
+                out.append((s, int(counts[i])))
+        prev = cur
+    return out

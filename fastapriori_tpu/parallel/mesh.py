@@ -79,6 +79,25 @@ class DeviceContext:
         the txn axis."""
         return jax.device_put(x, NamedSharding(self.mesh, P(AXIS)))
 
+    def sharding_rows(self) -> NamedSharding:
+        """Sharding for 2-D arrays with rows on the txn axis."""
+        return NamedSharding(self.mesh, P(AXIS, None))
+
+    def sharding_vector(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(AXIS))
+
+    def fused_miner(self, m_cap: int, l_max: int, n_digits: int):
+        """Jitted whole-loop mining program (ops/fused.py), cached per
+        static configuration."""
+        key = ("fused", m_cap, l_max, n_digits)
+        if key not in self._fns:
+            from fastapriori_tpu.ops.fused import make_fused_miner
+
+            self._fns[key] = make_fused_miner(
+                self.mesh, m_cap, l_max, n_digits
+            )
+        return self._fns[key]
+
     def replicate(self, x: np.ndarray) -> jax.Array:
         spec = P(*([None] * x.ndim))
         return jax.device_put(x, NamedSharding(self.mesh, spec))

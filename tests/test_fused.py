@@ -1,0 +1,91 @@
+"""Fused whole-loop engine (ops/fused.py) vs the per-level engine and the
+oracle, including overflow retry and multi-device equality."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu import oracle
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.ops.fused import pack_bitmap
+
+
+def _mine(lines, min_support, **cfg_kwargs):
+    cfg = MinerConfig(min_support=min_support, **cfg_kwargs)
+    got, _, _ = FastApriori(config=cfg).run(lines)
+    return got
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("min_support", [0.05, 0.15])
+def test_fused_matches_level_engine(seed, min_support):
+    lines = tokenized(random_dataset(seed, n_txns=120))
+    fused = _mine(lines, min_support, engine="fused", num_devices=1)
+    level = _mine(lines, min_support, engine="level", num_devices=1)
+    assert dict(fused) == dict(level)
+    assert len(fused) == len(level)
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_fused_matches_oracle_deep_levels(n_devices):
+    lines = tokenized(
+        ["1 2 3 4 5 6"] * 12
+        + ["1 2 3 4 5"] * 6
+        + ["2 3 4 5 6"] * 6
+        + ["7 8"] * 4
+        + ["9", "1 7"]
+    )
+    expected, _, _ = oracle.mine(lines, 0.15)
+    got = _mine(lines, 0.15, engine="fused", num_devices=n_devices)
+    assert dict(got) == dict(expected)
+    assert max(len(s) for s, _ in got) >= 5
+
+
+def test_fused_overflow_retries_then_succeeds():
+    # Tiny m_cap forces the doubling retry path; result must still be exact.
+    lines = tokenized(random_dataset(3, n_txns=100))
+    expected, _, _ = oracle.mine(lines, 0.05)
+    got = _mine(
+        lines, 0.05, engine="fused", num_devices=1,
+        fused_m_cap=4, fused_m_cap_max=8192,
+    )
+    assert dict(got) == dict(expected)
+
+
+def test_fused_falls_back_to_level_engine():
+    # m_cap capped too low for the data -> must fall back and stay exact.
+    lines = tokenized(random_dataset(3, n_txns=100))
+    expected, _, _ = oracle.mine(lines, 0.05)
+    got = _mine(
+        lines, 0.05, engine="fused", num_devices=1,
+        fused_m_cap=4, fused_m_cap_max=4,
+    )
+    assert dict(got) == dict(expected)
+
+
+def test_fused_l_max_exceeded_falls_back():
+    # 6-deep itemset lattice with l_max=3 -> incomplete -> fallback path.
+    lines = tokenized(["1 2 3 4 5 6 7"] * 10 + ["8 9"] * 2)
+    expected, _, _ = oracle.mine(lines, 0.5)
+    got = _mine(
+        lines, 0.5, engine="fused", num_devices=1,
+        fused_l_max=3, fused_m_cap_max=8192,
+    )
+    assert dict(got) == dict(expected)
+
+
+def test_pack_bitmap_roundtrip():
+    rng = np.random.default_rng(0)
+    b = (rng.random((16, 256)) < 0.3).astype(np.int8)
+    packed = pack_bitmap(b)
+    assert packed.shape == (16, 32)
+    assert (np.unpackbits(packed, axis=1) == b).all()
+
+
+def test_fused_weighted_digits():
+    # >128 duplicate baskets exercise the on-device two-digit path.
+    lines = tokenized(["1 2 3"] * 300 + ["4 5"] * 10 + ["1 2"] * 50)
+    expected, _, _ = oracle.mine(lines, 0.05)
+    got = _mine(lines, 0.05, engine="fused", num_devices=1)
+    assert dict(got) == dict(expected)

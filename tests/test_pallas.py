@@ -1,0 +1,84 @@
+"""Pallas fused counting kernel vs the plain jnp formulation (interpret
+mode on CPU; the same kernel runs compiled on TPU)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from fastapriori_tpu.ops.pallas_level import (
+    M_TILE,
+    T_TILE,
+    level_counts_pallas,
+)
+
+
+def _case(seed, t, m, f, k, max_w=5, n_digits=1):
+    rng = np.random.default_rng(seed)
+    bitmap = (rng.random((t, f)) < 0.2).astype(np.int8)
+    s = np.zeros((m, f), dtype=np.int8)
+    # valid prefix rows of size k-1
+    for i in range(m // 2):
+        cols = rng.choice(f, size=k - 1, replace=False)
+        s[i, cols] = 1
+    w = rng.integers(1, max_w + 1, size=t).astype(np.int64)
+    digits = []
+    rem = w.copy()
+    for _ in range(n_digits):
+        digits.append((rem % 128).astype(np.int8))
+        rem //= 128
+    assert (rem == 0).all()
+    w_digits = np.stack(digits)
+    return bitmap, w, w_digits, s
+
+
+def _expected(bitmap, w, s, k):
+    overlap = bitmap.astype(np.int64) @ s.astype(np.int64).T  # [T, M]
+    common = overlap == (k - 1)
+    return ((common * w[:, None]).T @ bitmap.astype(np.int64)).astype(
+        np.int64
+    )
+
+
+@pytest.mark.parametrize("k", [2, 3, 5])
+def test_pallas_level_counts_interpret(k):
+    bitmap, w, w_digits, s = _case(0, T_TILE * 2, M_TILE, 256, k)
+    got = np.asarray(
+        level_counts_pallas(
+            jnp.asarray(bitmap),
+            jnp.asarray(w_digits),
+            jnp.asarray(s),
+            jnp.int32(k - 1),
+            interpret=True,
+        )
+    )
+    assert (got == _expected(bitmap, w, s, k)).all()
+
+
+def test_pallas_level_counts_two_digits():
+    bitmap, w, w_digits, s = _case(
+        1, T_TILE, M_TILE, 128, 3, max_w=300, n_digits=2
+    )
+    got = np.asarray(
+        level_counts_pallas(
+            jnp.asarray(bitmap),
+            jnp.asarray(w_digits),
+            jnp.asarray(s),
+            jnp.int32(2),
+            interpret=True,
+        )
+    )
+    assert (got == _expected(bitmap, w, s, 3)).all()
+
+
+def test_pallas_multiple_m_tiles():
+    bitmap, w, w_digits, s = _case(2, T_TILE, M_TILE * 2, 128, 3)
+    got = np.asarray(
+        level_counts_pallas(
+            jnp.asarray(bitmap),
+            jnp.asarray(w_digits),
+            jnp.asarray(s),
+            jnp.int32(2),
+            interpret=True,
+        )
+    )
+    assert (got == _expected(bitmap, w, s, 3)).all()
