@@ -77,7 +77,7 @@ def reference_style_mine(lines, min_support):
     return out
 
 
-def main(argv=None) -> int:
+def _parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-txns", type=int, default=100_000)
     ap.add_argument("--min-support", type=float, default=0.01)
@@ -87,7 +87,72 @@ def main(argv=None) -> int:
         action="store_true",
         help="skip the reference-style numpy baseline (vs_baseline=0)",
     )
-    args = ap.parse_args(argv)
+    ap.add_argument(
+        "--engine",
+        choices=["auto", "fused", "level"],
+        default="auto",
+        help="auto = try the fused engine in a time-boxed subprocess, "
+        "fall back to the per-level engine if it fails",
+    )
+    ap.add_argument(
+        "--fused-budget-s",
+        type=float,
+        default=420.0,
+        help="auto mode: wall-clock budget for the fused attempt",
+    )
+    return ap
+
+
+def _orchestrate(args) -> int:
+    """auto mode: run the fused engine in a subprocess with a wall-clock
+    budget (first compile of the whole-loop program can be slow on some
+    backends); if it produces no result line, rerun with the per-level
+    engine.  Guarantees exactly one JSON line on stdout."""
+    import subprocess
+
+    base = [
+        sys.executable,
+        __file__,
+        "--n-txns", str(args.n_txns),
+        "--min-support", str(args.min_support),
+        "--seed", str(args.seed),
+    ] + (["--skip-baseline"] if args.skip_baseline else [])
+    for engine, timeout in (
+        ("fused", args.fused_budget_s),
+        ("level", None),
+    ):
+        try:
+            proc = subprocess.run(
+                base + ["--engine", engine],
+                stdout=subprocess.PIPE,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"engine={engine} exceeded {timeout}s budget; falling back",
+                file=sys.stderr,
+            )
+            continue
+        out = proc.stdout.decode()
+        line = next(
+            (l for l in out.splitlines() if l.startswith("{")), None
+        )
+        if proc.returncode == 0 and line:
+            print(line)
+            return 0
+        print(
+            f"engine={engine} failed (rc={proc.returncode}); falling back",
+            file=sys.stderr,
+        )
+    print(json.dumps({"metric": "bench_failed", "value": 0,
+                      "unit": "txns/sec", "vs_baseline": 0}))
+    return 1
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    if args.engine == "auto":
+        return _orchestrate(args)
 
     import tempfile
 
@@ -110,7 +175,13 @@ def main(argv=None) -> int:
     # Cold run (includes jit compiles), then warm run for the steady rate.
     # run_file = ingest straight from disk (native C++ scan when built),
     # matching the reference's from-HDFS measurement boundary.
-    miner = FastApriori(args.min_support)
+    from fastapriori_tpu.config import MinerConfig
+
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=args.min_support, engine=args.engine
+        )
+    )
     t0 = time.perf_counter()
     result_cold, _, _ = miner.run_file(d_file.name)
     cold = time.perf_counter() - t0

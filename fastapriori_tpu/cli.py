@@ -56,6 +56,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="devices in the mesh (default: all visible)",
     )
     p.add_argument(
+        "--engine",
+        choices=["fused", "level"],
+        default="fused",
+        help="mining engine: fused = whole level loop as one device "
+        "program; level = one kernel launch per level",
+    )
+    p.add_argument(
+        "--distributed",
+        action="store_true",
+        help="call jax.distributed.initialize() first (multi-host mesh "
+        "over ICI/DCN; the analog of standing up the Spark cluster)",
+    )
+    p.add_argument(
         "--metrics",
         action="store_true",
         help="emit structured JSON metrics to stderr",
@@ -88,7 +101,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         min_support=args.min_support,
         num_devices=args.num_devices,
         log_metrics=args.metrics,
+        engine=args.engine,
     )
+    if args.distributed:
+        from fastapriori_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed()
 
     # Imports deferred so --help works without initializing a backend.
     from fastapriori_tpu.models.apriori import FastApriori
