@@ -1,20 +1,28 @@
 """Apriori candidate generation (reference C7, FastApriori.scala:167-193).
 
 Host-side: the candidate table is tiny next to counting (SURVEY.md §2 C7).
-Semantics reproduced exactly:
 
-- extensions of a frequent (k-1)-set ``x`` are drawn from ranks
-  ``max(x)+1 .. F-1`` not in ``x`` (ordered-extension dedup, :176-177);
-- classic Apriori prune: extension ``y`` survives iff for EVERY element
-  ``e`` of ``x``, ``(x - {e}) ∪ {y}`` is a frequent (k-1)-set (:181-188 —
-  the reference's early exit when the candidate set empties does not change
-  the result, the prune conditions are order-independent);
-- prefixes with no surviving extension are dropped (:190).
+The reference enumerates every rank in ``max(x)+1 .. F-1`` per frequent
+set and prunes by hashed subset lookups — O(M·F·k).  Here the same
+candidate set is produced by the classic prefix join: two frequent
+(k-1)-sets sharing their first k-2 sorted elements join into a candidate
+``c = x ∪ {y}`` (``x`` = c minus its largest element, ``y = max(c)``), and
+the remaining k-2 subsets of ``c`` are verified by hash lookup —
+O(M·log M + candidates·k).
+
+Equivalence to the reference's rule (:176-188): a pair ``(x, y)`` with
+``y > max(x)`` survives the reference's prune iff every (k-1)-subset of
+``x ∪ {y}`` is frequent.  The join supplies two of those subsets
+(``c - y = x`` and ``c - e`` where e is x's largest element) and the
+explicit checks cover the rest, so the surviving set is identical.  The
+per-prefix extension lists are returned sorted ascending; prefixes with no
+surviving extension are dropped (:190).
 """
 
 from __future__ import annotations
 
-from typing import FrozenSet, List, Sequence, Tuple
+from collections import defaultdict
+from typing import Dict, FrozenSet, List, Sequence, Tuple
 
 Prefix = Tuple[int, ...]  # sorted ranks
 
@@ -23,15 +31,33 @@ def gen_candidates(
     k_items: Sequence[FrozenSet[int]], num_items: int
 ) -> List[Tuple[Prefix, List[int]]]:
     """Return ``(sorted prefix, sorted surviving extensions)`` per prefix."""
-    k_set = frozenset(k_items)
-    out: List[Tuple[Prefix, List[int]]] = []
-    for x in k_items:
-        cands = set(range(max(x) + 1, num_items)) - x
-        for elem in x:
-            if not cands:
-                break
-            sub = x - {elem}
-            cands = {y for y in cands if (sub | {y}) in k_set}
-        if cands:
-            out.append((tuple(sorted(x)), sorted(cands)))
-    return out
+    if not k_items:
+        return []
+    tuples = sorted(tuple(sorted(x)) for x in k_items)
+    k_set = set(tuples)
+    s = len(tuples[0])  # = k-1
+
+    by_prefix: Dict[Prefix, List[Tuple[int, ...]]] = defaultdict(list)
+    for t in tuples:
+        by_prefix[t[:-1]].append(t)
+
+    out: Dict[Prefix, List[int]] = defaultdict(list)
+    for shared, group in by_prefix.items():
+        # group is sorted by last element (tuples were globally sorted).
+        n = len(group)
+        for i in range(n - 1):
+            x = group[i]
+            for j in range(i + 1, n):
+                y = group[j][-1]
+                c = x + (y,)
+                # Verify the k-2 subsets dropping a shared-prefix element
+                # (dropping x's last element gives group[j], frequent by
+                # construction; dropping y gives x itself).
+                ok = True
+                for d in range(s - 1):
+                    if c[:d] + c[d + 1 :] not in k_set:
+                        ok = False
+                        break
+                if ok:
+                    out[x].append(y)
+    return [(x, ys) for x, ys in out.items()]  # ys ascending by construction
