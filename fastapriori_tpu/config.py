@@ -43,3 +43,7 @@ class MinerConfig:
     fused_m_cap_max: int = 32768
     # Fused engine: max Apriori levels held in the output buffers.
     fused_l_max: int = 24
+    # Fused engine: per-device transaction-chunk target — bounds the
+    # [chunk, m_cap] containment intermediate in HBM (the scan over chunks
+    # accumulates counts).
+    fused_txn_chunk: int = 1 << 17

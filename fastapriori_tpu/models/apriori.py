@@ -151,7 +151,12 @@ class FastApriori:
         f = data.num_items
 
         with self.metrics.timed("bitmap_pack") as m:
-            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices
+            # Per-device rows split into n_chunks equal scan chunks; pad the
+            # transaction axis to n_devices * n_chunks * 32.
+            t0 = len(data.weights)
+            per_dev = -(-t0 // ctx.n_devices)
+            n_chunks = max(1, -(-per_dev // cfg.fused_txn_chunk))
+            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices * n_chunks
             bitmap_np = build_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
@@ -176,7 +181,9 @@ class FastApriori:
         m_cap = cfg.fused_m_cap
         while m_cap <= cfg.fused_m_cap_max:
             with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
-                fn = ctx.fused_miner(m_cap, cfg.fused_l_max, n_digits)
+                fn = ctx.fused_miner(
+                    m_cap, cfg.fused_l_max, n_digits, n_chunks
+                )
                 out_rows, out_cols, out_counts, out_n, incomplete = fn(
                     packed, w, jnp.int32(data.min_count)
                 )

@@ -89,19 +89,43 @@ def _fused_mine_local(
     m_cap: int,
     l_max: int,
     n_digits: int,
+    n_chunks: int,
     axis_name: Optional[str],
 ):
     f = packed.shape[1] * 8
-    bitmap = _unpack(packed)  # [T, F] int8, stays in HBM
+    t_local = packed.shape[0]
+    assert t_local % n_chunks == 0, (t_local, n_chunks)
+    t_c = t_local // n_chunks
+    # Transaction chunking bounds the [T_c, M] `common` intermediate so
+    # HBM never holds a full [T, M] matrix at Webdocs scale; the scan
+    # accumulates the int32 count matrix across chunks.  The bitmap itself
+    # stays bit-packed in HBM — each chunk is unpacked transiently on the
+    # VPU, an 8x resident-memory saving.
+    packed_c = packed.reshape(n_chunks, t_c, packed.shape[1])
+    w_c = w.reshape(n_chunks, t_c)
     col_ids = jnp.arange(f, dtype=jnp.int32)
 
     def psum(x):
         return lax.psum(x, axis_name) if axis_name is not None else x
 
+    def scan_counts(project, out_dim):
+        """Σ over chunks of _weighted_counts(project(B_chunk), B_chunk)."""
+
+        def step(acc, xs):
+            pk, wk = xs
+            b = _unpack(pk)
+            return acc + _weighted_counts(project(b), b, wk, n_digits), None
+
+        acc0 = jnp.zeros((out_dim, f), dtype=jnp.int32)
+        if axis_name is not None:
+            # Mark the carry as device-varying over the mesh axis (each
+            # shard accumulates its own partial sums; psum comes later).
+            acc0 = lax.pcast(acc0, (axis_name,), to="varying")
+        acc, _ = lax.scan(step, acc0, (packed_c, w_c))
+        return acc
+
     # ---- level 2: weighted Gram matmul (C6) ---------------------------
-    pair = psum(
-        _weighted_counts(bitmap, bitmap, w, n_digits)
-    )  # [F, F] int32
+    pair = psum(scan_counts(lambda b: b, f))  # [F, F] int32
     mask2 = (pair >= min_count) & (col_ids[None, :] > col_ids[:, None])
     n2 = jnp.sum(mask2, dtype=jnp.int32)
     r2, c2 = jnp.nonzero(mask2, size=m_cap, fill_value=0)
@@ -148,12 +172,14 @@ def _fused_mine_local(
         )
 
         # Support counting: common = (B Sᵀ == k-1); weighted matmul; psum.
-        overlap = lax.dot_general(
-            bitmap, s, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # [T, M]
-        common = (overlap == (k - 1)).astype(jnp.int8)
-        counts = psum(_weighted_counts(common, bitmap, w, n_digits))
+        def contains_prefix(b):
+            overlap = lax.dot_general(
+                b, s, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [T_c, M]
+            return (overlap == (k - 1)).astype(jnp.int8)
+
+        counts = psum(scan_counts(contains_prefix, m_cap))
 
         surv = cand & (counts >= min_count)
         n = jnp.sum(surv, dtype=jnp.int32)
@@ -196,6 +222,7 @@ def make_fused_miner(
     m_cap: int,
     l_max: int,
     n_digits: int,
+    n_chunks: int = 1,
 ):
     """Build the jitted fused mining program.  With a mesh, the bitmap and
     weights are sharded over the txn axis inside shard_map (psum
@@ -205,6 +232,7 @@ def make_fused_miner(
         m_cap=m_cap,
         l_max=l_max,
         n_digits=n_digits,
+        n_chunks=n_chunks,
         axis_name=AXIS if mesh is not None else None,
     )
     if mesh is None:

@@ -86,15 +86,17 @@ class DeviceContext:
     def sharding_vector(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(AXIS))
 
-    def fused_miner(self, m_cap: int, l_max: int, n_digits: int):
+    def fused_miner(
+        self, m_cap: int, l_max: int, n_digits: int, n_chunks: int = 1
+    ):
         """Jitted whole-loop mining program (ops/fused.py), cached per
         static configuration."""
-        key = ("fused", m_cap, l_max, n_digits)
+        key = ("fused", m_cap, l_max, n_digits, n_chunks)
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import make_fused_miner
 
             self._fns[key] = make_fused_miner(
-                self.mesh, m_cap, l_max, n_digits
+                self.mesh, m_cap, l_max, n_digits, n_chunks
             )
         return self._fns[key]
 

@@ -75,6 +75,18 @@ def test_fused_l_max_exceeded_falls_back():
     assert dict(got) == dict(expected)
 
 
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_fused_txn_chunked_scan(n_devices):
+    # Tiny chunk target forces the multi-chunk scan path on every device.
+    lines = tokenized(random_dataset(11, n_txns=200))
+    expected, _, _ = oracle.mine(lines, 0.05)
+    got = _mine(
+        lines, 0.05, engine="fused", num_devices=n_devices,
+        fused_txn_chunk=8,
+    )
+    assert dict(got) == dict(expected)
+
+
 def test_pack_bitmap_roundtrip():
     rng = np.random.default_rng(0)
     b = (rng.random((16, 256)) < 0.3).astype(np.int8)
