@@ -77,11 +77,49 @@ def reference_style_mine(lines, min_support):
     return out
 
 
+# Synthetic stand-ins for the BASELINE.md configs (shape parameters follow
+# the public dataset statistics; data itself is generated — zero egress).
+CONFIGS = {
+    # dataset-style: (n_txns, n_items, avg_txn_len, min_support)
+    "t10i4d100k": (100_000, 1_000, 10, 0.01),
+    "retail": (88_000, 16_000, 10, 0.005),
+    "kosarak": (990_000, 41_000, 8, 0.002),
+    "webdocs-small": (200_000, 50_000, 177, 0.1),
+    "webdocs": (1_700_000, 50_000, 177, 0.1),
+}
+
+
 def _parser():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--n-txns", type=int, default=100_000)
-    ap.add_argument("--min-support", type=float, default=0.01)
+    ap.add_argument(
+        "--config",
+        choices=sorted(CONFIGS),
+        default="t10i4d100k",
+        help="synthetic dataset preset (BASELINE.md configs)",
+    )
+    ap.add_argument("--n-txns", type=int, default=None)
+    ap.add_argument("--min-support", type=float, default=None)
     ap.add_argument("--seed", type=int, default=2017)
+    ap.add_argument(
+        "--workload",
+        choices=["mine", "recommend"],
+        default="mine",
+        help="mine = frequent-itemset mining; recommend = end-to-end "
+        "rules + per-user recommendation (BASELINE.md config 5)",
+    )
+    ap.add_argument(
+        "--platform",
+        choices=["default", "cpu"],
+        default="default",
+        help="force the JAX platform in-process (env vars are unreliable "
+        "when a hardware plugin self-registers at interpreter start)",
+    )
+    ap.add_argument(
+        "--scaling",
+        action="store_true",
+        help="also report mining wall time on 1/2/4/8-device virtual CPU "
+        "meshes to stderr (functional scaling check, not real-chip perf)",
+    )
     ap.add_argument(
         "--skip-baseline",
         action="store_true",
@@ -113,9 +151,12 @@ def _orchestrate(args) -> int:
     base = [
         sys.executable,
         __file__,
+        "--config", args.config,
         "--n-txns", str(args.n_txns),
         "--min-support", str(args.min_support),
         "--seed", str(args.seed),
+        "--workload", args.workload,
+        "--platform", args.platform,
     ] + (["--skip-baseline"] if args.skip_baseline else [])
     for engine, timeout in (
         ("fused", args.fused_budget_s),
@@ -149,8 +190,120 @@ def _orchestrate(args) -> int:
     return 1
 
 
+def _recommend_workload(args, raw, d_path) -> int:
+    """BASELINE.md config 5: end-to-end rules + per-user recommendation
+    (mirrors the reference's phase 2, AssociationRules.scala)."""
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.io.reader import tokenize_line
+    from fastapriori_tpu.models.apriori import FastApriori
+    from fastapriori_tpu.models.recommender import AssociationRules
+    from fastapriori_tpu.utils.datagen import generate_user_baskets
+
+    n_users = max(1000, args.n_txns // 10)
+    u_lines = [
+        tokenize_line(l)
+        for l in generate_user_baskets(
+            n_users=n_users, n_items=args.n_items, seed=args.seed + 1
+        )
+    ]
+    cfg = MinerConfig(
+        min_support=args.min_support,
+        engine=args.engine if args.engine != "auto" else "fused",
+    )
+    miner = FastApriori(config=cfg)
+    itemsets, item_to_rank, freq_items = miner.run_file(d_path)
+    rec = AssociationRules(
+        itemsets, freq_items, item_to_rank, config=cfg,
+        context=miner.context,
+    )
+    rec.run(u_lines[:128])  # warm the containment kernel
+    t0 = time.perf_counter()
+    out = rec.run(u_lines)
+    wall = time.perf_counter() - t0
+    assert len(out) == n_users
+    print(
+        f"recommend: {n_users} users in {wall:.2f}s "
+        f"({len(itemsets)} itemsets)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"users_per_sec_recommend_{args.config}",
+                "value": round(n_users / wall, 1),
+                "unit": "users/sec",
+                "vs_baseline": 0.0,
+            }
+        )
+    )
+    return 0
+
+
+_SCALING_CHILD = """
+import jax, sys, time
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(sys.argv[2]))
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.models.apriori import FastApriori
+cfg = MinerConfig(min_support=float(sys.argv[3]), num_devices=int(sys.argv[2]))
+m = FastApriori(config=cfg)
+m.run_file(sys.argv[1]); t0 = time.perf_counter(); m.run_file(sys.argv[1])
+print(time.perf_counter() - t0)
+"""
+
+
+def _scaling_report(args) -> None:
+    """Mining wall time on 1/2/4/8-device virtual CPU meshes — validates
+    that the sharded path scales functionally (BASELINE.md scaling row;
+    real-chip efficiency needs real chips)."""
+    import subprocess
+    import tempfile
+
+    from fastapriori_tpu.utils.datagen import generate_transactions
+
+    raw = generate_transactions(
+        n_txns=min(args.n_txns, 50_000),
+        n_items=args.n_items,
+        avg_txn_len=args.avg_len,
+        seed=args.seed,
+    )
+    f = tempfile.NamedTemporaryFile(mode="w", suffix=".dat", delete=False)
+    f.write("\n".join(raw) + "\n")
+    f.close()
+    times = {}
+    for n in (1, 2, 4, 8):
+        proc = subprocess.run(
+            [sys.executable, "-c", _SCALING_CHILD, f.name, str(n),
+             str(args.min_support)],
+            capture_output=True,
+            timeout=1800,
+        )
+        out = proc.stdout.decode().strip().splitlines()
+        times[n] = float(out[-1]) if proc.returncode == 0 and out else None
+    base = times.get(1)
+    for n, t in times.items():
+        eff = base / (t * n) if base and t else float("nan")
+        print(
+            f"scaling[virtual-cpu] n={n}: {t if t else float('nan'):.2f}s "
+            f"efficiency={eff:.2f}",
+            file=sys.stderr,
+        )
+
+
 def main(argv=None) -> int:
     args = _parser().parse_args(argv)
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    n_txns, n_items, avg_len, min_support = CONFIGS[args.config]
+    args.n_txns = args.n_txns if args.n_txns is not None else n_txns
+    args.min_support = (
+        args.min_support if args.min_support is not None else min_support
+    )
+    args.n_items, args.avg_len = n_items, avg_len
+    if args.scaling:
+        _scaling_report(args)
     if args.engine == "auto":
         return _orchestrate(args)
 
@@ -161,16 +314,24 @@ def main(argv=None) -> int:
     from fastapriori_tpu.utils.datagen import generate_transactions
 
     t0 = time.perf_counter()
-    raw = generate_transactions(n_txns=args.n_txns, seed=args.seed)
+    raw = generate_transactions(
+        n_txns=args.n_txns,
+        n_items=args.n_items,
+        avg_txn_len=args.avg_len,
+        seed=args.seed,
+    )
     d_file = tempfile.NamedTemporaryFile(
         mode="w", suffix=".dat", delete=False
     )
     d_file.write("\n".join(raw) + "\n")
     d_file.close()
     print(
-        f"datagen: {args.n_txns} txns in {time.perf_counter()-t0:.1f}s",
+        f"datagen [{args.config}]: {args.n_txns} txns in "
+        f"{time.perf_counter()-t0:.1f}s",
         file=sys.stderr,
     )
+    if args.workload == "recommend":
+        return _recommend_workload(args, raw, d_file.name)
 
     # Cold run (includes jit compiles), then warm run for the steady rate.
     # run_file = ingest straight from disk (native C++ scan when built),

@@ -178,11 +178,31 @@ class FastApriori:
             w = jax.device_put(w_np, ctx.sharding_vector())
             m.update(shape=list(bitmap_np.shape), digits=n_digits)
 
-        m_cap = cfg.fused_m_cap
+        # CPU backends: run the counting matmuls in f32 (BLAS path) when
+        # every partial sum provably fits f32's exact-integer range; TPU
+        # always uses the int8 MXU path (ops/fused.py _weighted_counts).
+        fast_f32 = ctx.platform == "cpu" and 127 * t_pad < 2**24
+
+        # Size the row budget from the actual level-2 survivor count (a
+        # one-matmul pre-pass over the already-uploaded packed bitmap)
+        # instead of guessing; the overflow retry still covers levels that
+        # outgrow 2x the pair count.
+        with self.metrics.timed("pair_prepass") as met:
+            n2 = int(
+                ctx.pair_counter(n_digits, n_chunks, fast_f32)(
+                    packed, w, jnp.int32(data.min_count)
+                )
+            )
+            met.update(n2=n2)
+        m_cap = min(
+            max(_next_pow2(2 * max(n2, 1)), 512, cfg.min_prefix_bucket),
+            cfg.fused_m_cap_max,
+        )
+
         while m_cap <= cfg.fused_m_cap_max:
             with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
                 fn = ctx.fused_miner(
-                    m_cap, cfg.fused_l_max, n_digits, n_chunks
+                    m_cap, cfg.fused_l_max, n_digits, n_chunks, fast_f32
                 )
                 out_rows, out_cols, out_counts, out_n, incomplete = fn(
                     packed, w, jnp.int32(data.min_count)

@@ -63,19 +63,27 @@ def _unpack(packed: jnp.ndarray) -> jnp.ndarray:
     return bits.reshape(packed.shape[0], packed.shape[1] * 8).astype(jnp.int8)
 
 
-def _weighted_counts(common, bitmap, w, n_digits: int):
-    """counts[m, f] = Σ_t w_t common[t, m] bitmap[t, f] via base-128 int8
-    digit matmuls (ops/bitmap.py weight_digits, but on device)."""
+def _weighted_counts(common, bitmap, w, n_digits: int, fast_f32: bool):
+    """counts[m, f] = Σ_t w_t common[t, m] bitmap[t, f] via base-128 digit
+    matmuls (ops/bitmap.py weight_digits, but on device).
+
+    ``fast_f32`` runs the matmuls in float32 — exact only when the caller
+    has proven every partial sum fits f32's integer range (engine checks
+    ``127 · T_pad < 2^24``); used on CPU backends where XLA integer
+    matmuls are orders of magnitude slower than BLAS."""
+    dtype = jnp.float32 if fast_f32 else jnp.int8
+    acc = jnp.float32 if fast_f32 else jnp.int32
     total = None
     for d in range(n_digits):
-        w_d = ((w // (128**d)) % 128).astype(jnp.int8)
-        scaled = common * w_d[:, None]
+        w_d = ((w // (128**d)) % 128).astype(dtype)
+        scaled = common.astype(dtype) * w_d[:, None]
         part = lax.dot_general(
             scaled,
-            bitmap,
+            bitmap.astype(dtype),
             (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
+            preferred_element_type=acc,
         )
+        part = part.astype(jnp.int32)
         part = part if d == 0 else part * jnp.int32(128**d)
         total = part if total is None else total + part
     return total
@@ -90,6 +98,7 @@ def _fused_mine_local(
     l_max: int,
     n_digits: int,
     n_chunks: int,
+    fast_f32: bool,
     axis_name: Optional[str],
 ):
     f = packed.shape[1] * 8
@@ -114,7 +123,10 @@ def _fused_mine_local(
         def step(acc, xs):
             pk, wk = xs
             b = _unpack(pk)
-            return acc + _weighted_counts(project(b), b, wk, n_digits), None
+            return (
+                acc + _weighted_counts(project(b), b, wk, n_digits, fast_f32),
+                None,
+            )
 
         acc0 = jnp.zeros((out_dim, f), dtype=jnp.int32)
         if axis_name is not None:
@@ -153,15 +165,21 @@ def _fused_mine_local(
         valid_row = (jnp.arange(m_cap, dtype=jnp.int32) < m)[:, None]
 
         # Candidate generation: E = (S Sᵀ == k-2); cand_cnt = E S.
+        # float32 on purpose: every value is an intersection size bounded
+        # by F (< 2^24), so f32 accumulation is exact — and f32 matmuls
+        # hit the fast path on every backend (MXU on TPU, BLAS on the CPU
+        # fallback; XLA-CPU integer matmuls are orders slower).
+        s_f = s.astype(jnp.float32)
         d_mat = lax.dot_general(
-            s, s, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
+            s_f, s_f, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # [M, M] pairwise intersection sizes
-        e_mat = (d_mat == (k - 2)).astype(jnp.int8)
+        e_mat = (d_mat == (k - 2).astype(jnp.float32)).astype(jnp.float32)
         cand_cnt = lax.dot_general(
-            e_mat, s, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
+            e_mat, s_f, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # [M, F]
+        cand_cnt = cand_cnt.astype(jnp.int32)
         rowmax = jnp.max(
             jnp.where(s > 0, col_ids[None, :], -1), axis=1
         )  # [M] int32
@@ -173,11 +191,13 @@ def _fused_mine_local(
 
         # Support counting: common = (B Sᵀ == k-1); weighted matmul; psum.
         def contains_prefix(b):
+            dt = jnp.float32 if fast_f32 else jnp.int8
+            acc = jnp.float32 if fast_f32 else jnp.int32
             overlap = lax.dot_general(
-                b, s, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )  # [T_c, M]
-            return (overlap == (k - 1)).astype(jnp.int8)
+                b.astype(dt), s.astype(dt), (((1,), (1,)), ((), ())),
+                preferred_element_type=acc,
+            )  # [T_c, M] intersection sizes (bounded by F: f32-exact)
+            return (overlap == (k - 1).astype(acc)).astype(jnp.int8)
 
         counts = psum(scan_counts(contains_prefix, m_cap))
 
@@ -217,12 +237,57 @@ def _fused_mine_local(
     return out_rows, out_cols, out_counts, out_n, incomplete
 
 
+def make_pair_counter(
+    mesh: Optional[Mesh],
+    n_digits: int,
+    n_chunks: int = 1,
+    fast_f32: bool = False,
+):
+    """Cheap pre-pass over the same device-resident packed bitmap: the
+    number of frequent pairs (level-2 survivors).  The engine sizes the
+    fused program's row budget from this instead of guessing."""
+
+    def local(packed, w, min_count):
+        f = packed.shape[1] * 8
+        t_local = packed.shape[0]
+        t_c = t_local // n_chunks
+        packed_c = packed.reshape(n_chunks, t_c, packed.shape[1])
+        w_c = w.reshape(n_chunks, t_c)
+
+        def step(acc, xs):
+            pk, wk = xs
+            b = _unpack(pk)
+            return acc + _weighted_counts(b, b, wk, n_digits, fast_f32), None
+
+        acc0 = jnp.zeros((f, f), dtype=jnp.int32)
+        if mesh is not None:
+            acc0 = lax.pcast(acc0, (AXIS,), to="varying")
+        pair, _ = lax.scan(step, acc0, (packed_c, w_c))
+        if mesh is not None:
+            pair = lax.psum(pair, AXIS)
+        col = jnp.arange(f, dtype=jnp.int32)
+        mask = (pair >= min_count) & (col[None, :] > col[:, None])
+        return jnp.sum(mask, dtype=jnp.int32)
+
+    if mesh is None:
+        return jax.jit(local)
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P()),
+            out_specs=P(),
+        )
+    )
+
+
 def make_fused_miner(
     mesh: Optional[Mesh],
     m_cap: int,
     l_max: int,
     n_digits: int,
     n_chunks: int = 1,
+    fast_f32: bool = False,
 ):
     """Build the jitted fused mining program.  With a mesh, the bitmap and
     weights are sharded over the txn axis inside shard_map (psum
@@ -233,6 +298,7 @@ def make_fused_miner(
         l_max=l_max,
         n_digits=n_digits,
         n_chunks=n_chunks,
+        fast_f32=fast_f32,
         axis_name=AXIS if mesh is not None else None,
     )
     if mesh is None:

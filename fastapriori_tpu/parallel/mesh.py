@@ -86,17 +86,39 @@ class DeviceContext:
     def sharding_vector(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(AXIS))
 
+    @property
+    def platform(self) -> str:
+        return self.mesh.devices.flat[0].platform
+
+    def pair_counter(
+        self, n_digits: int, n_chunks: int = 1, fast_f32: bool = False
+    ):
+        """Jitted level-2 survivor counter (ops/fused.py pre-pass)."""
+        key = ("pairs", n_digits, n_chunks, fast_f32)
+        if key not in self._fns:
+            from fastapriori_tpu.ops.fused import make_pair_counter
+
+            self._fns[key] = make_pair_counter(
+                self.mesh, n_digits, n_chunks, fast_f32
+            )
+        return self._fns[key]
+
     def fused_miner(
-        self, m_cap: int, l_max: int, n_digits: int, n_chunks: int = 1
+        self,
+        m_cap: int,
+        l_max: int,
+        n_digits: int,
+        n_chunks: int = 1,
+        fast_f32: bool = False,
     ):
         """Jitted whole-loop mining program (ops/fused.py), cached per
         static configuration."""
-        key = ("fused", m_cap, l_max, n_digits, n_chunks)
+        key = ("fused", m_cap, l_max, n_digits, n_chunks, fast_f32)
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import make_fused_miner
 
             self._fns[key] = make_fused_miner(
-                self.mesh, m_cap, l_max, n_digits, n_chunks
+                self.mesh, m_cap, l_max, n_digits, n_chunks, fast_f32
             )
         return self._fns[key]
 
