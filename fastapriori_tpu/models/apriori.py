@@ -185,37 +185,54 @@ class FastApriori:
 
         # Size the row budget from the actual level-2 survivor count (a
         # one-matmul pre-pass over the already-uploaded packed bitmap)
-        # instead of guessing; the overflow retry still covers levels that
-        # outgrow 2x the pair count.
-        with self.metrics.timed("pair_prepass") as met:
-            n2 = int(
-                ctx.pair_counter(n_digits, n_chunks, fast_f32)(
-                    packed, w, jnp.int32(data.min_count)
-                )
-            )
-            met.update(n2=n2)
-        m_cap = min(
-            max(_next_pow2(2 * max(n2, 1)), 512, cfg.min_prefix_bucket),
-            cfg.fused_m_cap_max,
+        # instead of guessing.  When a previous run of this process already
+        # compiled-and-succeeded at some m_cap for this static profile, skip
+        # the prepass entirely and start there — the overflow retry still
+        # covers datasets that outgrow the hint, and the prepass's whole
+        # purpose (avoiding a wasted multi-second compile) is already met.
+        # Key the hint on the padded data shape as well as the static
+        # profile: a budget sized for one dataset must not leak onto a
+        # differently-sized one (a large stale hint would compile an
+        # oversized program; the [m_cap, m_cap] candidate matrix grows
+        # quadratically).  Hints above this instance's cap are unusable.
+        profile = (
+            t_pad, f, cfg.fused_l_max, n_digits, n_chunks, fast_f32
         )
+        m_cap = ctx.fused_m_cap_hint(profile)
+        if m_cap is not None and m_cap > cfg.fused_m_cap_max:
+            m_cap = None
+        if m_cap is None:
+            with self.metrics.timed("pair_prepass") as met:
+                n2 = int(
+                    ctx.pair_counter(n_digits, n_chunks, fast_f32)(
+                        packed, w, jnp.int32(data.min_count)
+                    )
+                )
+                met.update(n2=n2)
+            m_cap = min(
+                max(_next_pow2(2 * max(n2, 1)), 512, cfg.min_prefix_bucket),
+                cfg.fused_m_cap_max,
+            )
+        # Packed-output meta row needs m_cap > l_max; if the cap can't
+        # accommodate that, the fused engine can't run at all.
+        m_cap = max(m_cap, _next_pow2(cfg.fused_l_max + 1))
 
         while m_cap <= cfg.fused_m_cap_max:
             with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
                 fn = ctx.fused_miner(
                     m_cap, cfg.fused_l_max, n_digits, n_chunks, fast_f32
                 )
-                out_rows, out_cols, out_counts, out_n, incomplete = fn(
-                    packed, w, jnp.int32(data.min_count)
+                # ONE device->host transfer for the whole mining result.
+                packed_out = np.asarray(
+                    fn(packed, w, jnp.int32(data.min_count))
                 )
-                incomplete = bool(incomplete)
+                rows, cols, counts, n_lvl, incomplete = (
+                    fused.unpack_fused_result(packed_out, cfg.fused_l_max)
+                )
                 met.update(incomplete=incomplete)
             if not incomplete:
-                return fused.decode_fused_result(
-                    np.asarray(out_rows),
-                    np.asarray(out_cols),
-                    np.asarray(out_counts),
-                    np.asarray(out_n),
-                )
+                ctx.record_fused_m_cap(profile, m_cap)
+                return fused.decode_fused_result(rows, cols, counts, n_lvl)
             m_cap *= 2
         return None
 

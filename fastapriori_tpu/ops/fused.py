@@ -234,7 +234,22 @@ def _fused_mine_local(
     )
     # incomplete: loop stopped by the l_max bound while still converging.
     incomplete = overflow | ((m >= k) & (k > l_max + 1))
-    return out_rows, out_cols, out_counts, out_n, incomplete
+    # Pack everything into ONE int32 array so the host needs a single
+    # device->host transfer (each blocking fetch costs a full round trip
+    # on tunneled backends): rows | cols | counts stacked level-major,
+    # then a meta row holding per-level survivor counts and the
+    # incomplete flag at slot l_max (m_cap > l_max is asserted by the
+    # builders).
+    meta = (
+        jnp.zeros((m_cap,), dtype=jnp.int32)
+        .at[:l_max]
+        .set(out_n)
+        .at[l_max]
+        .set(incomplete.astype(jnp.int32))
+    )
+    return jnp.concatenate(
+        [out_rows, out_cols, out_counts, meta[None, :]], axis=0
+    )
 
 
 def make_pair_counter(
@@ -291,7 +306,9 @@ def make_fused_miner(
 ):
     """Build the jitted fused mining program.  With a mesh, the bitmap and
     weights are sharded over the txn axis inside shard_map (psum
-    reductions); without one, a plain single-device jit."""
+    reductions); without one, a plain single-device jit.  Returns the
+    packed [3*l_max+1, m_cap] int32 result (see _fused_mine_local)."""
+    assert m_cap > l_max, (m_cap, l_max)  # meta row layout requirement
     kernel = functools.partial(
         _fused_mine_local,
         m_cap=m_cap,
@@ -308,9 +325,21 @@ def make_fused_miner(
             kernel,
             mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS), P()),
-            out_specs=(P(), P(), P(), P(), P()),
+            out_specs=P(),
         )
     )
+
+
+def unpack_fused_result(
+    packed: np.ndarray, l_max: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool]:
+    """Split the packed [3*l_max+1, m_cap] device result into
+    (rows, cols, counts, n_per_level, incomplete)."""
+    rows = packed[:l_max]
+    cols = packed[l_max : 2 * l_max]
+    counts = packed[2 * l_max : 3 * l_max]
+    meta = packed[3 * l_max]
+    return rows, cols, counts, meta[:l_max], bool(meta[l_max])
 
 
 def decode_fused_result(

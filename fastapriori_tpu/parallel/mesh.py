@@ -56,6 +56,7 @@ class DeviceContext:
         self.n_devices = len(devs)
         self._fns: Dict[Tuple[int, ...], Tuple] = {}
         self._first_match = None
+        self._fused_hints: Dict[Tuple, int] = {}
 
     # -- data placement ----------------------------------------------------
     def shard_bitmap(self, bitmap: np.ndarray) -> jax.Array:
@@ -121,6 +122,14 @@ class DeviceContext:
                 self.mesh, m_cap, l_max, n_digits, n_chunks, fast_f32
             )
         return self._fns[key]
+
+    def fused_m_cap_hint(self, profile: Tuple) -> Optional[int]:
+        """Last row budget that compiled AND completed for this static
+        profile — lets repeat runs skip the pair-count sizing pre-pass."""
+        return self._fused_hints.get(profile)
+
+    def record_fused_m_cap(self, profile: Tuple, m_cap: int) -> None:
+        self._fused_hints[profile] = m_cap
 
     def replicate(self, x: np.ndarray) -> jax.Array:
         spec = P(*([None] * x.ndim))
