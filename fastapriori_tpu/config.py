@@ -32,6 +32,16 @@ class MinerConfig:
     num_devices: Optional[int] = None
     # Emit per-level structured metrics as JSON lines to stderr.
     log_metrics: bool = False
+    # Level engine (transfer-minimal kernels, ops/count.py
+    # local_level_gather / local_pair_gather): transaction-axis scan chunk
+    # (bounds the [tc, P] membership intermediate in HBM), padded prefix
+    # width (one compilation serves every level below this depth), padded
+    # candidate-gather width, and the survivor budget for the on-device
+    # pair threshold (doubles on overflow).
+    level_txn_chunk: int = 1 << 14
+    level_k_max: int = 24
+    level_cand_cap: int = 1 << 16
+    pair_cap: int = 1 << 17
     # Mining engine: "fused" = whole level loop as one on-device program
     # (ops/fused.py), falling back to "level" (one kernel launch per level,
     # host candidate generation) on row-budget overflow; "level" forces the

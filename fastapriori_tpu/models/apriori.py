@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fastapriori_tpu.config import MinerConfig
-from fastapriori_tpu.models.candidates import gen_candidates
+from fastapriori_tpu.models.candidates import gen_candidates_arrays
 from fastapriori_tpu.ops.bitmap import build_bitmap_csr, weight_digits
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import CompressedData, preprocess
@@ -244,7 +244,11 @@ class FastApriori:
         min_count = data.min_count
 
         with self.metrics.timed("bitmap_build") as m:
-            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices
+            # Pad the txn axis so per-device rows split into n_chunks equal
+            # scan chunks (ops/count.py local_level_gather).
+            per_dev = -(-data.total_count // ctx.n_devices)
+            n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
+            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices * n_chunks
             bitmap_np = build_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
@@ -258,39 +262,65 @@ class FastApriori:
             w_digits = ctx.shard_weight_digits(w_digits_np)
             m.update(shape=list(bitmap_np.shape), digits=len(scales))
 
-        freq_itemsets: List[ItemsetWithCount] = []
+        # Frequent k-sets live as a lex-sorted int32 [M, k] matrix between
+        # levels; frozensets are materialized ONCE at the end (the per-set
+        # Python objects were the dominant cost on dense data).
+        levels: List[Tuple[np.ndarray, np.ndarray]] = []
 
-        # Level 2 (C6): one Gram matmul, upper triangle thresholded on host.
+        # Level 2 (C6): one Gram matmul, thresholded ON DEVICE — only the
+        # surviving pairs are transferred (ops/count.py local_pair_gather).
         with self.metrics.timed("level", k=2) as m:
-            pair = np.asarray(ctx.pair_counts(bitmap, w_digits, scales))
-            iu, ju = np.triu_indices(f, k=1)
-            counts = pair[iu, ju]
-            keep = counts >= min_count
-            level = [
-                (frozenset((int(i), int(j))), int(c))
-                for i, j, c in zip(iu[keep], ju[keep], counts[keep])
-            ]
-            m.update(candidates=len(iu), frequent=len(level))
-        freq_itemsets.extend(level)
-        k_items = [s for s, _ in level]
+            cap = cfg.pair_cap
+            while True:
+                idx, cnt, n2 = (
+                    np.asarray(a)
+                    for a in ctx.pair_gather(
+                        bitmap, w_digits, scales, min_count, f, cap
+                    )
+                )
+                n2 = int(n2)
+                if n2 <= cap:
+                    break
+                cap = _next_pow2(n2)
+            f_pad = bitmap.shape[1]
+            idx, cnt = idx[:n2], cnt[:n2]
+            cur = np.stack([idx // f_pad, idx % f_pad], axis=1).astype(
+                np.int32
+            )  # row-major upper triangle => already lex-sorted
+            levels.append((cur, cnt.astype(np.int64)))
+            m.update(candidates=f * (f - 1) // 2, frequent=n2)
 
         # Levels >=3 (C7 + C8), reference termination rule
         # (FastApriori.scala:111).
         k = 3
-        while len(k_items) >= k:
+        while cur.shape[0] >= k:
             with self.metrics.timed("level", k=k) as m:
-                cands = gen_candidates(k_items, f)
-                n_cand = sum(len(e) for _, e in cands)
-                level = self._count_level(
-                    ctx, bitmap, w_digits, scales, cands, f, min_count
+                x_idx, ys = gen_candidates_arrays(cur)
+                nxt, nxt_counts = self._count_level(
+                    ctx,
+                    bitmap,
+                    w_digits,
+                    scales,
+                    cur,
+                    x_idx,
+                    ys,
+                    min_count,
+                    n_chunks,
                 )
                 m.update(
-                    prefixes=len(cands), candidates=n_cand, frequent=len(level)
+                    candidates=int(x_idx.size), frequent=nxt.shape[0]
                 )
-            freq_itemsets.extend(level)
-            k_items = [s for s, _ in level]
+            levels.append((nxt, nxt_counts))
+            cur = nxt
             k += 1
 
+        with self.metrics.timed("decode") as m:
+            freq_itemsets: List[ItemsetWithCount] = []
+            for mat, cnts in levels:
+                freq_itemsets.extend(
+                    zip(map(frozenset, mat.tolist()), cnts.tolist())
+                )
+            m.update(n=len(freq_itemsets))
         return freq_itemsets
 
     def _count_level(
@@ -299,38 +329,81 @@ class FastApriori:
         bitmap,
         w_digits,
         scales,
-        cands: List[Tuple[Tuple[int, ...], List[int]]],
-        f: int,
+        level: np.ndarray,
+        x_idx: np.ndarray,
+        ys: np.ndarray,
         min_count: int,
-    ) -> List[ItemsetWithCount]:
-        """C8 for one level: bucket prefixes to static shapes, launch the
-        prefix-product matmul kernel per bucket, mask+threshold on host."""
+        n_chunks: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """C8 for one level, transfer-minimal: greedy chunks of at most
+        P_CAP prefixes / C_CAP candidates go through the compiled-once
+        gather kernel (ops/count.py local_level_gather); only each
+        candidate's own count comes back.  Candidates arrive as (x_idx, ys)
+        pairs ordered by (x_idx, y) from :func:`gen_candidates_arrays`;
+        returns the next level's lex-sorted matrix and its counts."""
         cfg = self.config
-        out: List[ItemsetWithCount] = []
-        if not cands:
-            return out
+        s = level.shape[1]
+        empty = (
+            np.empty((0, s + 1), dtype=np.int32),
+            np.empty(0, dtype=np.int64),
+        )
+        if x_idx.size == 0:
+            return empty
         f_pad = bitmap.shape[1]
-        zero_col = f  # guaranteed all-zero padding column (ops/bitmap.py)
-        chunk = max(cfg.min_prefix_bucket, 1)
-        max_chunk = 4096
-        i = 0
-        while i < len(cands):
-            batch = cands[i : i + max_chunk]
-            i += max_chunk
-            p = len(batch)
-            p_pad = min(max(_next_pow2(p), chunk), max_chunk)
-            k1 = len(batch[0][0])
-            prefix_cols = np.full((p_pad, k1), zero_col, dtype=np.int32)
-            for row, (prefix, _exts) in enumerate(batch):
-                prefix_cols[row] = prefix
-            counts = np.asarray(
-                ctx.level_counts(bitmap, w_digits, scales, prefix_cols)
-            )  # [p_pad, f_pad] int32
-            for row, (prefix, exts) in enumerate(batch):
-                row_counts = counts[row]
-                ps = frozenset(prefix)
-                for y in exts:
-                    c = int(row_counts[y])
-                    if c >= min_count:
-                        out.append((ps | {y}, c))
-        return out
+        zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
+        p_cap = 4096
+        # A single prefix can have up to F-1 extensions, and chunks take
+        # whole per-prefix runs — the cap must fit at least one run.
+        c_cap = max(cfg.level_cand_cap, f_pad)
+        k_pad = cfg.level_k_max
+        if s > k_pad:  # deeper than the padded width: widen (recompiles)
+            k_pad = ((s + 7) // 8) * 8
+        # x_idx is sorted, so each unique prefix's candidates are one
+        # contiguous run; chunks take whole runs.
+        uniq_x, run_start = np.unique(x_idx, return_index=True)
+        run_end = np.concatenate([run_start[1:], [x_idx.size]])
+        counts_all = np.empty(x_idx.size, dtype=np.int64)
+        start = 0  # index into uniq_x
+        while start < uniq_x.size:
+            hi = min(start + p_cap, uniq_x.size)
+            # Largest end with total candidates <= c_cap (>= 1 prefix; a
+            # single prefix has < F <= c_cap extensions).
+            base = run_start[start]
+            end = int(
+                np.searchsorted(
+                    run_end[start:hi] - base, c_cap, side="right"
+                )
+            )
+            end = start + max(end, 1)
+            n_p = end - start
+            n_c = int(run_end[end - 1] - base)
+            prefix_cols = np.full((p_cap, k_pad), zcol, dtype=np.int32)
+            prefix_cols[:n_p, :s] = level[uniq_x[start:end]]
+            ci = slice(base, base + n_c)
+            cand_idx = np.zeros(c_cap, dtype=np.int32)
+            row_of_cand = (
+                np.searchsorted(uniq_x, x_idx[ci]) - start
+            ).astype(np.int64)
+            cand_idx[:n_c] = row_of_cand * f_pad + ys[ci]
+            out = np.asarray(
+                ctx.level_gather(
+                    bitmap,
+                    w_digits,
+                    scales,
+                    prefix_cols,
+                    s,
+                    cand_idx,
+                    n_chunks,
+                )
+            )
+            counts_all[ci] = out[:n_c]
+            start = end
+        keep = counts_all >= min_count
+        if not keep.any():
+            return empty
+        nxt = np.concatenate(
+            [level[x_idx[keep]], ys[keep, None]], axis=1
+        ).astype(np.int32)
+        # (x_idx, ys) is ordered by (x_idx, y) and level is lex-sorted, so
+        # nxt is already lex-sorted — the invariant the next join needs.
+        return nxt, counts_all[keep]

@@ -24,6 +24,8 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Dict, FrozenSet, List, Sequence, Tuple
 
+import numpy as np
+
 Prefix = Tuple[int, ...]  # sorted ranks
 
 
@@ -61,3 +63,84 @@ def gen_candidates(
                 if ok:
                     out[x].append(y)
     return [(x, ys) for x, ys in out.items()]  # ys ascending by construction
+
+
+# ----------------------------------------------------------------------
+# Vectorized form used by the level engine on large levels.  Same
+# candidate set as :func:`gen_candidates` (tested for equality), but the
+# frequent sets stay a lex-sorted int32 matrix end-to-end — no Python
+# tuples or per-candidate hash probes on the hot path.
+
+
+def _encode_rows(a: np.ndarray) -> np.ndarray:
+    """Encode int rows as fixed-width big-endian byte strings: memcmp
+    order == lexicographic row order, and (keys being equal length)
+    byte-equality == row equality, so a lex-sorted matrix encodes to a
+    sorted key array ready for ``np.searchsorted``."""
+    a = np.ascontiguousarray(a.astype(">u4"))
+    return a.view("S%d" % (4 * a.shape[1])).ravel()
+
+
+def _keys_member(qk: np.ndarray, table_keys: np.ndarray) -> np.ndarray:
+    pos = np.searchsorted(table_keys, qk)
+    ok = pos < table_keys.shape[0]
+    ok[ok] = table_keys[pos[ok]] == qk[ok]
+    return ok
+
+
+def gen_candidates_arrays(
+    level: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Prefix-join + Apriori subset prune, fully vectorized.
+
+    ``level``: lex-sorted int32 ``[M, s]`` matrix of the frequent
+    (k-1)-sets (``s = k-1``, rows sorted ascending within and across).
+    Returns ``(x_idx, y)``: each candidate is ``level[x_idx] ∪ {y}`` with
+    ``y > max(level[x_idx])``, ordered by ``(x_idx, y)`` — the same
+    ordered-extension semantics as the reference's prune
+    (FastApriori.scala:176-188).
+    """
+    m, s = level.shape
+    empty = (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int32))
+    if m < 2:
+        return empty
+    # Rows joinable when they share their first s-1 elements; since the
+    # matrix is lex-sorted, each join group is a contiguous row range.
+    if s == 1:
+        group_of_row = np.zeros(m, dtype=np.int64)
+        group_end = np.full(1, m, dtype=np.int64)
+    else:
+        new_group = np.any(level[1:, :-1] != level[:-1, :-1], axis=1)
+        group_of_row = np.concatenate(
+            [[0], np.cumsum(new_group)]
+        ).astype(np.int64)
+        group_end = np.zeros(int(group_of_row[-1]) + 1, dtype=np.int64)
+        np.maximum.at(group_end, group_of_row, np.arange(m) + 1)
+    # Pair (x, y_row) for every x < y_row inside a group: x repeats once
+    # per later row in its group.
+    reps = group_end[group_of_row] - np.arange(m) - 1
+    total = int(reps.sum())
+    if total == 0:
+        return empty
+    x_idx = np.repeat(np.arange(m, dtype=np.int64), reps)
+    offs = np.concatenate([[0], np.cumsum(reps)[:-1]])
+    y_row = x_idx + 1 + (np.arange(total) - offs[x_idx])
+    y = level[y_row, -1].astype(np.int32)
+
+    # Apriori prune: every (k-1)-subset of the candidate obtained by
+    # dropping one of the shared-prefix positions must be frequent.
+    # (Dropping y gives level[x_idx]; dropping x's last element gives
+    # level[y_row] — both frequent by construction.)
+    ok = np.ones(total, dtype=bool)
+    table_keys = _encode_rows(level)
+    for d in range(s - 1):
+        live = np.flatnonzero(ok)
+        if live.size == 0:
+            break
+        xi = x_idx[live]
+        sub = np.empty((live.size, s), dtype=level.dtype)
+        sub[:, :d] = level[xi, :d]
+        sub[:, d:s - 1] = level[xi, d + 1:]
+        sub[:, s - 1] = y[live]
+        ok[live] = _keys_member(_encode_rows(sub), table_keys)
+    return x_idx[ok], y[ok]

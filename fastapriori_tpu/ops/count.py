@@ -93,6 +93,113 @@ def local_level_counts(
     return _psum_if(counts, axis_name)
 
 
+def local_pair_gather(
+    bitmap: jnp.ndarray,  # [T_local, F] int8
+    w_digits: jnp.ndarray,  # [D, T_local] int8
+    scales: Sequence[int],
+    min_count: jnp.ndarray,  # () int32 (traced)
+    num_items: jnp.ndarray,  # () int32 (traced) — real F before padding
+    cap: int,
+    axis_name: Optional[str] = None,
+) -> tuple:
+    """C6, transfer-minimal form: the pair Gram matmul PLUS the threshold,
+    on device.  Only surviving pairs leave the chip: returns
+    ``(flat_idx int32[cap], counts int32[cap], n2 int32)`` where the first
+    ``n2`` entries are the upper-triangle survivors in row-major order
+    (``i = idx // F``, ``j = idx % F``).  ``n2 > cap`` signals overflow —
+    the caller retries with a doubled cap.  Replaces transferring the full
+    [F, F] table (16 MB at F=2048) with ~2·cap·4 bytes.
+    """
+    f = bitmap.shape[1]
+    counts = _weighted_matmul(bitmap, bitmap, w_digits, scales)
+    counts = _psum_if(counts, axis_name)
+    iu = jnp.arange(f)
+    upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
+    mask = upper & (counts >= min_count)
+    n2 = jnp.sum(mask, dtype=jnp.int32)
+    (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
+    flat_idx = flat_idx.astype(jnp.int32)
+    return flat_idx, jnp.take(counts.reshape(-1), flat_idx), n2
+
+
+def local_level_gather(
+    bitmap: jnp.ndarray,  # [T_local, F] int8
+    w_digits: jnp.ndarray,  # [D, T_local] int8
+    scales: Sequence[int],
+    prefix_cols: jnp.ndarray,  # [P, K_MAX] int32; padding -> zero column
+    k1: jnp.ndarray,  # () int32 — real prefix width (traced, not static)
+    cand_idx: jnp.ndarray,  # [C] int32 flat indexes row*F + y
+    n_chunks: int,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """C8, transfer-minimal form: one compilation serves EVERY level.
+
+    Differences from :func:`local_level_counts` (both kept — this one is
+    the mining engine's path, that one the simple/test path):
+
+    - prefix membership via a one-hot matmul ``(B @ onehotᵀ) == k1``
+      instead of per-column gathers — k1 enters as a *traced* scalar and
+      ``prefix_cols`` has a fixed padded width, so changing level depth
+      does not recompile (the reference recompiles nothing per level
+      either; its per-level cost is pure re-execution,
+      FastApriori.scala:111-121);
+    - the transaction axis is processed in ``n_chunks`` scan steps so the
+      [tc, P] intermediates stay bounded in HBM at Webdocs scale;
+    - only the candidates' own counts leave the device: a [C] gather is
+      ``psum``-reduced instead of the full [P, F] table (device->host
+      bandwidth is the scarcest resource on a tunneled or PCIe-attached
+      chip, and C << P·F).
+
+    Padding discipline: padded prefix *positions* and padded prefix *rows*
+    both point at the guaranteed all-zero bitmap column, so padded
+    positions add 0 to the membership count and padded rows match only a
+    k1 of 0 (never used: k1 >= 2).  Padded ``cand_idx`` entries gather a
+    garbage count that callers slice off.
+    """
+    t_loc, f_pad = bitmap.shape
+    p = prefix_cols.shape[0]
+    d = w_digits.shape[0]
+    onehot = (
+        jnp.zeros((p, f_pad), jnp.int8)
+        .at[jnp.arange(p)[:, None], prefix_cols]
+        .set(1)
+    )
+    tc = t_loc // n_chunks
+    bm = bitmap.reshape(n_chunks, tc, f_pad)
+    wd = w_digits.reshape(d, n_chunks, tc).transpose(1, 0, 2)
+
+    def body(acc, xs):
+        b_chunk, wd_chunk = xs  # [tc, F] int8, [D, tc] int8
+        member = lax.dot_general(
+            b_chunk,
+            onehot,
+            (((1,), (1,)), ((), ())),  # contract over F -> [tc, P]
+            preferred_element_type=jnp.int32,
+        )
+        common = (member == k1).astype(jnp.int8)
+        total = None
+        for di, scale in enumerate(scales):
+            scaled = common * wd_chunk[di][:, None]
+            part = lax.dot_general(
+                scaled,
+                b_chunk,
+                (((0,), (0,)), ((), ())),  # contract over tc -> [P, F]
+                preferred_element_type=jnp.int32,
+            )
+            part = part if scale == 1 else part * jnp.int32(scale)
+            total = part if total is None else total + part
+        return acc + total, None
+
+    init = jnp.zeros((p, f_pad), jnp.int32)
+    if axis_name is not None:
+        # The per-shard accumulator varies over the mesh axis (each shard
+        # sums its own rows); mark the initial carry accordingly.
+        init = lax.pcast(init, (axis_name,), to="varying")
+    counts, _ = lax.scan(body, init, (bm, wd))
+    local = jnp.take(counts.reshape(-1), cand_idx)
+    return _psum_if(local, axis_name)
+
+
 def local_item_supports(
     bitmap: jnp.ndarray,  # [T_local, F] int8
     w_digits: jnp.ndarray,  # [D, T_local] int8

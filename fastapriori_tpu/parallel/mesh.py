@@ -23,6 +23,7 @@ import functools
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -185,6 +186,87 @@ class DeviceContext:
 
         self._fns[scales] = (pair, level, item)
         return self._fns[scales]
+
+    def pair_gather(
+        self, bitmap, w_digits, scales, min_count: int, num_items: int,
+        cap: int,
+    ):
+        """On-device pair threshold (ops/count.py local_pair_gather);
+        returns (flat_idx, counts, n2) numpy-convertible arrays."""
+        key = ("pair_gather", tuple(scales), cap)
+        if key not in self._fns:
+            mesh = self.mesh
+            scl = tuple(scales)
+
+            def _local(bitmap, w_digits, min_count, num_items):
+                return count_ops.local_pair_gather(
+                    bitmap, w_digits, scl, min_count, num_items, cap,
+                    axis_name=AXIS,
+                )
+
+            self._fns[key] = jax.jit(
+                jax.shard_map(
+                    _local,
+                    mesh=mesh,
+                    in_specs=(P(AXIS, None), P(None, AXIS), P(), P()),
+                    out_specs=(P(None), P(None), P()),
+                )
+            )
+        return self._fns[key](
+            bitmap, w_digits, jnp.int32(min_count), jnp.int32(num_items)
+        )
+
+    def level_gather(
+        self,
+        bitmap,
+        w_digits,
+        scales,
+        prefix_cols,
+        k1: int,
+        cand_idx,
+        n_chunks: int,
+    ) -> jax.Array:
+        """Transfer-minimal level kernel (ops/count.py
+        local_level_gather): one compilation serves every level — k1 is
+        traced and prefix_cols has a fixed padded width."""
+        key = ("level_gather", tuple(scales), n_chunks)
+        if key not in self._fns:
+            mesh = self.mesh
+            scl = tuple(scales)
+
+            def _local(bitmap, w_digits, prefix_cols, k1, cand_idx):
+                return count_ops.local_level_gather(
+                    bitmap,
+                    w_digits,
+                    scl,
+                    prefix_cols,
+                    k1,
+                    cand_idx,
+                    n_chunks,
+                    axis_name=AXIS,
+                )
+
+            self._fns[key] = jax.jit(
+                jax.shard_map(
+                    _local,
+                    mesh=mesh,
+                    in_specs=(
+                        P(AXIS, None),
+                        P(None, AXIS),
+                        P(None, None),
+                        P(),
+                        P(None),
+                    ),
+                    out_specs=P(None),
+                )
+            )
+        return self._fns[key](
+            bitmap,
+            w_digits,
+            prefix_cols,
+            jnp.int32(k1),
+            cand_idx,
+        )
 
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
         pair, _, _ = self._get_fns(tuple(scales))

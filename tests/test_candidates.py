@@ -50,3 +50,45 @@ def test_known_triangle():
     # {0,1},{0,2},{1,2} -> candidate {0,1,2} from prefix (0,1) ext 2.
     items = [frozenset(p) for p in [(0, 1), (0, 2), (1, 2)]]
     assert gen_candidates(items, 3) == [((0, 1), [2])]
+
+
+def test_arrays_equals_host_oracle():
+    # The vectorized join+prune (the level engine's path) must produce
+    # exactly the host oracle's candidate set on random levels.
+    import numpy as np
+
+    from fastapriori_tpu.models.candidates import gen_candidates_arrays
+
+    rng = random.Random(7)
+    for _ in range(60):
+        f = rng.randint(4, 16)
+        s = rng.randint(1, 5)
+        m = rng.randint(1, 60)
+        seen = {
+            tuple(sorted(rng.sample(range(f), min(s, f))))
+            for _ in range(m)
+        }
+        level = np.array(sorted(seen), dtype=np.int32)
+        xi, ys = gen_candidates_arrays(level)
+        got = sorted(
+            (tuple(level[i].tolist()), int(y)) for i, y in zip(xi, ys)
+        )
+        want = sorted(
+            (p, y)
+            for p, exts in gen_candidates(
+                [frozenset(t) for t in seen], f
+            )
+            for y in exts
+        )
+        assert got == want
+
+
+def test_arrays_empty_and_tiny():
+    import numpy as np
+
+    from fastapriori_tpu.models.candidates import gen_candidates_arrays
+
+    xi, ys = gen_candidates_arrays(np.empty((0, 2), dtype=np.int32))
+    assert xi.size == 0 and ys.size == 0
+    xi, ys = gen_candidates_arrays(np.array([[0, 1]], dtype=np.int32))
+    assert xi.size == 0
