@@ -164,6 +164,13 @@ def _parser():
         default=420.0,
         help="auto mode: wall-clock budget for the fused attempt",
     )
+    ap.add_argument(
+        "--data-file",
+        default=None,
+        help="pre-generated D.dat to mine instead of running datagen "
+        "(auto mode generates once in the parent and passes it down so "
+        "the fused attempt's budget is spent on mining, not datagen)",
+    )
     return ap
 
 
@@ -172,7 +179,29 @@ def _orchestrate(args) -> int:
     budget (first compile of the whole-loop program can be slow on some
     backends); if it produces no result line, rerun with the per-level
     engine.  Guarantees exactly one JSON line on stdout."""
+    import os
     import subprocess
+    import tempfile
+
+    # Use the caller's dataset when given; otherwise generate ONCE here —
+    # children mine the same file either way.
+    if args.data_file is not None:
+        d_path, own_file = args.data_file, False
+    else:
+        t0 = time.perf_counter()
+        raw = gen_lines(args)
+        d_file = tempfile.NamedTemporaryFile(
+            mode="w", suffix=".dat", delete=False
+        )
+        d_file.write("\n".join(raw) + "\n")
+        d_file.close()
+        del raw
+        d_path, own_file = d_file.name, True
+        print(
+            f"datagen [{args.config}]: {args.n_txns} txns in "
+            f"{time.perf_counter()-t0:.1f}s",
+            file=sys.stderr,
+        )
 
     base = [
         sys.executable,
@@ -183,37 +212,44 @@ def _orchestrate(args) -> int:
         "--seed", str(args.seed),
         "--workload", args.workload,
         "--platform", args.platform,
+        "--data-file", d_path,
     ] + (["--skip-baseline"] if args.skip_baseline else [])
-    for engine, timeout in (
-        ("fused", args.fused_budget_s),
-        ("level", None),
-    ):
-        try:
-            proc = subprocess.run(
-                base + ["--engine", engine],
-                stdout=subprocess.PIPE,
-                timeout=timeout,
+    try:
+        for engine, timeout in (
+            ("fused", args.fused_budget_s),
+            ("level", None),
+        ):
+            try:
+                proc = subprocess.run(
+                    base + ["--engine", engine],
+                    stdout=subprocess.PIPE,
+                    timeout=timeout,
+                )
+            except subprocess.TimeoutExpired:
+                print(
+                    f"engine={engine} exceeded {timeout}s budget; "
+                    "falling back",
+                    file=sys.stderr,
+                )
+                continue
+            out = proc.stdout.decode()
+            line = next(
+                (l for l in out.splitlines() if l.startswith("{")), None
             )
-        except subprocess.TimeoutExpired:
+            if proc.returncode == 0 and line:
+                print(line)
+                return 0
             print(
-                f"engine={engine} exceeded {timeout}s budget; falling back",
+                f"engine={engine} failed (rc={proc.returncode}); "
+                "falling back",
                 file=sys.stderr,
             )
-            continue
-        out = proc.stdout.decode()
-        line = next(
-            (l for l in out.splitlines() if l.startswith("{")), None
-        )
-        if proc.returncode == 0 and line:
-            print(line)
-            return 0
-        print(
-            f"engine={engine} failed (rc={proc.returncode}); falling back",
-            file=sys.stderr,
-        )
-    print(json.dumps({"metric": "bench_failed", "value": 0,
-                      "unit": "txns/sec", "vs_baseline": 0}))
-    return 1
+        print(json.dumps({"metric": "bench_failed", "value": 0,
+                          "unit": "txns/sec", "vs_baseline": 0}))
+        return 1
+    finally:
+        if own_file:
+            os.unlink(d_path)
 
 
 def _recommend_workload(args, raw, d_path) -> int:
@@ -282,10 +318,10 @@ def _scaling_report(args) -> None:
     """Mining wall time on 1/2/4/8-device virtual CPU meshes — validates
     that the sharded path scales functionally (BASELINE.md scaling row;
     real-chip efficiency needs real chips)."""
+    import copy
+    import os
     import subprocess
     import tempfile
-
-    import copy
 
     small = copy.copy(args)
     small.n_txns = min(args.n_txns, 50_000)
@@ -294,15 +330,20 @@ def _scaling_report(args) -> None:
     f.write("\n".join(raw) + "\n")
     f.close()
     times = {}
-    for n in (1, 2, 4, 8):
-        proc = subprocess.run(
-            [sys.executable, "-c", _SCALING_CHILD, f.name, str(n),
-             str(args.min_support)],
-            capture_output=True,
-            timeout=1800,
-        )
-        out = proc.stdout.decode().strip().splitlines()
-        times[n] = float(out[-1]) if proc.returncode == 0 and out else None
+    try:
+        for n in (1, 2, 4, 8):
+            proc = subprocess.run(
+                [sys.executable, "-c", _SCALING_CHILD, f.name, str(n),
+                 str(args.min_support)],
+                capture_output=True,
+                timeout=1800,
+            )
+            out = proc.stdout.decode().strip().splitlines()
+            times[n] = (
+                float(out[-1]) if proc.returncode == 0 and out else None
+            )
+    finally:
+        os.unlink(f.name)
     base = times.get(1)
     for n, t in times.items():
         eff = base / (t * n) if base and t else float("nan")
@@ -335,20 +376,25 @@ def main(argv=None) -> int:
     from fastapriori_tpu.io.reader import tokenize_line
     from fastapriori_tpu.models.apriori import FastApriori
 
-    t0 = time.perf_counter()
-    raw = gen_lines(args)
-    d_file = tempfile.NamedTemporaryFile(
-        mode="w", suffix=".dat", delete=False
-    )
-    d_file.write("\n".join(raw) + "\n")
-    d_file.close()
-    print(
-        f"datagen [{args.config}]: {args.n_txns} txns in "
-        f"{time.perf_counter()-t0:.1f}s",
-        file=sys.stderr,
-    )
+    if args.data_file is not None:
+        d_path = args.data_file
+        raw = None  # materialized lazily only if the baseline needs it
+    else:
+        t0 = time.perf_counter()
+        raw = gen_lines(args)
+        d_file = tempfile.NamedTemporaryFile(
+            mode="w", suffix=".dat", delete=False
+        )
+        d_file.write("\n".join(raw) + "\n")
+        d_file.close()
+        d_path = d_file.name
+        print(
+            f"datagen [{args.config}]: {args.n_txns} txns in "
+            f"{time.perf_counter()-t0:.1f}s",
+            file=sys.stderr,
+        )
     if args.workload == "recommend":
-        return _recommend_workload(args, raw, d_file.name)
+        return _recommend_workload(args, raw, d_path)
 
     # Cold run (includes jit compiles), then warm run for the steady rate.
     # run_file = ingest straight from disk (native C++ scan when built),
@@ -357,16 +403,16 @@ def main(argv=None) -> int:
 
     miner = FastApriori(
         config=MinerConfig(
-            min_support=args.min_support, engine=args.engine
+            min_support=args.min_support, engine=args.engine,
+            log_metrics=True,
         )
     )
     t0 = time.perf_counter()
-    result_cold, _, _ = miner.run_file(d_file.name)
+    result_cold, _, _ = miner.run_file(d_path)
     cold = time.perf_counter() - t0
     t0 = time.perf_counter()
-    result, _, _ = miner.run_file(d_file.name)
+    result, _, _ = miner.run_file(d_path)
     warm = time.perf_counter() - t0
-    lines = [tokenize_line(l) for l in raw]
     print(
         f"mining: cold {cold:.2f}s warm {warm:.2f}s "
         f"({len(result)} frequent itemsets)",
@@ -387,6 +433,10 @@ def main(argv=None) -> int:
         )
         args.skip_baseline = True
     if not args.skip_baseline:
+        if raw is None:
+            with open(d_path) as fh:
+                raw = fh.read().splitlines()
+        lines = [tokenize_line(l) for l in raw]
         t0 = time.perf_counter()
         base_result = reference_style_mine(lines, args.min_support)
         base = time.perf_counter() - t0

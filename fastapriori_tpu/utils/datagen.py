@@ -83,9 +83,21 @@ def _txn_block(rng, pat, weights, yield_per_draw, targets, n_items,
     return flat[order], np.bincount(rows, minlength=n)
 
 
-def _format_rows(flat, counts) -> List[str]:
-    """Vectorized int->str then per-row join."""
-    toks = flat.astype("U12")
+_TOK_CACHE: dict = {}
+
+
+def _token_table(n_items: int):
+    """item id -> str, computed once per distinct vocabulary size."""
+    tab = _TOK_CACHE.get(n_items)
+    if tab is None:
+        tab = np.array([str(i) for i in range(n_items + 1)], dtype=object)
+        _TOK_CACHE[n_items] = tab
+    return tab
+
+
+def _format_rows(flat, counts, n_items) -> List[str]:
+    """Vectorized int->str (cached table lookup) then per-row join."""
+    toks = _token_table(n_items)[flat]
     out = []
     pos = 0
     for c in counts:
@@ -121,7 +133,7 @@ def iter_transaction_blocks(
         flat, counts = _txn_block(
             rng, pat, weights, ypd, targets, n_items, corruption
         )
-        yield _format_rows(flat, counts)
+        yield _format_rows(flat, counts, n_items)
         done += n
 
 
@@ -150,8 +162,11 @@ def _doc_block(rng, p_cum, pat, pat_w_cum, targets, pattern_frac, n_items):
     n = targets.shape[0]
     n_zipf = np.maximum(1, (targets * (1.0 - pattern_frac)).astype(np.int64))
     rows_z = np.repeat(np.arange(n), n_zipf)
-    flat_z = np.searchsorted(
-        p_cum, rng.random(rows_z.shape[0]), side="right"
+    # Clip: float error can leave p_cum[-1] a hair below 1.0, and a draw
+    # above it would index past the vocabulary.
+    flat_z = np.minimum(
+        np.searchsorted(p_cum, rng.random(rows_z.shape[0]), side="right"),
+        n_items - 1,
     ) + 1
     # Pattern overlay: each txn picks a couple of patterns whose items are
     # all drawn from the popularity head, planting real correlations.
@@ -168,12 +183,16 @@ def _doc_block(rng, p_cum, pat, pat_w_cum, targets, pattern_frac, n_items):
     keep = flat_p > 0
     rows = np.concatenate([rows_z, rows_p[keep]])
     flat = np.concatenate([flat_z, flat_p[keep]])
-    # Dedupe within txn; keep sorted item order (output lines sort anyway).
+    # Dedupe within txn.  The combined key encodes (row, item) lexicographic
+    # order, so ONE in-place sort both groups rows and orders items within
+    # each row — replacing unique()'s internal sort plus a lexsort.
     key = rows * np.int64(n_items + 1) + flat
-    _, first = np.unique(key, return_index=True)
-    rows, flat = rows[first], flat[first]
-    order = np.lexsort((flat, rows))
-    rows, flat = rows[order], flat[order]
+    key.sort(kind="stable")
+    first = np.empty(key.shape[0], dtype=bool)
+    first[0] = True
+    np.not_equal(key[1:], key[:-1], out=first[1:])
+    key = key[first]
+    rows, flat = np.divmod(key, np.int64(n_items + 1))
     return flat, np.bincount(rows, minlength=n)
 
 
@@ -228,7 +247,7 @@ def iter_doc_transaction_blocks(
         flat, counts = _doc_block(
             rng, p_cum, pat, pat_w_cum, targets, pattern_frac, n_items
         )
-        yield _format_rows(flat, counts)
+        yield _format_rows(flat, counts, n_items)
         done += n
 
 
@@ -260,4 +279,4 @@ def generate_user_baskets(
     rows, flat = rows[np.sort(first)], flat[np.sort(first)]
     counts = np.bincount(rows, minlength=n_users)
     # Unique-ing can only shrink rows, never empty them (sizes >= 1).
-    return _format_rows(flat, counts)
+    return _format_rows(flat, counts, n_items)
