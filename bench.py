@@ -379,6 +379,10 @@ def main(argv=None) -> int:
     if args.data_file is not None:
         d_path = args.data_file
         raw = None  # materialized lazily only if the baseline needs it
+        # The metric divides by the transaction count — trust the file,
+        # not the preset, when the caller supplies data.
+        with open(d_path, "rb") as fh:
+            args.n_txns = sum(1 for _ in fh)
     else:
         t0 = time.perf_counter()
         raw = gen_lines(args)

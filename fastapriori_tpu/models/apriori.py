@@ -150,46 +150,26 @@ class FastApriori:
         ctx = self.context
         f = data.num_items
 
-        with self.metrics.timed("bitmap_pack") as m:
-            # Per-device rows split into n_chunks equal scan chunks; pad the
-            # transaction axis to n_devices * n_chunks * 32.
-            t0 = len(data.weights)
-            per_dev = -(-t0 // ctx.n_devices)
-            n_chunks = max(1, -(-per_dev // cfg.fused_txn_chunk))
-            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices * n_chunks
-            bitmap_np = build_bitmap_csr(
-                data.basket_indices,
-                data.basket_offsets,
-                f,
-                txn_multiple,
-                cfg.item_tile,
-            )
-            packed_np = fused.pack_bitmap(bitmap_np)
-            t_pad = bitmap_np.shape[0]
-            w_np = np.zeros(t_pad, dtype=np.int32)
-            w_np[: data.total_count] = data.weights
-            max_w = int(data.weights.max()) if data.total_count else 1
-            n_digits = 1
-            while 128**n_digits <= max_w:
-                n_digits += 1
-            packed = jax.device_put(
-                packed_np, ctx.sharding_rows()
-            )
-            w = jax.device_put(w_np, ctx.sharding_vector())
-            m.update(shape=list(bitmap_np.shape), digits=n_digits)
+        # The static profile is fully determined by the data shape — compute
+        # it BEFORE building or uploading anything so a known-doomed profile
+        # skips the bitmap pack and transfer too.  Per-device rows split
+        # into n_chunks equal scan chunks; the transaction axis pads to
+        # n_devices * n_chunks * 32.
+        from fastapriori_tpu.ops.bitmap import pad_axis
 
+        t0 = len(data.weights)
+        per_dev = -(-t0 // ctx.n_devices)
+        n_chunks = max(1, -(-per_dev // cfg.fused_txn_chunk))
+        txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices * n_chunks
+        t_pad = pad_axis(t0, txn_multiple)
+        max_w = int(data.weights.max()) if data.total_count else 1
+        n_digits = 1
+        while 128**n_digits <= max_w:
+            n_digits += 1
         # CPU backends: run the counting matmuls in f32 (BLAS path) when
         # every partial sum provably fits f32's exact-integer range; TPU
         # always uses the int8 MXU path (ops/fused.py _weighted_counts).
         fast_f32 = ctx.platform == "cpu" and 127 * t_pad < 2**24
-
-        # Size the row budget from the actual level-2 survivor count (a
-        # one-matmul pre-pass over the already-uploaded packed bitmap)
-        # instead of guessing.  When a previous run of this process already
-        # compiled-and-succeeded at some m_cap for this static profile, skip
-        # the prepass entirely and start there — the overflow retry still
-        # covers datasets that outgrow the hint, and the prepass's whole
-        # purpose (avoiding a wasted multi-second compile) is already met.
         # Key the hint on the padded data shape as well as the static
         # profile: a budget sized for one dataset must not leak onto a
         # differently-sized one (a large stale hint would compile an
@@ -198,6 +178,37 @@ class FastApriori:
         profile = (
             t_pad, f, cfg.fused_l_max, n_digits, n_chunks, fast_f32
         )
+        if ctx.fused_failed(profile):
+            # A previous run of this exact profile exhausted the row-budget
+            # cap — don't re-pay the doomed attempts.
+            self.metrics.emit("fused_skip", reason="known_overflow")
+            return None
+
+        with self.metrics.timed("bitmap_pack") as m:
+            bitmap_np = build_bitmap_csr(
+                data.basket_indices,
+                data.basket_offsets,
+                f,
+                txn_multiple,
+                cfg.item_tile,
+            )
+            assert bitmap_np.shape[0] == t_pad, (bitmap_np.shape, t_pad)
+            packed_np = fused.pack_bitmap(bitmap_np)
+            w_np = np.zeros(t_pad, dtype=np.int32)
+            w_np[: data.total_count] = data.weights
+            packed = jax.device_put(
+                packed_np, ctx.sharding_rows()
+            )
+            w = jax.device_put(w_np, ctx.sharding_vector())
+            m.update(shape=list(bitmap_np.shape), digits=n_digits)
+
+        # Size the row budget from the actual level-2 survivor count (a
+        # one-matmul pre-pass over the already-uploaded packed bitmap)
+        # instead of guessing.  When a previous run of this process already
+        # compiled-and-succeeded at some m_cap for this static profile, skip
+        # the prepass entirely and start there — the overflow retry still
+        # covers datasets that outgrow the hint, and the prepass's whole
+        # purpose (avoiding a wasted multi-second compile) is already met.
         m_cap = ctx.fused_m_cap_hint(profile)
         if m_cap is not None and m_cap > cfg.fused_m_cap_max:
             m_cap = None
@@ -234,6 +245,7 @@ class FastApriori:
                 ctx.record_fused_m_cap(profile, m_cap)
                 return fused.decode_fused_result(rows, cols, counts, n_lvl)
             m_cap *= 2
+        ctx.record_fused_fail(profile)
         return None
 
     # ------------------------------------------------------------------
@@ -258,7 +270,9 @@ class FastApriori:
             )
             t_pad = bitmap_np.shape[0]
             w_digits_np, scales = weight_digits(data.weights, t_pad)
-            bitmap = ctx.shard_bitmap(bitmap_np)
+            # Bit-packed transfer + on-device unpack: 8x less host->device
+            # traffic (the dominant cost of this phase on tunneled chips).
+            bitmap = ctx.upload_bitmap_packed(bitmap_np)
             w_digits = ctx.shard_weight_digits(w_digits_np)
             m.update(shape=list(bitmap_np.shape), digits=len(scales))
 

@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -48,6 +49,28 @@ struct VecHash {
 inline bool is_ws(unsigned char c) {
   return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
          c == '\r';
+}
+
+// Dense fast path: most datasets use small decimal item ids.  A token in
+// CANONICAL decimal form (single "0", or leading digit 1-9, all digits, at
+// most 7 of them) maps to a slot in a dense array, bypassing the string
+// hash maps in both passes.  Canonical-form only: "007", "+7" and "7" are
+// DIFFERENT tokens for counting purposes and must not collide.  Returns
+// -1 when the token doesn't qualify (string-map path).
+constexpr int64_t kDenseCap = 10'000'000;  // ids 0..9,999,999 (<= 7 digits)
+
+inline int64_t fast_id(std::string_view s) {
+  size_t n = s.size();
+  if (n == 0 || n > 7) return -1;
+  unsigned char c0 = static_cast<unsigned char>(s[0]) - '0';
+  if (c0 > 9 || (c0 == 0 && n > 1)) return -1;  // non-digit or leading zero
+  int64_t v = c0;
+  for (size_t i = 1; i < n; ++i) {
+    unsigned char c = static_cast<unsigned char>(s[i]) - '0';
+    if (c > 9) return -1;
+    v = v * 10 + c;
+  }
+  return v;
 }
 
 // Matches Python int(token) on ASCII: optional sign, all digits.  Python
@@ -139,6 +162,11 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
       static_cast<int64_t>(std::ceil(min_support * static_cast<double>(n_raw)));
 
   // ---- pass 1: occurrence counts ---------------------------------------
+  // Dense array for canonical small-integer tokens (the overwhelmingly
+  // common case), string hash map for everything else.  calloc pages
+  // lazily, so untouched id ranges cost no physical memory.
+  int64_t* dense_counts =
+      static_cast<int64_t*>(std::calloc(kDenseCap, sizeof(int64_t)));
   std::unordered_map<std::string_view, int64_t> counts;
   counts.reserve(1 << 16);
   auto for_each_token = [](std::string_view line, auto&& fn) {
@@ -154,8 +182,23 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
       if (i > start) fn(line.substr(start, i - start));
     }
   };
-  for (auto line : lines) {
-    for_each_token(line, [&](std::string_view tok) { ++counts[tok]; });
+  int64_t max_dense_id = -1;
+  if (dense_counts) {
+    for (auto line : lines) {
+      for_each_token(line, [&](std::string_view tok) {
+        int64_t id = fast_id(tok);
+        if (id >= 0) {
+          ++dense_counts[id];
+          if (id > max_dense_id) max_dense_id = id;
+        } else {
+          ++counts[tok];
+        }
+      });
+    }
+  } else {  // allocation failed: everything through the map
+    for (auto line : lines) {
+      for_each_token(line, [&](std::string_view tok) { ++counts[tok]; });
+    }
   }
 
   // ---- rank assignment -------------------------------------------------
@@ -165,7 +208,20 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     bool numeric;
     BigInt value;
   };
+  // Owned storage for tokens materialized from dense ids (deque: stable
+  // addresses so string_views into it survive growth).
+  std::deque<std::string> dense_tok_arena;
   std::vector<Item> freq;
+  for (int64_t id = 0; id <= max_dense_id; ++id) {
+    int64_t c = dense_counts ? dense_counts[id] : 0;
+    if (c > 0 && c >= min_count) {  // c > 0: only tokens actually seen
+      dense_tok_arena.push_back(std::to_string(id));
+      std::string_view tok = dense_tok_arena.back();
+      BigInt v;
+      parse_int(tok, &v);
+      freq.push_back({tok, c, true, v});
+    }
+  }
   for (const auto& [tok, c] : counts) {
     if (c >= min_count) {
       BigInt v;
@@ -185,7 +241,22 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   const int32_t f = static_cast<int32_t>(freq.size());
   std::unordered_map<std::string_view, int32_t> rank;
   rank.reserve(freq.size() * 2);
-  for (int32_t r = 0; r < f; ++r) rank.emplace(freq[r].tok, r);
+  // Dense rank table (rank+1; 0 = not frequent) mirrors the counting fast
+  // path so pass 2's per-token lookup is one array read.
+  int32_t* dense_rank = nullptr;
+  if (dense_counts && max_dense_id >= 0) {
+    dense_rank = static_cast<int32_t*>(
+        std::calloc(max_dense_id + 1, sizeof(int32_t)));
+  }
+  for (int32_t r = 0; r < f; ++r) {
+    int64_t id = freq[r].numeric ? fast_id(freq[r].tok) : -1;
+    if (dense_rank && id >= 0 && id <= max_dense_id) {
+      dense_rank[id] = r + 1;
+    } else {
+      rank.emplace(freq[r].tok, r);
+    }
+  }
+  std::free(dense_counts);
 
   // ---- pass 2: basket dedup with multiplicity --------------------------
   std::unordered_map<std::vector<int32_t>, int32_t, VecHash> mult;
@@ -196,6 +267,16 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   for (auto line : lines) {
     scratch.clear();
     for_each_token(line, [&](std::string_view tok) {
+      int64_t id;
+      // Without dense_rank (dense path unused or alloc failed) every
+      // frequent token is in the string map — fall through.
+      if (dense_rank && (id = fast_id(tok)) >= 0) {
+        if (id <= max_dense_id) {  // beyond: unseen in pass 1 => infrequent
+          int32_t r = dense_rank[id];
+          if (r) scratch.push_back(r - 1);
+        }
+        return;
+      }
       auto it = rank.find(tok);
       if (it != rank.end()) scratch.push_back(it->second);
     });
@@ -252,6 +333,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     res->weights[i] = mult.find(basket)->second;
   }
   res->basket_offsets[t] = off;
+  std::free(dense_rank);
   return res;
 }
 

@@ -58,6 +58,7 @@ class DeviceContext:
         self._fns: Dict[Tuple[int, ...], Tuple] = {}
         self._first_match = None
         self._fused_hints: Dict[Tuple, int] = {}
+        self._fused_fails: set = set()
 
     # -- data placement ----------------------------------------------------
     def shard_bitmap(self, bitmap: np.ndarray) -> jax.Array:
@@ -69,6 +70,34 @@ class DeviceContext:
         return jax.device_put(
             bitmap, NamedSharding(self.mesh, P(AXIS, None))
         )
+
+    def upload_bitmap_packed(self, bitmap: np.ndarray) -> jax.Array:
+        """Like :meth:`shard_bitmap`, but the host->device transfer is
+        bit-packed (8x smaller — the tunnel/PCIe link is the scarcest
+        resource) and unpacked once on device into the resident int8 form
+        the counting kernels consume.  Requires F % 8 == 0 (guaranteed by
+        ops/bitmap.py item_tile padding)."""
+        assert bitmap.shape[0] % self.n_devices == 0, (
+            bitmap.shape,
+            self.n_devices,
+        )
+        from fastapriori_tpu.ops.fused import pack_bitmap
+
+        packed_np = pack_bitmap(bitmap)
+        packed = jax.device_put(packed_np, self.sharding_rows())
+        if "unpack" not in self._fns:
+            from fastapriori_tpu.ops.fused import _unpack
+
+            self._fns["unpack"] = jax.jit(
+                jax.shard_map(
+                    _unpack,
+                    mesh=self.mesh,
+                    in_specs=P(AXIS, None),
+                    out_specs=P(AXIS, None),
+                ),
+                donate_argnums=0,  # free the packed buffer after unpack
+            )
+        return self._fns["unpack"](packed)
 
     def shard_weight_digits(self, w_digits: np.ndarray) -> jax.Array:
         """Place the [D, T] digit matrix with T sharded."""
@@ -131,6 +160,15 @@ class DeviceContext:
 
     def record_fused_m_cap(self, profile: Tuple, m_cap: int) -> None:
         self._fused_hints[profile] = m_cap
+
+    def fused_failed(self, profile: Tuple) -> bool:
+        """True when a previous run of this profile exhausted the fused
+        row-budget cap — repeat runs go straight to the level engine
+        instead of re-paying the doomed attempts."""
+        return profile in self._fused_fails
+
+    def record_fused_fail(self, profile: Tuple) -> None:
+        self._fused_fails.add(profile)
 
     def replicate(self, x: np.ndarray) -> jax.Array:
         spec = P(*([None] * x.ndim))
