@@ -414,12 +414,23 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     result_cold, _, _ = miner.run_file(d_path)
     cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    result, _, _ = miner.run_file(d_path)
-    warm = time.perf_counter() - t0
+    # Steady-state rate: best of three warm runs.  The first post-compile
+    # run still pays one-off backend costs (deferred transfer-program
+    # setup, allocator warmup — on tunneled TPU backends these are large
+    # and run-to-run variance is high), so a single warm sample
+    # under-reports the sustained rate by 2-3x.
+    warm_runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result, _, _ = miner.run_file(d_path)
+        warm_runs.append(time.perf_counter() - t0)
+        if warm_runs[-1] > 60.0:  # huge datasets: one warm sample is enough
+            break
+    warm = min(warm_runs)
     print(
         f"mining: cold {cold:.2f}s warm {warm:.2f}s "
-        f"({len(result)} frequent itemsets)",
+        f"(runs {' '.join(f'{w:.2f}' for w in warm_runs)}; "
+        f"{len(result)} frequent itemsets)",
         file=sys.stderr,
     )
     tps = args.n_txns / warm
@@ -441,9 +452,16 @@ def main(argv=None) -> int:
             with open(d_path) as fh:
                 raw = fh.read().splitlines()
         lines = [tokenize_line(l) for l in raw]
-        t0 = time.perf_counter()
-        base_result = reference_style_mine(lines, args.min_support)
-        base = time.perf_counter() - t0
+        # Same best-of-3 methodology as the framework measurement above,
+        # so vs_baseline compares like with like.
+        base_runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            base_result = reference_style_mine(lines, args.min_support)
+            base_runs.append(time.perf_counter() - t0)
+            if base_runs[-1] > 60.0:
+                break
+        base = min(base_runs)
         assert dict(base_result) == dict(result), (
             "baseline and framework disagree"
         )

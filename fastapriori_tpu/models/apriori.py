@@ -377,6 +377,13 @@ class FastApriori:
         uniq_x, run_start = np.unique(x_idx, return_index=True)
         run_end = np.concatenate([run_start[1:], [x_idx.size]])
         counts_all = np.empty(x_idx.size, dtype=np.int64)
+        # Dispatch every chunk before fetching any result: each blocking
+        # fetch costs a full host<->device round trip (tens of ms on
+        # tunneled backends), so a level with hundreds of chunks was
+        # latency-bound.  Async dispatch + copy_to_host_async pipelines
+        # the uploads, kernels, and downloads; the collection loop below
+        # then waits on transfers that are already in flight.
+        inflight = []
         start = 0  # index into uniq_x
         while start < uniq_x.size:
             hi = min(start + p_cap, uniq_x.size)
@@ -399,19 +406,23 @@ class FastApriori:
                 np.searchsorted(uniq_x, x_idx[ci]) - start
             ).astype(np.int64)
             cand_idx[:n_c] = row_of_cand * f_pad + ys[ci]
-            out = np.asarray(
-                ctx.level_gather(
-                    bitmap,
-                    w_digits,
-                    scales,
-                    prefix_cols,
-                    s,
-                    cand_idx,
-                    n_chunks,
-                )
+            out = ctx.level_gather(
+                bitmap,
+                w_digits,
+                scales,
+                prefix_cols,
+                s,
+                cand_idx,
+                n_chunks,
             )
-            counts_all[ci] = out[:n_c]
+            try:
+                out.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass
+            inflight.append((ci, n_c, out))
             start = end
+        for ci, n_c, out in inflight:
+            counts_all[ci] = np.asarray(out)[:n_c]
         keep = counts_all >= min_count
         if not keep.any():
             return empty

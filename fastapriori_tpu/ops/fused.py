@@ -353,22 +353,23 @@ def decode_fused_result(
     level's survivor list.  Returns [(frozenset, count), ...] in level
     order (the order the reference appends, FastApriori.scala:105,116)."""
     out = []
-    prev: list = []
+    prev: Optional[np.ndarray] = None  # [N_prev, k-1] int32 member matrix
     for lvl in range(len(out_n)):
         n = int(out_n[lvl])
         if n == 0:
             break
-        cur = []
-        rows, cols, counts = out_rows[lvl], out_cols[lvl], out_counts[lvl]
+        rows = np.asarray(out_rows[lvl][:n], dtype=np.int32)
+        cols = np.asarray(out_cols[lvl][:n], dtype=np.int32)
+        counts = out_counts[lvl][:n]
         if lvl == 0:
-            for i in range(n):
-                s = frozenset((int(rows[i]), int(cols[i])))
-                cur.append(s)
-                out.append((s, int(counts[i])))
+            cur = np.stack([rows, cols], axis=1)
         else:
-            for i in range(n):
-                s = prev[int(rows[i])] | {int(cols[i])}
-                cur.append(s)
-                out.append((s, int(counts[i])))
+            # Chain through the previous level's survivor matrix in one
+            # gather instead of a per-set Python loop (1.35M itemsets at
+            # Webdocs scale made the loop the decode bottleneck).
+            cur = np.concatenate([prev[rows], cols[:, None]], axis=1)
+        out.extend(
+            zip(map(frozenset, cur.tolist()), map(int, counts.tolist()))
+        )
         prev = cur
     return out
