@@ -90,6 +90,10 @@ CONFIGS = {
     "kosarak": (990_000, 41_000, 8, 0.002, "quest"),
     "webdocs-small": (200_000, 50_000, 177, 0.1, "docs"),
     "webdocs": (1_700_000, 50_000, 177, 0.1, "docs"),
+    # MovieLens-25M user->item baskets (BASELINE.md config 5): 162K users,
+    # 59K movies, ~153 ratings/user; long-tail popularity like a doc corpus.
+    # Pair with --workload recommend for the end-to-end rule pipeline.
+    "movielens": (162_000, 59_000, 153, 0.1, "docs"),
 }
 
 

@@ -47,9 +47,12 @@ class MinerConfig:
     # host candidate generation) on row-budget overflow; "level" forces the
     # per-level engine.
     engine: str = "fused"
-    # Fused engine: static per-level frequent-set row budget (padded).
-    # Doubled up to fused_m_cap_max on overflow before falling back.
-    fused_m_cap: int = 4096
+    # Fused engine: floor for the starting per-level frequent-set row
+    # budget (the budget itself is sized from the level-2 survivor count
+    # pre-pass).  On overflow the engine re-compiles with a budget sized
+    # from the overflowing level's true survivor count, up to
+    # fused_m_cap_max, then falls back to the per-level engine.
+    fused_m_cap: int = 512
     fused_m_cap_max: int = 32768
     # Fused engine: max Apriori levels held in the output buffers.
     fused_l_max: int = 24

@@ -221,12 +221,16 @@ class FastApriori:
                 )
                 met.update(n2=n2)
             m_cap = min(
-                max(_next_pow2(2 * max(n2, 1)), 512, cfg.min_prefix_bucket),
+                max(
+                    _next_pow2(2 * max(n2, 1)),
+                    cfg.fused_m_cap,
+                    cfg.min_prefix_bucket,
+                ),
                 cfg.fused_m_cap_max,
             )
-        # Packed-output meta row needs m_cap > l_max; if the cap can't
+        # Packed-output meta row needs m_cap > l_max + 1; if the cap can't
         # accommodate that, the fused engine can't run at all.
-        m_cap = max(m_cap, _next_pow2(cfg.fused_l_max + 1))
+        m_cap = max(m_cap, _next_pow2(cfg.fused_l_max + 2))
 
         while m_cap <= cfg.fused_m_cap_max:
             with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
@@ -237,14 +241,24 @@ class FastApriori:
                 packed_out = np.asarray(
                     fn(packed, w, jnp.int32(data.min_count))
                 )
-                rows, cols, counts, n_lvl, incomplete = (
+                rows, cols, counts, n_lvl, incomplete, overflow = (
                     fused.unpack_fused_result(packed_out, cfg.fused_l_max)
                 )
-                met.update(incomplete=incomplete)
+                met.update(incomplete=incomplete, overflow=overflow)
             if not incomplete:
                 ctx.record_fused_m_cap(profile, m_cap)
                 return fused.decode_fused_result(rows, cols, counts, n_lvl)
-            m_cap *= 2
+            if not overflow:
+                # Stopped by the l_max level bound — a larger row budget
+                # cannot help; go straight to the level engine.
+                break
+            # The meta row holds TRUE (pre-cap) survivor counts, so the
+            # overflowing level's need is known exactly — jump straight to
+            # a budget that fits it (later levels may need more still; the
+            # retry loop covers that).  Each skipped attempt saves a full
+            # compile of the next-larger [m_cap, m_cap] program.
+            needed = int(max(np.max(n_lvl), m_cap + 1))
+            m_cap = max(2 * m_cap, _next_pow2(needed))
         ctx.record_fused_fail(profile)
         return None
 

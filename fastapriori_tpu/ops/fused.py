@@ -237,15 +237,21 @@ def _fused_mine_local(
     # Pack everything into ONE int32 array so the host needs a single
     # device->host transfer (each blocking fetch costs a full round trip
     # on tunneled backends): rows | cols | counts stacked level-major,
-    # then a meta row holding per-level survivor counts and the
-    # incomplete flag at slot l_max (m_cap > l_max is asserted by the
-    # builders).
+    # then a meta row holding per-level survivor counts, the incomplete
+    # flag at slot l_max, and the overflow flag at slot l_max+1
+    # (m_cap > l_max+1 is asserted by the builders).  Overflow is
+    # reported separately because the host's responses differ: overflow
+    # retries with a budget sized from the true survivor counts (out_n
+    # is the pre-cap sum, so the overflowing level's need is exact),
+    # while an l_max-bound stop can't be fixed by more rows at all.
     meta = (
         jnp.zeros((m_cap,), dtype=jnp.int32)
         .at[:l_max]
         .set(out_n)
         .at[l_max]
         .set(incomplete.astype(jnp.int32))
+        .at[l_max + 1]
+        .set(overflow.astype(jnp.int32))
     )
     return jnp.concatenate(
         [out_rows, out_cols, out_counts, meta[None, :]], axis=0
@@ -308,7 +314,7 @@ def make_fused_miner(
     weights are sharded over the txn axis inside shard_map (psum
     reductions); without one, a plain single-device jit.  Returns the
     packed [3*l_max+1, m_cap] int32 result (see _fused_mine_local)."""
-    assert m_cap > l_max, (m_cap, l_max)  # meta row layout requirement
+    assert m_cap > l_max + 1, (m_cap, l_max)  # meta row layout requirement
     kernel = functools.partial(
         _fused_mine_local,
         m_cap=m_cap,
@@ -332,14 +338,21 @@ def make_fused_miner(
 
 def unpack_fused_result(
     packed: np.ndarray, l_max: int
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool, bool]:
     """Split the packed [3*l_max+1, m_cap] device result into
-    (rows, cols, counts, n_per_level, incomplete)."""
+    (rows, cols, counts, n_per_level, incomplete, overflow)."""
     rows = packed[:l_max]
     cols = packed[l_max : 2 * l_max]
     counts = packed[2 * l_max : 3 * l_max]
     meta = packed[3 * l_max]
-    return rows, cols, counts, meta[:l_max], bool(meta[l_max])
+    return (
+        rows,
+        cols,
+        counts,
+        meta[:l_max],
+        bool(meta[l_max]),
+        bool(meta[l_max + 1]),
+    )
 
 
 def decode_fused_result(

@@ -64,15 +64,54 @@ def test_fused_falls_back_to_level_engine():
     assert dict(got) == dict(expected)
 
 
+def test_fused_overflow_jumps_to_needed_budget():
+    # One basket of 14 items makes every C(14,k) level frequent: n2=91
+    # sizes the starting budget at 256, then levels 3..6 (364, 1001,
+    # 2002, 3003 rows) overflow in turn and the meta row's TRUE survivor
+    # counts size each retry exactly: 256→512→1024→2048→4096, completing
+    # at 4096 (level 7 peaks at 3432).  On smooth binomial growth the
+    # sized jump coincides with doubling — what this test pins is the
+    # meta-slot wiring: a mis-read overflow flag would break to the
+    # fallback after one attempt, and a garbage n_lvl would derail the
+    # deterministic budget sequence.
+    lines = tokenized([" ".join(map(str, range(1, 15)))] * 20)
+    expected, _, _ = oracle.mine(lines, 0.5)
+    cfg = MinerConfig(
+        min_support=0.5, engine="fused", num_devices=1,
+        fused_m_cap=4, min_prefix_bucket=1, fused_m_cap_max=8192,
+        log_metrics=False,
+    )
+    miner = FastApriori(config=cfg)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    attempts = [
+        r for r in miner.metrics.records if r["event"] == "fused_mine"
+    ]
+    assert [a["m_cap"] for a in attempts] == [256, 512, 1024, 2048, 4096]
+    assert attempts[0]["overflow"] and attempts[0]["incomplete"]
+    assert not attempts[-1]["overflow"] and not attempts[-1]["incomplete"]
+
+
 def test_fused_l_max_exceeded_falls_back():
-    # 6-deep itemset lattice with l_max=3 -> incomplete -> fallback path.
+    # 6-deep itemset lattice with l_max=3 -> incomplete (not overflow) ->
+    # a larger row budget can't help, so exactly ONE fused attempt, then
+    # straight to the level engine — and exact output either way.
     lines = tokenized(["1 2 3 4 5 6 7"] * 10 + ["8 9"] * 2)
     expected, _, _ = oracle.mine(lines, 0.5)
-    got = _mine(
-        lines, 0.5, engine="fused", num_devices=1,
-        fused_l_max=3, fused_m_cap_max=8192,
+    cfg = MinerConfig(
+        min_support=0.5, engine="fused", num_devices=1,
+        fused_l_max=3, fused_m_cap_max=8192, log_metrics=False,
     )
+    miner = FastApriori(config=cfg)
+    got, _, _ = miner.run(lines)
     assert dict(got) == dict(expected)
+    attempts = [
+        r for r in miner.metrics.records if r["event"] == "fused_mine"
+    ]
+    assert len(attempts) == 1, attempts
+    assert any(
+        r["event"] == "fused_fallback" for r in miner.metrics.records
+    )
 
 
 @pytest.mark.parametrize("n_devices", [1, 8])
