@@ -42,6 +42,10 @@ class AssociationRules:
         self.config = config or MinerConfig()
         self._context = context
         self.metrics = MetricsLogger(enabled=self.config.log_metrics)
+        # Rules depend only on the (immutable) mining result — built once
+        # per instance, like the reference's single genRules pass
+        # (AssociationRules.scala:72), not once per run() call.
+        self._sorted_rules: Optional[List[Rule]] = None
 
     @property
     def context(self) -> DeviceContext:
@@ -60,9 +64,13 @@ class AssociationRules:
             m.update(
                 users=len(user_lines), distinct=len(baskets), empty=len(empty)
             )
-        with self.metrics.timed("gen_rules") as m:
-            rules = sort_rules(gen_rules(self.freq_itemsets), self.freq_items)
-            m.update(rules=len(rules))
+        if self._sorted_rules is None:
+            with self.metrics.timed("gen_rules") as m:
+                self._sorted_rules = sort_rules(
+                    gen_rules(self.freq_itemsets), self.freq_items
+                )
+                m.update(rules=len(self._sorted_rules))
+        rules = self._sorted_rules
 
         out: List[Tuple[int, str]] = [(i, "0") for i in empty]
         if not baskets:
