@@ -105,3 +105,31 @@ def test_miner_with_native_preprocess_end_to_end(tmp_path):
     data = preprocess_file(str(p), 0.05, native=True)
     got = miner.mine_compressed(data)
     assert dict(got) == dict(expected)
+
+
+def test_native_packed_bitmap_matches_numpy():
+    # The native bit-filler and the dense-build + packbits fallback must
+    # produce identical packed bytes (MSB-first within each byte).
+    from fastapriori_tpu.native.loader import fill_packed_bitmap, get_lib
+    from fastapriori_tpu.ops.bitmap import (
+        build_bitmap_csr,
+        build_packed_bitmap_csr,
+    )
+
+    if get_lib() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(5)
+    baskets = [
+        np.unique(rng.integers(0, 300, size=rng.integers(2, 20)))
+        for _ in range(57)
+    ]
+    indices = np.concatenate(baskets).astype(np.int32)
+    offsets = np.concatenate(
+        [[0], np.cumsum([len(b) for b in baskets])]
+    ).astype(np.int64)
+    packed, f_pad = build_packed_bitmap_csr(indices, offsets, 300, 32, 128)
+    dense = build_bitmap_csr(indices, offsets, 300, 32, 128)
+    assert packed.shape == (dense.shape[0], f_pad // 8)
+    assert (np.packbits(dense.astype(bool), axis=1) == packed).all()
