@@ -25,7 +25,10 @@ import numpy as np
 
 from fastapriori_tpu.config import MinerConfig
 from fastapriori_tpu.models.candidates import gen_candidates_arrays
-from fastapriori_tpu.ops.bitmap import build_bitmap_csr, weight_digits
+from fastapriori_tpu.ops.bitmap import (
+    build_packed_bitmap_csr,
+    weight_digits,
+)
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import CompressedData, preprocess
 from fastapriori_tpu.utils.logging import MetricsLogger
@@ -185,22 +188,21 @@ class FastApriori:
             return None
 
         with self.metrics.timed("bitmap_pack") as m:
-            bitmap_np = build_bitmap_csr(
+            packed_np, f_pad = build_packed_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
                 f,
                 txn_multiple,
                 cfg.item_tile,
             )
-            assert bitmap_np.shape[0] == t_pad, (bitmap_np.shape, t_pad)
-            packed_np = fused.pack_bitmap(bitmap_np)
+            assert packed_np.shape[0] == t_pad, (packed_np.shape, t_pad)
             w_np = np.zeros(t_pad, dtype=np.int32)
             w_np[: data.total_count] = data.weights
             packed = jax.device_put(
                 packed_np, ctx.sharding_rows()
             )
             w = jax.device_put(w_np, ctx.sharding_vector())
-            m.update(shape=list(bitmap_np.shape), digits=n_digits)
+            m.update(shape=[t_pad, f_pad], digits=n_digits)
 
         # Size the row budget from the actual level-2 survivor count (a
         # one-matmul pre-pass over the already-uploaded packed bitmap)
@@ -275,20 +277,20 @@ class FastApriori:
             per_dev = -(-data.total_count // ctx.n_devices)
             n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
             txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices * n_chunks
-            bitmap_np = build_bitmap_csr(
+            packed_np, f_pad = build_packed_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
                 f,
                 txn_multiple,
                 cfg.item_tile,
             )
-            t_pad = bitmap_np.shape[0]
+            t_pad = packed_np.shape[0]
             w_digits_np, scales = weight_digits(data.weights, t_pad)
             # Bit-packed transfer + on-device unpack: 8x less host->device
             # traffic (the dominant cost of this phase on tunneled chips).
-            bitmap = ctx.upload_bitmap_packed(bitmap_np)
+            bitmap = ctx.upload_packed(packed_np)
             w_digits = ctx.shard_weight_digits(w_digits_np)
-            m.update(shape=list(bitmap_np.shape), digits=len(scales))
+            m.update(shape=[t_pad, f_pad], digits=len(scales))
 
         # Frequent k-sets live as a lex-sorted int32 [M, k] matrix between
         # levels; frozensets are materialized ONCE at the end (the per-set

@@ -70,6 +70,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
     ]
     lib.fa_free_result.argtypes = [ctypes.POINTER(_FaResult)]
     lib.fa_free_result.restype = None
+    # Stale prebuilt .so (from before this symbol existed) must not break
+    # the other native entry points — probe instead of hard-binding.
+    fill = getattr(lib, "fa_fill_packed_bitmap", None)
+    if fill is not None:
+        fill.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        fill.restype = None
     _lib = lib
     return _lib
 
@@ -133,6 +145,35 @@ def preprocess_buffer(data: bytes, min_support: float) -> NativeResult:
         )
     finally:
         lib.fa_free_result(res_ptr)
+
+
+def fill_packed_bitmap(
+    indices: np.ndarray, offsets: np.ndarray, out: np.ndarray
+) -> bool:
+    """Set CSR basket bits into a zeroed bit-packed bitmap ``out``
+    (uint8[t_pad, f_pad//8], MSB-first like numpy packbits).  Returns
+    False when the native library is unavailable (caller falls back)."""
+    lib = get_lib()
+    if lib is None or getattr(lib, "fa_fill_packed_bitmap", None) is None:
+        return False
+    assert out.dtype == np.uint8 and out.flags["C_CONTIGUOUS"]
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    n_baskets = len(offsets) - 1
+    assert out.shape[0] >= n_baskets
+    if len(indices):
+        # The C filler does no bounds checks (the numpy fallback's fancy
+        # indexing would raise); fence inconsistent CSR input here.
+        lo, hi = int(indices.min()), int(indices.max())
+        assert 0 <= lo and hi < out.shape[1] * 8, (lo, hi, out.shape)
+    lib.fa_fill_packed_bitmap(
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(n_baskets),
+        ctypes.c_int64(out.shape[1]),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return True
 
 
 def preprocess_file(path: str, min_support: float) -> NativeResult:

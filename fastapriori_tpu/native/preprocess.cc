@@ -314,15 +314,44 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     }
   };
   std::vector<int32_t> scratch;
+  // Small-F fast path: collect each line's ranks into an F-bit set —
+  // dedup is free and a ctz walk emits them sorted in O(F/64 + n) instead
+  // of sort+unique's O(n log n).  F is minSupport-bounded (hundreds on
+  // the benchmark corpora), so the per-line clear is a few words.
+  const size_t n_words = (static_cast<size_t>(f) + 63) / 64;
+  const bool use_bitset = f > 0 && f <= 4096;
+  std::vector<uint64_t> rank_bits(use_bitset ? n_words : 0, 0);
   for (int64_t li = 0; li < n_raw; ++li) {
     scratch.clear();
-    for (int64_t ti = tok_offsets[li]; ti < tok_offsets[li + 1]; ++ti) {
-      int32_t id = tok_ids[ti];
-      int32_t r = id >= 0 ? dense_rank[id] : side_rank[-id - 1];
-      if (r) scratch.push_back(r - 1);
+    if (use_bitset) {
+      for (int64_t ti = tok_offsets[li]; ti < tok_offsets[li + 1]; ++ti) {
+        int32_t id = tok_ids[ti];
+        int32_t r = id >= 0 ? dense_rank[id] : side_rank[-id - 1];
+        if (r) {
+          uint32_t rr = static_cast<uint32_t>(r - 1);
+          rank_bits[rr >> 6] |= 1ull << (rr & 63);
+        }
+      }
+      for (size_t wi = 0; wi < n_words; ++wi) {
+        uint64_t w = rank_bits[wi];
+        if (!w) continue;
+        rank_bits[wi] = 0;
+        do {
+          scratch.push_back(static_cast<int32_t>(
+              (wi << 6) + static_cast<size_t>(__builtin_ctzll(w))));
+          w &= w - 1;
+        } while (w);
+      }
+    } else {
+      for (int64_t ti = tok_offsets[li]; ti < tok_offsets[li + 1]; ++ti) {
+        int32_t id = tok_ids[ti];
+        int32_t r = id >= 0 ? dense_rank[id] : side_rank[-id - 1];
+        if (r) scratch.push_back(r - 1);
+      }
+      std::sort(scratch.begin(), scratch.end());
+      scratch.erase(std::unique(scratch.begin(), scratch.end()),
+                    scratch.end());
     }
-    std::sort(scratch.begin(), scratch.end());
-    scratch.erase(std::unique(scratch.begin(), scratch.end()), scratch.end());
     const size_t n = scratch.size();
     if (n <= 1) continue;
     const uint64_t h = hash_basket(scratch.data(), n);
@@ -394,6 +423,24 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   res->basket_offsets[t] = total_items;
   std::free(dense_rank);
   return res;
+}
+
+// Fill a caller-allocated bit-packed vertical bitmap (MSB-first within
+// each byte, matching numpy packbits / ops/fused.py pack_bitmap) straight
+// from the CSR baskets: out[row, col>>3] |= 0x80 >> (col&7).  Replaces
+// the host-side dense [T, F] int8 intermediate + packbits pass (~0.5 GB
+// of traffic at Webdocs scale).  ``out`` must be zeroed, with
+// ``row_stride`` bytes per row (= padded F / 8).
+void fa_fill_packed_bitmap(const int64_t* offsets, const int32_t* items,
+                           int64_t n_baskets, int64_t row_stride,
+                           uint8_t* out) {
+  for (int64_t i = 0; i < n_baskets; ++i) {
+    uint8_t* row = out + i * row_stride;
+    for (int64_t j = offsets[i]; j < offsets[i + 1]; ++j) {
+      int32_t col = items[j];
+      row[col >> 3] |= static_cast<uint8_t>(0x80u >> (col & 7));
+    }
+  }
 }
 
 void fa_free_result(FaResult* res) {

@@ -81,6 +81,38 @@ def build_bitmap_csr(
     return b
 
 
+def build_packed_bitmap_csr(
+    indices: np.ndarray,
+    offsets: np.ndarray,
+    num_items: int,
+    txn_multiple: int = 8,
+    item_multiple: int = 128,
+) -> Tuple[np.ndarray, int]:
+    """Bit-packed variant of :func:`build_bitmap_csr`: returns
+    ``(packed uint8[t_pad, f_pad//8], f_pad)`` with the same MSB-first
+    byte layout as ``numpy.packbits`` / ``ops.fused.pack_bitmap``.
+
+    The native scanner fills the bits straight from the CSR arrays when
+    available, skipping the dense ``[T, F]`` int8 intermediate and the
+    ``packbits`` pass (~0.5 GB of host traffic at Webdocs scale); the
+    numpy fallback materializes the dense bitmap and packs it.
+    """
+    t = len(offsets) - 1
+    t_pad = pad_axis(t, txn_multiple)
+    f_pad = pad_axis(num_items + 1, item_multiple)
+    assert f_pad % 8 == 0
+    packed = np.zeros((t_pad, f_pad // 8), dtype=np.uint8)
+    if t > 0 and len(indices) > 0:
+        from fastapriori_tpu.native.loader import fill_packed_bitmap
+
+        if not fill_packed_bitmap(indices, offsets, packed):
+            dense = build_bitmap_csr(
+                indices, offsets, num_items, txn_multiple, item_multiple
+            )
+            packed = np.packbits(dense.astype(bool), axis=1)
+    return packed, f_pad
+
+
 def pad_weights(weights: np.ndarray, txn_pad: int) -> np.ndarray:
     """Zero-pad the multiplicity vector to the padded transaction count."""
     out = np.zeros(txn_pad, dtype=np.int32)

@@ -71,20 +71,15 @@ class DeviceContext:
             bitmap, NamedSharding(self.mesh, P(AXIS, None))
         )
 
-    def upload_bitmap_packed(self, bitmap: np.ndarray) -> jax.Array:
-        """Like :meth:`shard_bitmap`, but the host->device transfer is
-        bit-packed (8x smaller — the tunnel/PCIe link is the scarcest
-        resource) and unpacked once on device into the resident int8 form
-        the counting kernels consume.  Requires F % 8 == 0 (guaranteed by
-        ops/bitmap.py item_tile padding)."""
-        assert bitmap.shape[0] % self.n_devices == 0, (
-            bitmap.shape,
+    def upload_packed(self, packed: np.ndarray) -> jax.Array:
+        """Upload an already bit-packed ``uint8[T, F//8]`` bitmap (e.g.
+        from ops/bitmap.py build_packed_bitmap_csr) sharded over the txn
+        axis and unpack it on device into the resident int8 form."""
+        assert packed.shape[0] % self.n_devices == 0, (
+            packed.shape,
             self.n_devices,
         )
-        from fastapriori_tpu.ops.fused import pack_bitmap
-
-        packed_np = pack_bitmap(bitmap)
-        packed = jax.device_put(packed_np, self.sharding_rows())
+        arr = jax.device_put(packed, self.sharding_rows())
         if "unpack" not in self._fns:
             from fastapriori_tpu.ops.fused import _unpack
 
@@ -97,7 +92,7 @@ class DeviceContext:
                 ),
                 donate_argnums=0,  # free the packed buffer after unpack
             )
-        return self._fns["unpack"](packed)
+        return self._fns["unpack"](arr)
 
     def shard_weight_digits(self, w_digits: np.ndarray) -> jax.Array:
         """Place the [D, T] digit matrix with T sharded."""
