@@ -92,6 +92,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="write a jax.profiler trace for the mining phase here",
     )
+    p.add_argument(
+        "--platform",
+        choices=["default", "cpu"],
+        default="default",
+        help="force the JAX platform in-process (env vars are unreliable "
+        "when a hardware plugin self-registers at interpreter start — "
+        "'cpu' runs the full pipeline without an accelerator)",
+    )
     return p
 
 
@@ -103,6 +111,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         log_metrics=args.metrics,
         engine=args.engine,
     )
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        # The config only takes effect at backend init; if a caller already
+        # initialized backends in this process, fail loudly rather than
+        # silently running on the accelerator anyway.
+        if jax.default_backend() != "cpu":
+            print(
+                "--platform cpu requested but JAX backends were already "
+                f"initialized ({jax.default_backend()}); start a fresh "
+                "process",
+                file=sys.stderr,
+            )
+            return 2
     if args.distributed:
         from fastapriori_tpu.parallel.mesh import initialize_distributed
 

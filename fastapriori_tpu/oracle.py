@@ -260,8 +260,18 @@ def gen_rules(freq_itemsets: List[Tuple[ItemSet, int]]) -> List[Rule]:
 
 def sort_rules(rules: List[Rule], freq_items: List[str]) -> List[Rule]:
     """C12 ordering: confidence desc, consequent-as-int asc
-    (AssociationRules.scala:116-120)."""
-    return sorted(rules, key=lambda r: (-r[2], int(freq_items[r[1]])))
+    (AssociationRules.scala:116-120 — the reference's ``.toInt`` would
+    crash on non-integer item strings; like rules/gen.py sort_rules, fall
+    back to ordering those after the integers, by string)."""
+
+    def key(r: Rule):
+        item = freq_items[r[1]]
+        try:
+            return (-r[2], 0, int(item), item)
+        except ValueError:
+            return (-r[2], 1, 0, item)
+
+    return sorted(rules, key=key)
 
 
 def recommend(

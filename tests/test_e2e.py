@@ -60,3 +60,37 @@ def test_reader_round_trip(tmp_path):
     d, u = read_input_dir(inp)
     assert d == [["1", "2"], [""], ["3"]]
     assert u == [["7"]]
+
+
+@pytest.mark.parametrize("seed", range(20, 26))
+def test_cli_fuzz_adversarial_tokens_matches_oracle(tmp_path, seed):
+    # Full-pipeline fuzz over token forms that straddle the native
+    # scanner's dense/side split: canonical decimal ids, non-canonical
+    # numerics ("007", "+5", "-3" — distinct tokens from "7"/"5"/"3"),
+    # the 7-digit dense-id boundary, arbitrary-precision integers
+    # (BigInt rank ordering), and non-numeric tokens.
+    import random
+
+    rng = random.Random(seed)
+    pool = (
+        [str(i) for i in range(1, 10)]
+        + ["007", "0", "9999999", "12345678", "x9", "+5", "-3",
+           "99999999999999999999"]
+    )
+    d_raw = [
+        " ".join(rng.choices(pool, k=rng.randint(1, 6))) for _ in range(70)
+    ] + ["", "  007 7 007  ", "\t0 0\t"]
+    u_raw = [
+        " ".join(rng.choices(pool, k=rng.randint(1, 4))) for _ in range(20)
+    ] + [""]
+    min_support = rng.choice([0.05, 0.1, 0.25])
+    inp, outp = _write_inputs(tmp_path, d_raw, u_raw)
+
+    rc = main([inp, outp, "--min-support", str(min_support)])
+    assert rc == 0
+
+    d_lines = [tokenize_line(l) for l in d_raw]
+    u_lines = [tokenize_line(l) for l in u_raw]
+    exp_freq, exp_rec = oracle.run_pipeline(d_lines, u_lines, min_support)
+    assert (tmp_path / "out" / "freqItemset").read_text() == exp_freq
+    assert (tmp_path / "out" / "recommends").read_text() == exp_rec
