@@ -56,6 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="devices in the mesh (default: all visible)",
     )
     p.add_argument(
+        "--cand-devices",
+        type=int,
+        default=1,
+        help="2-D mesh: split devices as (num/cand, cand) over (txn, "
+        "cand); the level engine shards each level's candidate prefixes "
+        "over the cand axis (default 1 = plain transaction mesh)",
+    )
+    p.add_argument(
         "--engine",
         choices=["fused", "level"],
         default="fused",
@@ -108,6 +116,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     config = MinerConfig(
         min_support=args.min_support,
         num_devices=args.num_devices,
+        cand_devices=args.cand_devices,
         log_metrics=args.metrics,
         engine=args.engine,
     )
@@ -115,6 +124,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        if args.num_devices and args.num_devices > 1:
+            # Provision that many virtual CPU devices so the sharded
+            # paths (and 2-D meshes) run for real without an accelerator.
+            # Raises if backends already initialized — fall through to the
+            # default_backend guard below for the friendly diagnostic.
+            try:
+                jax.config.update("jax_num_cpu_devices", args.num_devices)
+            except RuntimeError:
+                pass
         # The config only takes effect at backend init; if a caller already
         # initialized backends in this process, fail loudly rather than
         # silently running on the accelerator anyway.

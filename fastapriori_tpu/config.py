@@ -30,6 +30,10 @@ class MinerConfig:
     item_tile: int = 128
     # Optional cap on devices used (None = all devices in the mesh).
     num_devices: Optional[int] = None
+    # 2-D mesh split: devices arrange as (num/cand_devices, cand_devices)
+    # over axes (txn, cand); the level engine shards candidate-prefix rows
+    # over cand (SURVEY.md §7 optional 2-D mesh).  1 = plain txn mesh.
+    cand_devices: int = 1
     # Emit per-level structured metrics as JSON lines to stderr.
     log_metrics: bool = False
     # Level engine (transfer-minimal kernels, ops/count.py

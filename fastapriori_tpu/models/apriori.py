@@ -81,7 +81,10 @@ class FastApriori:
     @property
     def context(self) -> DeviceContext:
         if self._context is None:
-            self._context = DeviceContext(num_devices=self.config.num_devices)
+            self._context = DeviceContext(
+                num_devices=self.config.num_devices,
+                cand_devices=self.config.cand_devices,
+            )
         return self._context
 
     # ------------------------------------------------------------------
@@ -157,13 +160,13 @@ class FastApriori:
         # it BEFORE building or uploading anything so a known-doomed profile
         # skips the bitmap pack and transfer too.  Per-device rows split
         # into n_chunks equal scan chunks; the transaction axis pads to
-        # n_devices * n_chunks * 32.
+        # txn_shards * n_chunks * 32.
         from fastapriori_tpu.ops.bitmap import pad_axis
 
         t0 = len(data.weights)
-        per_dev = -(-t0 // ctx.n_devices)
+        per_dev = -(-t0 // ctx.txn_shards)
         n_chunks = max(1, -(-per_dev // cfg.fused_txn_chunk))
-        txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices * n_chunks
+        txn_multiple = max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
         t_pad = pad_axis(t0, txn_multiple)
         max_w = int(data.weights.max()) if data.total_count else 1
         n_digits = 1
@@ -274,9 +277,9 @@ class FastApriori:
         with self.metrics.timed("bitmap_build") as m:
             # Pad the txn axis so per-device rows split into n_chunks equal
             # scan chunks (ops/count.py local_level_gather).
-            per_dev = -(-data.total_count // ctx.n_devices)
+            per_dev = -(-data.total_count // ctx.txn_shards)
             n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
-            txn_multiple = max(cfg.txn_tile, 32) * ctx.n_devices * n_chunks
+            txn_multiple = max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
             packed_np, f_pad = build_packed_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
@@ -381,15 +384,22 @@ class FastApriori:
             return empty
         f_pad = bitmap.shape[1]
         zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
-        p_cap = 4096
-        # A single prefix can have up to F-1 extensions, and chunks take
-        # whole per-prefix runs — the cap must fit at least one run.
-        c_cap = max(cfg.level_cand_cap, f_pad)
+        # Per-cand-shard capacities: the prefix rows and the candidate
+        # gather are sharded over the mesh's cand axis (mesh.level_gather),
+        # so each shard gets a contiguous block of prefix runs.  A single
+        # prefix can have up to F-1 extensions, and blocks take whole
+        # per-prefix runs — each shard's budget must fit at least one run.
+        # With cand_shards == 1 this is exactly the old single-block path.
+        n_cs = ctx.cand_shards
+        p_sh = max(4096 // n_cs, 1)
+        p_cap = p_sh * n_cs
+        c_sh = max(cfg.level_cand_cap // n_cs, f_pad)
+        c_cap = c_sh * n_cs
         k_pad = cfg.level_k_max
         if s > k_pad:  # deeper than the padded width: widen (recompiles)
             k_pad = ((s + 7) // 8) * 8
         # x_idx is sorted, so each unique prefix's candidates are one
-        # contiguous run; chunks take whole runs.
+        # contiguous run; blocks take whole runs.
         uniq_x, run_start = np.unique(x_idx, return_index=True)
         run_end = np.concatenate([run_start[1:], [x_idx.size]])
         counts_all = np.empty(x_idx.size, dtype=np.int64)
@@ -402,26 +412,38 @@ class FastApriori:
         inflight = []
         start = 0  # index into uniq_x
         while start < uniq_x.size:
-            hi = min(start + p_cap, uniq_x.size)
-            # Largest end with total candidates <= c_cap (>= 1 prefix; a
-            # single prefix has < F <= c_cap extensions).
-            base = run_start[start]
-            end = int(
-                np.searchsorted(
-                    run_end[start:hi] - base, c_cap, side="right"
-                )
-            )
-            end = start + max(end, 1)
-            n_p = end - start
-            n_c = int(run_end[end - 1] - base)
             prefix_cols = np.full((p_cap, k_pad), zcol, dtype=np.int32)
-            prefix_cols[:n_p, :s] = level[uniq_x[start:end]]
-            ci = slice(base, base + n_c)
             cand_idx = np.zeros(c_cap, dtype=np.int32)
-            row_of_cand = (
-                np.searchsorted(uniq_x, x_idx[ci]) - start
-            ).astype(np.int64)
-            cand_idx[:n_c] = row_of_cand * f_pad + ys[ci]
+            placed = []  # (counts_all slice, offset in cand_idx, length)
+            for sh in range(n_cs):
+                if start >= uniq_x.size:
+                    break
+                hi = min(start + p_sh, uniq_x.size)
+                # Largest end with candidates <= c_sh (>= 1 prefix; a
+                # single prefix has < F <= c_sh extensions).
+                base = run_start[start]
+                end = int(
+                    np.searchsorted(
+                        run_end[start:hi] - base, c_sh, side="right"
+                    )
+                )
+                end = start + max(end, 1)
+                n_p = end - start
+                n_c = int(run_end[end - 1] - base)
+                prefix_cols[sh * p_sh : sh * p_sh + n_p, :s] = level[
+                    uniq_x[start:end]
+                ]
+                ci = slice(base, base + n_c)
+                # Row indexes are LOCAL to the shard's prefix block — each
+                # cand shard sees only its own [p_sh, F] counts matrix.
+                row_of_cand = (
+                    np.searchsorted(uniq_x, x_idx[ci]) - start
+                ).astype(np.int64)
+                cand_idx[sh * c_sh : sh * c_sh + n_c] = (
+                    row_of_cand * f_pad + ys[ci]
+                )
+                placed.append((ci, sh * c_sh, n_c))
+                start = end
             out = ctx.level_gather(
                 bitmap,
                 w_digits,
@@ -435,10 +457,11 @@ class FastApriori:
                 out.copy_to_host_async()
             except (AttributeError, NotImplementedError):
                 pass
-            inflight.append((ci, n_c, out))
-            start = end
-        for ci, n_c, out in inflight:
-            counts_all[ci] = np.asarray(out)[:n_c]
+            inflight.append((placed, out))
+        for placed, out in inflight:
+            arr = np.asarray(out)
+            for ci, off, n_c in placed:
+                counts_all[ci] = arr[off : off + n_c]
         keep = counts_all >= min_count
         if not keep.any():
             return empty

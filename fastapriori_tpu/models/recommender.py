@@ -50,7 +50,10 @@ class AssociationRules:
     @property
     def context(self) -> DeviceContext:
         if self._context is None:
-            self._context = DeviceContext(num_devices=self.config.num_devices)
+            self._context = DeviceContext(
+                num_devices=self.config.num_devices,
+                cand_devices=self.config.cand_devices,
+            )
         return self._context
 
     # ------------------------------------------------------------------
@@ -121,7 +124,7 @@ class AssociationRules:
         cfg = self.config
 
         basket_mat = build_bitmap(
-            baskets, f, max(cfg.txn_tile, 32) * ctx.n_devices, cfg.item_tile
+            baskets, f, max(cfg.txn_tile, 32) * ctx.txn_shards, cfg.item_tile
         )
         nb_pad, f_pad = basket_mat.shape
         basket_len = np.zeros(nb_pad, dtype=np.int32)

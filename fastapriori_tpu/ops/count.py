@@ -131,6 +131,7 @@ def local_level_gather(
     cand_idx: jnp.ndarray,  # [C] int32 flat indexes row*F + y
     n_chunks: int,
     axis_name: Optional[str] = None,
+    cand_axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     """C8, transfer-minimal form: one compilation serves EVERY level.
 
@@ -191,10 +192,12 @@ def local_level_gather(
         return acc + total, None
 
     init = jnp.zeros((p, f_pad), jnp.int32)
-    if axis_name is not None:
-        # The per-shard accumulator varies over the mesh axis (each shard
-        # sums its own rows); mark the initial carry accordingly.
-        init = lax.pcast(init, (axis_name,), to="varying")
+    # The per-shard accumulator varies over every sharded mesh axis (its
+    # txn rows AND, on a 2-D mesh, its cand slice of the prefix rows);
+    # mark the initial carry accordingly.
+    varying = tuple(a for a in (axis_name, cand_axis_name) if a is not None)
+    if varying:
+        init = lax.pcast(init, varying, to="varying")
     counts, _ = lax.scan(body, init, (bm, wd))
     local = jnp.take(counts.reshape(-1), cand_idx)
     return _psum_if(local, axis_name)

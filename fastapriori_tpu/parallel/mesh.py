@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fastapriori_tpu.ops import count as count_ops
 
 AXIS = "txn"
+CAND = "cand"
 
 
 def initialize_distributed(**kwargs) -> None:
@@ -39,22 +40,43 @@ def initialize_distributed(**kwargs) -> None:
 
 
 class DeviceContext:
-    """Owns the 1-D transaction mesh and the jitted counting kernels.
+    """Owns the (txn × cand) device mesh and the jitted counting kernels.
 
     ``num_devices=None`` uses every visible device; ``1`` gives the
     single-chip path (same code — a 1-device mesh; psum is the identity).
+
+    ``cand_devices`` splits the mesh into a 2-D ``(txn, cand)`` grid
+    (default 1 = the plain transaction mesh).  The bitmap is sharded over
+    ``txn`` and replicated over ``cand``; the level engine then shards
+    each level's candidate-prefix rows over ``cand`` (SURVEY.md §7's
+    optional 2-D mesh) — candidate-space parallelism layered on top of
+    the transaction sharding, the analog of the reference running many
+    candidate tasks per executor (FastApriori.scala:140).  Useful when
+    txn shards would otherwise go thin on a large pod (T'/n small).
     """
 
     def __init__(
         self,
         num_devices: Optional[int] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        cand_devices: int = 1,
     ):
         devs = list(devices if devices is not None else jax.devices())
         if num_devices is not None:
             devs = devs[:num_devices]
-        self.mesh = Mesh(np.array(devs), (AXIS,))
+        if cand_devices < 1 or len(devs) % cand_devices != 0:
+            raise ValueError(
+                f"cand_devices={cand_devices} must be >= 1 and divide the "
+                f"device count ({len(devs)}); with --platform cpu, pass "
+                "--num-devices to provision that many virtual devices"
+            )
         self.n_devices = len(devs)
+        self.cand_shards = cand_devices
+        self.txn_shards = len(devs) // cand_devices
+        self.mesh = Mesh(
+            np.array(devs).reshape(self.txn_shards, cand_devices),
+            (AXIS, CAND),
+        )
         self._fns: Dict[Tuple[int, ...], Tuple] = {}
         self._first_match = None
         self._fused_hints: Dict[Tuple, int] = {}
@@ -63,9 +85,9 @@ class DeviceContext:
     # -- data placement ----------------------------------------------------
     def shard_bitmap(self, bitmap: np.ndarray) -> jax.Array:
         """Place B with rows sharded over the txn axis."""
-        assert bitmap.shape[0] % self.n_devices == 0, (
+        assert bitmap.shape[0] % self.txn_shards == 0, (
             bitmap.shape,
-            self.n_devices,
+            self.txn_shards,
         )
         return jax.device_put(
             bitmap, NamedSharding(self.mesh, P(AXIS, None))
@@ -75,9 +97,9 @@ class DeviceContext:
         """Upload an already bit-packed ``uint8[T, F//8]`` bitmap (e.g.
         from ops/bitmap.py build_packed_bitmap_csr) sharded over the txn
         axis and unpack it on device into the resident int8 form."""
-        assert packed.shape[0] % self.n_devices == 0, (
+        assert packed.shape[0] % self.txn_shards == 0, (
             packed.shape,
-            self.n_devices,
+            self.txn_shards,
         )
         arr = jax.device_put(packed, self.sharding_rows())
         if "unpack" not in self._fns:
@@ -277,20 +299,26 @@ class DeviceContext:
                     cand_idx,
                     n_chunks,
                     axis_name=AXIS,
+                    cand_axis_name=CAND,
                 )
 
             self._fns[key] = jax.jit(
                 jax.shard_map(
                     _local,
                     mesh=mesh,
+                    # Prefix rows and the candidate gather are sharded
+                    # over the cand axis (each cand shard counts its own
+                    # slice of the level's candidates over its txn rows);
+                    # with cand_shards == 1 this degenerates to the plain
+                    # transaction mesh.
                     in_specs=(
                         P(AXIS, None),
                         P(None, AXIS),
-                        P(None, None),
+                        P(CAND, None),
                         P(),
-                        P(None),
+                        P(CAND),
                     ),
-                    out_specs=P(None),
+                    out_specs=P(CAND),
                 )
             )
         return self._fns[key](

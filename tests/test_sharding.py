@@ -61,3 +61,42 @@ def test_bitmap_sharding_layout():
     shard_shapes = {s.data.shape for s in sharded.addressable_shards}
     assert shard_shapes == {(8, 128)}
     assert len(sharded.addressable_shards) == 8
+
+
+@pytest.mark.parametrize("cand", [2, 4])
+def test_level_engine_2d_mesh_matches_single_device(cand):
+    """2-D (txn x cand) mesh: candidate-prefix rows sharded over the cand
+    axis (SURVEY.md §7 optional 2-D mesh) must count bit-exactly like the
+    1-device run.  Deep levels force multiple per-shard prefix blocks."""
+    from fastapriori_tpu.config import MinerConfig
+    lines = tokenized(
+        random_dataset(13, n_txns=200, n_items=14, max_len=9)
+    )
+    expected, _, _ = FastApriori(
+        config=MinerConfig(min_support=0.05, engine="level", num_devices=1)
+    ).run(lines)
+    got, _, _ = FastApriori(
+        config=MinerConfig(
+            min_support=0.05, engine="level",
+            num_devices=8, cand_devices=cand,
+        )
+    ).run(lines)
+    assert dict(got) == dict(expected)
+
+
+def test_2d_mesh_full_pipeline_with_fused_engine():
+    """The fused engine and recommender run 1-D-style on a 2-D mesh
+    (replicated over cand) — the whole pipeline must still be exact."""
+    from fastapriori_tpu.config import MinerConfig
+    d_lines = tokenized(random_dataset(7))
+    u_lines = tokenized(random_dataset(77, n_txns=40))
+    exp_sets, item_to_rank, freq_items = oracle.mine(d_lines, 0.08)
+    exp_rules = oracle.sort_rules(oracle.gen_rules(exp_sets), freq_items)
+    exp_rec = oracle.recommend(u_lines, exp_rules, freq_items, item_to_rank)
+
+    cfg = MinerConfig(min_support=0.08, num_devices=8, cand_devices=2)
+    ctx = DeviceContext(num_devices=8, cand_devices=2)
+    got, i2r, fi = FastApriori(config=cfg, context=ctx).run(d_lines)
+    assert dict(got) == dict(exp_sets)
+    rec = AssociationRules(got, fi, i2r, config=cfg, context=ctx).run(u_lines)
+    assert sorted(rec) == sorted(exp_rec)
