@@ -36,6 +36,13 @@ class MinerConfig:
     cand_devices: int = 1
     # Emit per-level structured metrics as JSON lines to stderr.
     log_metrics: bool = False
+    # Level engine: count levels with the Pallas fused
+    # containment+counting kernel (ops/pallas_level.py — keeps the [T, P]
+    # common intermediate in VMEM) instead of the XLA formulation.
+    # Interpreted on CPU backends; compiled on TPU.  Falls back to the
+    # XLA path when the weight-digit count exceeds the kernel's static
+    # bound.
+    level_use_pallas: bool = False
     # Level engine (transfer-minimal kernels, ops/count.py
     # local_level_gather / local_pair_gather): transaction-axis scan chunk
     # (bounds the [tc, P] membership intermediate in HBM), padded prefix

@@ -276,10 +276,38 @@ class FastApriori:
 
         with self.metrics.timed("bitmap_build") as m:
             # Pad the txn axis so per-device rows split into n_chunks equal
-            # scan chunks (ops/count.py local_level_gather).
+            # scan chunks (ops/count.py local_level_gather); the Pallas
+            # path instead needs per-device rows to be a tile multiple
+            # (its grid does the chunking, keeping `common` in VMEM).
+            # Pallas eligibility is decided BEFORE padding so a fallback
+            # keeps the chunked layout's HBM bound: the kernel statically
+            # unrolls at most MAX_DIGITS weight digits, and its blocks
+            # span the full item width — beyond ~2048 padded items the
+            # resident [tile, F] blocks exceed VMEM.
+            use_pallas = cfg.level_use_pallas
+            if use_pallas:
+                from fastapriori_tpu.ops.pallas_level import (
+                    MAX_DIGITS,
+                    T_TILE,
+                )
+                from fastapriori_tpu.ops.bitmap import pad_axis
+
+                max_w = (
+                    int(data.weights.max()) if data.total_count else 1
+                )
+                n_digits = 1
+                while 128**n_digits <= max_w:
+                    n_digits += 1
+                if n_digits > MAX_DIGITS:
+                    use_pallas = False
+                if pad_axis(f + 1, cfg.item_tile) > 2048:
+                    use_pallas = False
             per_dev = -(-data.total_count // ctx.txn_shards)
             n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
             txn_multiple = max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
+            if use_pallas:
+                n_chunks = 1
+                txn_multiple = T_TILE * ctx.txn_shards
             packed_np, f_pad = build_packed_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
@@ -293,7 +321,11 @@ class FastApriori:
             # traffic (the dominant cost of this phase on tunneled chips).
             bitmap = ctx.upload_packed(packed_np)
             w_digits = ctx.shard_weight_digits(w_digits_np)
-            m.update(shape=[t_pad, f_pad], digits=len(scales))
+            m.update(
+                shape=[t_pad, f_pad],
+                digits=len(scales),
+                pallas=use_pallas,
+            )
 
         # Frequent k-sets live as a lex-sorted int32 [M, k] matrix between
         # levels; frozensets are materialized ONCE at the end (the per-set
@@ -339,6 +371,7 @@ class FastApriori:
                     ys,
                     min_count,
                     n_chunks,
+                    use_pallas,
                 )
                 m.update(
                     candidates=int(x_idx.size), frequent=nxt.shape[0]
@@ -367,6 +400,7 @@ class FastApriori:
         ys: np.ndarray,
         min_count: int,
         n_chunks: int,
+        use_pallas: bool = False,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """C8 for one level, transfer-minimal: greedy chunks of at most
         P_CAP prefixes / C_CAP candidates go through the compiled-once
@@ -392,6 +426,11 @@ class FastApriori:
         # With cand_shards == 1 this is exactly the old single-block path.
         n_cs = ctx.cand_shards
         p_sh = max(4096 // n_cs, 1)
+        if use_pallas:
+            from fastapriori_tpu.ops.pallas_level import M_TILE
+
+            # Per-shard prefix rows must be a whole number of M tiles.
+            p_sh = -(-max(p_sh, M_TILE) // M_TILE) * M_TILE
         p_cap = p_sh * n_cs
         c_sh = max(cfg.level_cand_cap // n_cs, f_pad)
         c_cap = c_sh * n_cs
@@ -444,15 +483,20 @@ class FastApriori:
                 )
                 placed.append((ci, sh * c_sh, n_c))
                 start = end
-            out = ctx.level_gather(
-                bitmap,
-                w_digits,
-                scales,
-                prefix_cols,
-                s,
-                cand_idx,
-                n_chunks,
-            )
+            if use_pallas:
+                out = ctx.level_gather_pallas(
+                    bitmap, w_digits, prefix_cols, s, cand_idx
+                )
+            else:
+                out = ctx.level_gather(
+                    bitmap,
+                    w_digits,
+                    scales,
+                    prefix_cols,
+                    s,
+                    cand_idx,
+                    n_chunks,
+                )
             try:
                 out.copy_to_host_async()
             except (AttributeError, NotImplementedError):

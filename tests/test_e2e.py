@@ -6,7 +6,7 @@ import pytest
 from conftest import random_dataset
 from fastapriori_tpu import oracle
 from fastapriori_tpu.cli import main
-from fastapriori_tpu.io.reader import read_input_dir, tokenize_line
+from fastapriori_tpu.io.reader import read_dat, read_input_dir, tokenize_line
 
 
 def _write_inputs(tmp_path, d_raw, u_raw):
@@ -94,3 +94,17 @@ def test_cli_fuzz_adversarial_tokens_matches_oracle(tmp_path, seed):
     exp_freq, exp_rec = oracle.run_pipeline(d_lines, u_lines, min_support)
     assert (tmp_path / "out" / "freqItemset").read_text() == exp_freq
     assert (tmp_path / "out" / "recommends").read_text() == exp_rec
+
+
+def test_reader_remote_path_via_fsspec():
+    # The "://"-triggered fsspec branch (HDFS/GCS analog of the
+    # reference's sc.textFile over HDFS, Utils.scala:21) — exercised with
+    # fsspec's in-process memory filesystem.
+    fsspec = pytest.importorskip("fsspec")
+    with fsspec.open("memory://fa_test/D.dat", "w") as f:
+        f.write("1 2\n\n 3  1 \n")
+    assert read_dat("memory://fa_test/D.dat") == [
+        ["1", "2"],
+        [""],
+        ["3", "1"],
+    ]

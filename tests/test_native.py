@@ -133,3 +133,25 @@ def test_native_packed_bitmap_matches_numpy():
     dense = build_bitmap_csr(indices, offsets, 300, 32, 128)
     assert packed.shape == (dense.shape[0], f_pad // 8)
     assert (np.packbits(dense.astype(bool), axis=1) == packed).all()
+
+
+def test_native_large_f_sort_fallback():
+    # F > 4096 frequent items bypasses the bitset per-line sort in the
+    # native scanner's pass 2 (std::sort fallback) — equality with the
+    # Python path must hold there too.
+    import random
+
+    rng = random.Random(3)
+    n_items = 5000
+    lines = tokenized(
+        [
+            " ".join(
+                str(rng.randint(1, n_items)) for _ in range(rng.randint(2, 8))
+            )
+            for _ in range(4000)
+        ]
+    )
+    a = preprocess(lines, 0.0001, native=True)
+    b = preprocess(lines, 0.0001, native=False)
+    assert a.num_items > 4096, a.num_items
+    _assert_equal(a, b)
