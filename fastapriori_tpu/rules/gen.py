@@ -25,49 +25,130 @@ from __future__ import annotations
 
 from typing import Dict, FrozenSet, List, Sequence, Tuple
 
+import numpy as np
+
 Rule = Tuple[FrozenSet[int], int, float]  # (antecedent, consequent, confidence)
+
+
+def _rows_view(m: np.ndarray) -> np.ndarray:
+    """View an int32 [N, K] matrix as N comparable composite scalars so
+    whole rows sort/search as single keys."""
+    m = np.ascontiguousarray(m)
+    return m.view([("", m.dtype)] * m.shape[1]).ravel()
+
+
+def _lookup_rows(
+    sorted_keys: np.ndarray, order: np.ndarray, keys: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(position-in-original-order, found) for each key row."""
+    pos = np.searchsorted(sorted_keys, keys)
+    found = np.zeros(len(keys), dtype=bool)
+    inb = pos < len(sorted_keys)
+    found[inb] = sorted_keys[pos[inb]] == keys[inb]
+    safe = np.minimum(pos, max(len(sorted_keys) - 1, 0))
+    return (order[safe] if len(order) else safe), found
 
 
 def gen_rules(
     freq_itemsets: Sequence[Tuple[FrozenSet[int], int]]
 ) -> List[Rule]:
-    support: Dict[FrozenSet[int], int] = dict(freq_itemsets)
-
-    raw_by_len: Dict[int, List[Rule]] = {}
+    # Group itemsets by size into sorted-row matrices; all raw-rule
+    # generation and the level-wise prune are then vectorized row joins
+    # (the pure-Python dict/frozenset formulation was the cold-start
+    # bottleneck at 10^5-itemset scale).
+    by_len: Dict[int, List[Tuple[FrozenSet[int], int]]] = {}
     for s, c in freq_itemsets:
-        if len(s) < 2:
+        by_len.setdefault(len(s), []).append((s, c))
+    mats: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    for k, entries in by_len.items():
+        if k == 0:
             continue
-        for item in s:
-            ant = s - {item}
-            raw_by_len.setdefault(len(ant), []).append(
-                (ant, item, c / support[ant])
-            )
+        mat = np.fromiter(
+            (r for s, _ in entries for r in sorted(s)),
+            np.int32,
+            len(entries) * k,
+        ).reshape(-1, k)
+        cnts = np.fromiter((c for _, c in entries), np.int64, len(entries))
+        mats[k] = (mat, cnts)
 
-    if not raw_by_len:
+    # Raw rules (S - {i}) -> i with confidence count(S)/count(S - {i})
+    # (:129-145); the size-1 denominator is the raw occurrence count, via
+    # the 1-itemset table.  Downward closure guarantees every antecedent
+    # is present (KeyError otherwise, like the reference's table lookup).
+    raw: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+    for k in sorted(mats):
+        if k < 2:
+            continue
+        if k - 1 not in mats:
+            raise KeyError(f"missing {k - 1}-itemset table")
+        mat, cnts = mats[k]
+        pmat, pcnts = mats[k - 1]
+        pview = _rows_view(pmat)
+        porder = np.argsort(pview)
+        psorted = pview[porder]
+        ants, conss, confs = [], [], []
+        for j in range(k):
+            ant = np.delete(mat, j, axis=1)  # sorted rows stay sorted
+            idx, found = _lookup_rows(psorted, porder, _rows_view(ant))
+            if not found.all():
+                raise KeyError("antecedent missing from itemset table")
+            # IEEE double division of two int counts — identical to the
+            # reference's JVM division, so >= comparisons agree exactly.
+            ants.append(ant)
+            conss.append(mat[:, j])
+            confs.append(cnts / pcnts[idx].astype(np.float64))
+        raw[k - 1] = (
+            np.concatenate(ants),
+            np.concatenate(conss),
+            np.concatenate(confs),
+        )
+
+    if not raw:
         return []
 
-    min_len = min(raw_by_len)
-    max_len = max(raw_by_len)
-    survivors: List[Rule] = list(raw_by_len[min_len])
-    low_level = survivors
+    min_len = min(raw)
+    max_len = max(raw)
+    out: List[Rule] = []
+
+    def emit(ant: np.ndarray, cons: np.ndarray, conf: np.ndarray) -> None:
+        out.extend(
+            (frozenset(a), int(c), float(f))
+            for a, c, f in zip(ant.tolist(), cons.tolist(), conf.tolist())
+        )
+
+    surv_ant, surv_cons, surv_conf = raw[min_len]
+    emit(surv_ant, surv_cons, surv_conf)
     for i in range(min_len + 1, max_len + 1):
-        # Surviving lower-level rules indexed by (antecedent, consequent).
-        low_conf: Dict[Tuple[FrozenSet[int], int], float] = {
-            (ant, cons): conf for ant, cons, conf in low_level
-        }
-        level: List[Rule] = []
-        for ant, cons, conf in raw_by_len.get(i, ()):
-            ok = True
-            for e in ant:
-                sub_conf = low_conf.get((ant - {e}, cons))
-                if sub_conf is None or sub_conf >= conf:
-                    ok = False
-                    break
-            if ok:
-                level.append((ant, cons, conf))
-        survivors.extend(level)
-        low_level = level
-    return survivors
+        # Surviving lower-level rules keyed by (antecedent cols, cons).
+        low_key = _rows_view(
+            np.concatenate([surv_ant, surv_cons[:, None]], axis=1)
+        )
+        lorder = np.argsort(low_key)
+        lsorted = low_key[lorder]
+        lconf = surv_conf
+        if i not in raw:
+            surv_ant = np.zeros((0, i), np.int32)
+            surv_cons = np.zeros(0, np.int32)
+            surv_conf = np.zeros(0)
+            continue
+        ant, cons, conf = raw[i]
+        ok = np.ones(len(cons), dtype=bool)
+        for e in range(i):
+            key = _rows_view(
+                np.concatenate(
+                    [np.delete(ant, e, axis=1), cons[:, None]], axis=1
+                )
+            )
+            idx, found = _lookup_rows(lsorted, lorder, key)
+            # Survive iff EVERY (ant - {e}) -> cons survived below (:173)
+            # with strictly lower confidence (:168).
+            sub_conf = np.where(
+                found, lconf[idx] if len(lconf) else 0.0, np.inf
+            )
+            ok &= found & (sub_conf < conf)
+        surv_ant, surv_cons, surv_conf = ant[ok], cons[ok], conf[ok]
+        emit(surv_ant, surv_cons, surv_conf)
+    return out
 
 
 def sort_rules(rules: Sequence[Rule], freq_items: Sequence[str]) -> List[Rule]:
