@@ -36,6 +36,10 @@ class MinerConfig:
     cand_devices: int = 1
     # Emit per-level structured metrics as JSON lines to stderr.
     log_metrics: bool = False
+    # Recommender: rules per first-match chunk (priority-ordered; the
+    # scan stops as soon as every basket has matched, so most runs touch
+    # only the first chunk).
+    rule_chunk: int = 1 << 13
     # Level engine: count levels with the Pallas fused
     # containment+counting kernel (ops/pallas_level.py — keeps the [T, P]
     # common intermediate in VMEM) instead of the XLA formulation.

@@ -117,7 +117,17 @@ class AssociationRules:
         self, baskets: List[np.ndarray], rules: List[Rule]
     ) -> List[int]:
         """Containment-matmul path (ops/contain.py), baskets sharded over
-        the mesh, rule tables replicated."""
+        the mesh, rule tables replicated.
+
+        Rules are processed in priority-ordered chunks with a running
+        per-basket best index and an early exit once every basket has
+        matched — the batch analog of the reference's scan stopping at
+        the first hit (AssociationRules.scala:95-102).  Most users match
+        within the highest-confidence chunk, so usually only a fraction
+        of the rule table is ever uploaded or counted, and the [Nb, R]
+        eligibility matrix never materializes at full R."""
+        from fastapriori_tpu.ops.contain import NO_MATCH
+
         ctx = self.context
         f = len(self.freq_items)
         nb = len(baskets)
@@ -131,24 +141,44 @@ class AssociationRules:
         basket_len[:nb] = [len(b) for b in baskets]
 
         r = len(rules)
-        r_pad = pad_axis(r, 128)
+        chunk = pad_axis(max(1, cfg.rule_chunk), 128)  # lane-aligned
+        r_pad = pad_axis(r, chunk)
         ant_rows = [np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules]
-        ant_mat = np.zeros((r_pad, f_pad), dtype=np.int8)
         lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
-        rows = np.repeat(np.arange(r, dtype=np.int64), lens)
-        ant_mat[rows, np.concatenate(ant_rows)] = 1
-        ant_size = np.full(r_pad, f + 1, dtype=np.int32)  # pad: never eligible
-        ant_size[:r] = lens
         consequent = np.zeros(r_pad, dtype=np.int32)
         consequent[:r] = [c for _, c, _ in rules]
 
-        rec = np.asarray(
-            ctx.first_match(
-                ctx.shard_bitmap(basket_mat),
-                ctx.shard_weights_like(basket_len),
-                ctx.replicate(ant_mat),
-                ctx.replicate(ant_size),
-                ctx.replicate(consequent),
-            )
+        baskets_dev = ctx.shard_bitmap(basket_mat)
+        basket_len_dev = ctx.shard_weights_like(basket_len)
+        best = ctx.shard_weights_like(
+            np.full(nb_pad, int(NO_MATCH), dtype=np.int32)
         )
-        return [int(x) for x in rec[:nb]]
+        for c0 in range(0, r_pad, chunk):
+            hi = min(c0 + chunk, r)
+            n_c = hi - c0  # real rules in this chunk (0 for pure padding)
+            ant_c = np.zeros((chunk, f_pad), dtype=np.int8)
+            if n_c > 0:
+                rows = np.repeat(
+                    np.arange(n_c, dtype=np.int64), lens[c0:hi]
+                )
+                ant_c[rows, np.concatenate(ant_rows[c0:hi])] = 1
+            size_c = np.full(chunk, f + 1, dtype=np.int32)  # pad: never hits
+            size_c[:n_c] = lens[c0:hi]
+            cons_c = np.zeros(chunk, dtype=np.int32)
+            cons_c[:n_c] = consequent[c0:hi]
+            best = ctx.first_match_chunk(
+                baskets_dev,
+                basket_len_dev,
+                ctx.replicate(ant_c),
+                ctx.replicate(size_c),
+                ctx.replicate(cons_c),
+                c0,
+                best,
+            )
+            best_np = np.asarray(best)
+            if (best_np[:nb] < int(NO_MATCH)).all():
+                break
+        best_np = best_np[:nb]  # from the loop's early-exit fetch
+        found = best_np < int(NO_MATCH)
+        rec = np.where(found, consequent[np.minimum(best_np, r_pad - 1)], -1)
+        return [int(x) for x in rec]

@@ -1,5 +1,5 @@
 """First-match rule containment kernel (reference C12's hot loop,
-AssociationRules.scala:88-102) as one matmul + argmin.
+AssociationRules.scala:88-102) as chunked matmuls + a running argmin.
 
 The reference scans the confidence-sorted rule list per user basket until
 the first rule whose antecedent is a subset of the basket fires (:95-102).
@@ -26,41 +26,61 @@ from jax.sharding import Mesh, PartitionSpec as P
 AXIS = "txn"
 
 
-def local_first_match(
+NO_MATCH = jnp.int32(2**31 - 1)  # "no rule yet" sentinel in `best`
+
+
+def local_first_match_chunk(
     baskets: jnp.ndarray,  # [Nb_local, F] int8
-    basket_len: jnp.ndarray,  # [Nb_local] int32  (distinct frequent items)
-    antecedents: jnp.ndarray,  # [R, F] int8, priority-sorted
-    ant_size: jnp.ndarray,  # [R] int32 (padded rules: F+1 => never eligible)
-    consequent: jnp.ndarray,  # [R] int32 rank of the consequent
+    basket_len: jnp.ndarray,  # [Nb_local] int32
+    antecedents: jnp.ndarray,  # [Rc, F] int8 — ONE priority chunk
+    ant_size: jnp.ndarray,  # [Rc] int32
+    consequent: jnp.ndarray,  # [Rc] int32
+    base: jnp.ndarray,  # () int32 — global index of this chunk's first rule
+    best: jnp.ndarray,  # [Nb_local] int32 — running best global rule index
 ) -> jnp.ndarray:
-    """Per basket: rank of the recommended item, or -1 for no match."""
-    r = antecedents.shape[0]
+    """Fold one rule chunk into the running first-match.
+
+    The reference's per-user scan stops at the first hit (:95-102); the
+    batch analog processes rules in priority-ordered chunks and keeps a
+    running minimum, so the caller can stop dispatching chunks once every
+    basket has matched — and the [Nb, R] eligibility matrix never exists
+    at full R, only [Nb, Rc] per step."""
+    rc = antecedents.shape[0]
     overlap = lax.dot_general(
         baskets,
         antecedents,
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32,
-    )  # [Nb, R]
+    )  # [Nb, Rc]
     contained = overlap == ant_size[None, :]
     size_ok = ant_size[None, :] <= basket_len[:, None]
-    # consequent ∉ basket: gather each basket's bit at the consequent's rank.
-    cons_in_basket = jnp.take(baskets, consequent, axis=1) > 0  # [Nb, R]
+    cons_in_basket = jnp.take(baskets, consequent, axis=1) > 0
     eligible = contained & size_ok & ~cons_in_basket
-    idx = jnp.where(eligible, jnp.arange(r, dtype=jnp.int32)[None, :], r)
-    first = jnp.min(idx, axis=1)  # [Nb]
-    found = first < r
-    rec = jnp.take(consequent, jnp.where(found, first, 0))
-    return jnp.where(found, rec, -1)
+    idx = jnp.where(
+        eligible,
+        jnp.arange(rc, dtype=jnp.int32)[None, :] + base,
+        NO_MATCH,
+    )
+    return jnp.minimum(best, jnp.min(idx, axis=1))
 
 
-def make_sharded_first_match(mesh: Mesh):
-    """shard_map-wrapped, jitted first-match kernel: baskets sharded over
-    the mesh axis, rule tables replicated."""
+def make_sharded_first_match_chunk(mesh: Mesh):
+    """shard_map-wrapped, jitted chunk kernel: baskets and
+    the running ``best`` vector sharded over the mesh axis, the rule
+    chunk replicated."""
     return jax.jit(
         jax.shard_map(
-            local_first_match,
+            local_first_match_chunk,
             mesh=mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(None, None), P(None), P(None)),
+            in_specs=(
+                P(AXIS, None),
+                P(AXIS),
+                P(None, None),
+                P(None),
+                P(None),
+                P(),
+                P(AXIS),
+            ),
             out_specs=P(AXIS),
         )
     )

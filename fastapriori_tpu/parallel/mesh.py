@@ -78,7 +78,6 @@ class DeviceContext:
             (AXIS, CAND),
         )
         self._fns: Dict[Tuple[int, ...], Tuple] = {}
-        self._first_match = None
         self._fused_hints: Dict[Tuple, int] = {}
         self._fused_fails: set = set()
 
@@ -401,13 +400,20 @@ class DeviceContext:
         _, _, item = self._get_fns(tuple(scales))
         return item(bitmap, w_digits)
 
-    def first_match(self, baskets, basket_len, antecedents, ant_size, consequent):
-        """Recommender containment kernel (ops/contain.py), jitted once per
-        context so repeated run() calls reuse the compilation cache."""
-        if self._first_match is None:
-            from fastapriori_tpu.ops.contain import make_sharded_first_match
+    def first_match_chunk(
+        self, baskets, basket_len, antecedents, ant_size, consequent,
+        base: int, best,
+    ):
+        """One priority chunk of the early-exit first-match scan
+        (ops/contain.py local_first_match_chunk)."""
+        key = ("first_match_chunk",)
+        if key not in self._fns:
+            from fastapriori_tpu.ops.contain import (
+                make_sharded_first_match_chunk,
+            )
 
-            self._first_match = make_sharded_first_match(self.mesh)
-        return self._first_match(
-            baskets, basket_len, antecedents, ant_size, consequent
+            self._fns[key] = make_sharded_first_match_chunk(self.mesh)
+        return self._fns[key](
+            baskets, basket_len, antecedents, ant_size, consequent,
+            jnp.int32(base), best,
         )

@@ -5,6 +5,7 @@ import pytest
 from conftest import random_dataset, tokenized
 from fastapriori_tpu import oracle
 from fastapriori_tpu.models.recommender import AssociationRules
+from fastapriori_tpu.parallel.mesh import DeviceContext
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -55,3 +56,34 @@ def test_recommender_dedup_fanout():
     # basket {1} -> rule {0}->1 fires -> item "2"; basket {2} -> item "1";
     # unknown item -> "0".
     assert got == {0: "2", 1: "2", 2: "1", 3: "0"}
+
+
+@pytest.mark.parametrize("rule_chunk", [128, 256])
+def test_device_first_match_chunked_scan(rule_chunk):
+    # Force the priority-chunked early-exit scan across several chunks;
+    # must agree with the host scan exactly (including users whose first
+    # match lands in a late chunk and users with no match at all).
+    from fastapriori_tpu.config import MinerConfig
+
+    d_lines = tokenized(
+        random_dataset(23, n_txns=200, n_items=30, max_len=8)
+    )
+    u_lines = tokenized(
+        random_dataset(24, n_txns=80, n_items=30)
+        + ["", "999 998"]  # empty + all-infrequent baskets
+    )
+    itemsets, item_to_rank, freq_items = oracle.mine(d_lines, 0.02)
+    rules = oracle.gen_rules(itemsets)
+    assert len(rules) > 256, len(rules)  # several chunks at both params
+    cfg = MinerConfig(
+        min_support=0.02, num_devices=8, rule_chunk=rule_chunk,
+    )
+    rec_dev = AssociationRules(
+        itemsets, freq_items, item_to_rank, config=cfg,
+        context=DeviceContext(num_devices=8),
+    ).run(u_lines)
+    rec_host = AssociationRules(
+        itemsets, freq_items, item_to_rank, config=cfg,
+        context=DeviceContext(num_devices=1),
+    ).run(u_lines, use_device=False)
+    assert sorted(rec_dev) == sorted(rec_host)
