@@ -187,6 +187,23 @@ def _orchestrate(args) -> int:
     import subprocess
     import tempfile
 
+    # Launch the backend liveness probe concurrently with datagen so a
+    # healthy run never waits on it; join before the first engine child.
+    probe_proc = None
+    if args.platform == "default":
+        probe_proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp;"
+                "x = jnp.ones((8, 8), jnp.int8);"
+                "jnp.sum(x).block_until_ready();"
+                "print('ok')",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+        )
+
     # Use the caller's dataset when given; otherwise generate ONCE here —
     # children mine the same file either way.
     if args.data_file is not None:
@@ -207,6 +224,22 @@ def _orchestrate(args) -> int:
             file=sys.stderr,
         )
 
+    if probe_proc is not None:
+        try:
+            out, _ = probe_proc.communicate(timeout=150)
+            alive = probe_proc.returncode == 0 and b"ok" in out
+        except subprocess.TimeoutExpired:
+            probe_proc.kill()
+            probe_proc.communicate()
+            alive = False
+        if not alive:
+            print(
+                "default backend unresponsive (accelerator tunnel down?); "
+                "falling back to --platform cpu for this run",
+                file=sys.stderr,
+            )
+            args.platform = "cpu"
+
     base = [
         sys.executable,
         __file__,
@@ -215,24 +248,36 @@ def _orchestrate(args) -> int:
         "--min-support", str(args.min_support),
         "--seed", str(args.seed),
         "--workload", args.workload,
-        "--platform", args.platform,
         "--data-file", d_path,
     ] + (["--skip-baseline"] if args.skip_baseline else [])
     try:
-        for engine, timeout in (
-            ("fused", args.fused_budget_s),
-            ("level", None),
-        ):
+        # Attempt order: fused (budgeted), level, then — only when the
+        # default platform failed both (e.g. the tunnel died AFTER the
+        # probe) — the level engine on cpu.  The finite level timeout
+        # exists to bound a hung accelerator, so it applies only to the
+        # default platform; an explicit/fallback cpu run may legitimately
+        # take as long as it takes.
+        attempts = [
+            ("fused", args.platform, args.fused_budget_s),
+            (
+                "level",
+                args.platform,
+                3600.0 if args.platform == "default" else None,
+            ),
+        ]
+        if args.platform == "default":
+            attempts.append(("level", "cpu", None))
+        for engine, platform, timeout in attempts:
             try:
                 proc = subprocess.run(
-                    base + ["--engine", engine],
+                    base + ["--engine", engine, "--platform", platform],
                     stdout=subprocess.PIPE,
                     timeout=timeout,
                 )
             except subprocess.TimeoutExpired:
                 print(
-                    f"engine={engine} exceeded {timeout}s budget; "
-                    "falling back",
+                    f"engine={engine} platform={platform} exceeded "
+                    f"{timeout}s budget; falling back",
                     file=sys.stderr,
                 )
                 continue
@@ -244,8 +289,8 @@ def _orchestrate(args) -> int:
                 print(line)
                 return 0
             print(
-                f"engine={engine} failed (rc={proc.returncode}); "
-                "falling back",
+                f"engine={engine} platform={platform} failed "
+                f"(rc={proc.returncode}); falling back",
                 file=sys.stderr,
             )
         print(json.dumps({"metric": "bench_failed", "value": 0,
