@@ -135,10 +135,15 @@ class FastApriori:
         freq_itemsets: List[ItemsetWithCount] = []
         if f >= 2 and data.total_count > 0:
             if self.config.engine == "fused":
-                freq_itemsets = self._mine_fused(data)
-                if freq_itemsets is None:  # row budget exhausted
-                    self.metrics.emit("fused_fallback")
-                    freq_itemsets = self._mine_levels(data)
+                freq_itemsets, partial = self._mine_fused(data)
+                if freq_itemsets is None:  # row budget / level bound hit
+                    self.metrics.emit(
+                        "fused_fallback",
+                        resume_levels=len(partial) if partial else 0,
+                    )
+                    freq_itemsets = self._mine_levels(
+                        data, resume=partial or None
+                    )
             else:
                 freq_itemsets = self._mine_levels(data)
         return freq_itemsets + one_itemsets
@@ -146,10 +151,13 @@ class FastApriori:
     # ------------------------------------------------------------------
     def _mine_fused(
         self, data: CompressedData
-    ) -> Optional[List[ItemsetWithCount]]:
+    ) -> Tuple[Optional[List[ItemsetWithCount]], Optional[list]]:
         """Whole-loop on-device engine (ops/fused.py): one dispatch mines
-        every level; retries with a doubled row budget on overflow, returns
-        None when the budget cap is exhausted (caller falls back)."""
+        every level; on overflow retries with a budget sized from the true
+        survivor counts.  Returns ``(itemsets, None)`` on success, or
+        ``(None, complete_levels)`` when the budget cap or level bound is
+        hit — the caller resumes the level engine from the last attempt's
+        COMPLETE levels instead of recounting them."""
         from fastapriori_tpu.ops import fused
 
         cfg = self.config
@@ -188,7 +196,7 @@ class FastApriori:
             # A previous run of this exact profile exhausted the row-budget
             # cap — don't re-pay the doomed attempts.
             self.metrics.emit("fused_skip", reason="known_overflow")
-            return None
+            return None, None
 
         with self.metrics.timed("bitmap_pack") as m:
             packed_np, f_pad = build_packed_bitmap_csr(
@@ -237,7 +245,10 @@ class FastApriori:
         # accommodate that, the fused engine can't run at all.
         m_cap = max(m_cap, _next_pow2(cfg.fused_l_max + 2))
 
+        rows = None  # last attempt's output (None if no attempt ran)
+        m_cap_run = 0
         while m_cap <= cfg.fused_m_cap_max:
+            m_cap_run = m_cap
             with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
                 fn = ctx.fused_miner(
                     m_cap, cfg.fused_l_max, n_digits, n_chunks, fast_f32
@@ -252,7 +263,10 @@ class FastApriori:
                 met.update(incomplete=incomplete, overflow=overflow)
             if not incomplete:
                 ctx.record_fused_m_cap(profile, m_cap)
-                return fused.decode_fused_result(rows, cols, counts, n_lvl)
+                return (
+                    fused.decode_fused_result(rows, cols, counts, n_lvl),
+                    None,
+                )
             if not overflow:
                 # Stopped by the l_max level bound — a larger row budget
                 # cannot help; go straight to the level engine.
@@ -265,10 +279,23 @@ class FastApriori:
             needed = int(max(np.max(n_lvl), m_cap + 1))
             m_cap = max(2 * m_cap, _next_pow2(needed))
         ctx.record_fused_fail(profile)
-        return None
+        if rows is None:  # no attempt ran (budget floor above the cap)
+            return None, None
+        # Salvage the last attempt's COMPLETE levels (those whose survivor
+        # count fit the budget) so the level engine resumes mid-lattice.
+        partial = fused.decode_level_matrices(
+            rows, cols, counts, n_lvl, max_rows=m_cap_run
+        )
+        return None, partial
 
     # ------------------------------------------------------------------
-    def _mine_levels(self, data: CompressedData) -> List[ItemsetWithCount]:
+    def _mine_levels(
+        self, data: CompressedData, resume: Optional[list] = None
+    ) -> List[ItemsetWithCount]:
+        """``resume``: complete levels salvaged from a failed fused
+        attempt (``[(member matrix, counts), ...]`` starting at level 2,
+        lex-sorted) — the loop continues from the deepest one instead of
+        recounting them."""
         cfg = self.config
         ctx = self.context
         f = data.num_items
@@ -332,32 +359,39 @@ class FastApriori:
         # Python objects were the dominant cost on dense data).
         levels: List[Tuple[np.ndarray, np.ndarray]] = []
 
-        # Level 2 (C6): one Gram matmul, thresholded ON DEVICE — only the
-        # surviving pairs are transferred (ops/count.py local_pair_gather).
-        with self.metrics.timed("level", k=2) as m:
-            cap = cfg.pair_cap
-            while True:
-                idx, cnt, n2 = (
-                    np.asarray(a)
-                    for a in ctx.pair_gather(
-                        bitmap, w_digits, scales, min_count, f, cap
+        if resume:
+            levels.extend(resume)
+            cur = resume[-1][0]
+            self.metrics.emit(
+                "level_resume", from_k=int(cur.shape[1]) + 1
+            )
+        else:
+            # Level 2 (C6): one Gram matmul, thresholded ON DEVICE — only
+            # the surviving pairs are transferred (local_pair_gather).
+            with self.metrics.timed("level", k=2) as m:
+                cap = cfg.pair_cap
+                while True:
+                    idx, cnt, n2 = (
+                        np.asarray(a)
+                        for a in ctx.pair_gather(
+                            bitmap, w_digits, scales, min_count, f, cap
+                        )
                     )
-                )
-                n2 = int(n2)
-                if n2 <= cap:
-                    break
-                cap = _next_pow2(n2)
-            f_pad = bitmap.shape[1]
-            idx, cnt = idx[:n2], cnt[:n2]
-            cur = np.stack([idx // f_pad, idx % f_pad], axis=1).astype(
-                np.int32
-            )  # row-major upper triangle => already lex-sorted
-            levels.append((cur, cnt.astype(np.int64)))
-            m.update(candidates=f * (f - 1) // 2, frequent=n2)
+                    n2 = int(n2)
+                    if n2 <= cap:
+                        break
+                    cap = _next_pow2(n2)
+                f_pad = bitmap.shape[1]
+                idx, cnt = idx[:n2], cnt[:n2]
+                cur = np.stack([idx // f_pad, idx % f_pad], axis=1).astype(
+                    np.int32
+                )  # row-major upper triangle => already lex-sorted
+                levels.append((cur, cnt.astype(np.int64)))
+                m.update(candidates=f * (f - 1) // 2, frequent=n2)
 
         # Levels >=3 (C7 + C8), reference termination rule
         # (FastApriori.scala:111).
-        k = 3
+        k = cur.shape[1] + 1
         while cur.shape[0] >= k:
             with self.metrics.timed("level", k=k) as m:
                 x_idx, ys = gen_candidates_arrays(cur)

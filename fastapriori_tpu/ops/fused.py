@@ -355,34 +355,57 @@ def unpack_fused_result(
     )
 
 
+def decode_level_matrices(
+    out_rows: np.ndarray,
+    out_cols: np.ndarray,
+    out_counts: np.ndarray,
+    out_n: np.ndarray,
+    max_rows: Optional[int] = None,
+) -> list:
+    """Chain complete levels into ``[(member matrix int32[N, k],
+    counts int64[N]), ...]`` — the level engine's inter-level
+    representation, lex-sorted by construction (survivor extraction is
+    row-major over a lex-ordered previous level via one gather per level
+    — 1.35M itemsets at Webdocs scale made a per-set Python loop the
+    decode bottleneck — and the extension column is always the largest
+    member).
+
+    ``max_rows`` (the attempt's row budget) stops BEFORE the first level
+    whose true survivor count exceeded it: such a level's stored rows are
+    truncated and must never be decoded.  Pass it when salvaging a failed
+    attempt for the level engine to resume from; a successful attempt
+    needs no cap."""
+    out = []
+    prev: Optional[np.ndarray] = None
+    for lvl in range(len(out_n)):
+        n = int(out_n[lvl])
+        if n == 0 or (max_rows is not None and n > max_rows):
+            break
+        rows = np.asarray(out_rows[lvl][:n], dtype=np.int32)
+        cols = np.asarray(out_cols[lvl][:n], dtype=np.int32)
+        if lvl == 0:
+            cur = np.stack([rows, cols], axis=1)
+        else:
+            cur = np.concatenate([prev[rows], cols[:, None]], axis=1)
+        out.append((cur, out_counts[lvl][:n].astype(np.int64)))
+        prev = cur
+    return out
+
+
 def decode_fused_result(
     out_rows: np.ndarray,
     out_cols: np.ndarray,
     out_counts: np.ndarray,
     out_n: np.ndarray,
 ) -> list:
-    """Host-side reconstruction: chain (row, col) through levels.
-    Level 2's rows/cols are item ranks; level k's row indexes the previous
-    level's survivor list.  Returns [(frozenset, count), ...] in level
+    """Host-side reconstruction of a SUCCESSFUL fused run: every stored
+    level chained and flattened to [(frozenset, count), ...] in level
     order (the order the reference appends, FastApriori.scala:105,116)."""
     out = []
-    prev: Optional[np.ndarray] = None  # [N_prev, k-1] int32 member matrix
-    for lvl in range(len(out_n)):
-        n = int(out_n[lvl])
-        if n == 0:
-            break
-        rows = np.asarray(out_rows[lvl][:n], dtype=np.int32)
-        cols = np.asarray(out_cols[lvl][:n], dtype=np.int32)
-        counts = out_counts[lvl][:n]
-        if lvl == 0:
-            cur = np.stack([rows, cols], axis=1)
-        else:
-            # Chain through the previous level's survivor matrix in one
-            # gather instead of a per-set Python loop (1.35M itemsets at
-            # Webdocs scale made the loop the decode bottleneck).
-            cur = np.concatenate([prev[rows], cols[:, None]], axis=1)
+    for mat, cnts in decode_level_matrices(
+        out_rows, out_cols, out_counts, out_n
+    ):
         out.extend(
-            zip(map(frozenset, cur.tolist()), map(int, counts.tolist()))
+            zip(map(frozenset, mat.tolist()), map(int, cnts.tolist()))
         )
-        prev = cur
     return out

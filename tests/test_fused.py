@@ -95,7 +95,8 @@ def test_fused_overflow_jumps_to_needed_budget():
 def test_fused_l_max_exceeded_falls_back():
     # 6-deep itemset lattice with l_max=3 -> incomplete (not overflow) ->
     # a larger row budget can't help, so exactly ONE fused attempt, then
-    # straight to the level engine — and exact output either way.
+    # the level engine RESUMES from the fused attempt's complete levels
+    # (2..4) instead of recounting them — and exact output either way.
     lines = tokenized(["1 2 3 4 5 6 7"] * 10 + ["8 9"] * 2)
     expected, _, _ = oracle.mine(lines, 0.5)
     cfg = MinerConfig(
@@ -105,13 +106,14 @@ def test_fused_l_max_exceeded_falls_back():
     miner = FastApriori(config=cfg)
     got, _, _ = miner.run(lines)
     assert dict(got) == dict(expected)
-    attempts = [
-        r for r in miner.metrics.records if r["event"] == "fused_mine"
-    ]
+    records = miner.metrics.records
+    attempts = [r for r in records if r["event"] == "fused_mine"]
     assert len(attempts) == 1, attempts
-    assert any(
-        r["event"] == "fused_fallback" for r in miner.metrics.records
-    )
+    assert any(r["event"] == "fused_fallback" for r in records)
+    resume = [r for r in records if r["event"] == "level_resume"]
+    assert resume and resume[0]["from_k"] == 5, records
+    recounted = [r["k"] for r in records if r["event"] == "level"]
+    assert min(recounted) == 5, recounted
 
 
 @pytest.mark.parametrize("n_devices", [1, 8])
