@@ -12,7 +12,6 @@ a prefix, :func:`load_phase1` round-trips them.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, FrozenSet, List, Sequence, Tuple
 
 from fastapriori_tpu.io.writer import (

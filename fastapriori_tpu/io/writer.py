@@ -11,7 +11,7 @@ recommends are sorted by row index, one item per line (Utils.scala:48).
 from __future__ import annotations
 
 import os
-from typing import Iterable, List, Sequence, Tuple
+from typing import Iterable, Sequence, Tuple
 
 
 def _ensure_parent(path: str) -> None:

@@ -13,7 +13,7 @@ import contextlib
 import json
 import sys
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 
 class MetricsLogger:
