@@ -79,3 +79,34 @@ def test_miner_small_prefix_bucket():
     expected, _, _ = oracle.mine(lines, 0.05)
     got, _, _ = FastApriori(0.05, config=cfg).run(lines)
     assert dict(got) == dict(expected)
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("engine", ["fused", "level"])
+def test_apriori_invariants(seed, engine):
+    """SURVEY §4 property tests on the miner's own output:
+
+    - downward closure: every (k-1)-subset of a frequent k-set (k >= 3)
+      is itself in the result, and every 2-subset too;
+    - count monotonicity: count(S) <= count(S - {i}).  For |S| = 2 the
+      comparison is against the 1-itemsets' RAW occurrence counts
+      (within-line duplicates and dropped size<=1 baskets included,
+      FastApriori.scala:55 vs :70), which can only exceed the
+      deduplicated basket support.
+    """
+    lines = tokenized(
+        random_dataset(seed, n_txns=150, n_items=14, max_len=7)
+    )
+    itemsets, _, _ = FastApriori(
+        config=MinerConfig(min_support=0.04, engine=engine, num_devices=8)
+    ).run(lines)
+    counts = dict(itemsets)
+    assert itemsets, "degenerate dataset"
+    for s, c in itemsets:
+        assert c > 0
+        if len(s) < 2:
+            continue
+        for item in s:
+            sub = s - {item}
+            assert sub in counts, (s, sub)
+            assert c <= counts[sub], (s, c, sub, counts[sub])
