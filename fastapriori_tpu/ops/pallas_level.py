@@ -58,14 +58,19 @@ def _kernel(km1_ref, b_ref, wd_ref, s_ref, out_ref):
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32,
     )  # [M_TILE, T_TILE]
-    common = (overlap == km1).astype(jnp.int8)
+    common = overlap == km1  # bool mask
 
     # Unrolled digit loop with static bound; digits beyond n_digits are
-    # masked to zero scale so they contribute nothing.
+    # masked to zero scale so they contribute nothing.  The masked weight
+    # is a select, not an int8 multiply — Mosaic has no int8 vector
+    # `muli` lowering on v5e (fails to legalize).  The select runs in
+    # int32 (same (8,128) tiling as the i1 mask from the int32 compare;
+    # mixing the mask with (32,128)-tiled int8 operands is an invalid
+    # relayout), then truncates to int8 to feed the MXU.
     total = jnp.zeros_like(out_ref)
     for d in range(MAX_DIGITS):
-        w_d = wd_ref[d, :]  # [T_TILE] int8
-        scaled = common * w_d[None, :]  # int8 in [0,127]
+        w_d = wd_ref[d, :].astype(jnp.int32)  # [T_TILE]
+        scaled = jnp.where(common, w_d[None, :], 0).astype(jnp.int8)
         part = lax.dot_general(
             scaled,
             b_ref[:],
