@@ -14,8 +14,10 @@ from __future__ import annotations
 
 from typing import Dict, FrozenSet, List, Sequence, Tuple
 
+from fastapriori_tpu.io.reader import _open
 from fastapriori_tpu.io.writer import (
     _ensure_parent,
+    open_write,
     save_freq_itemsets_with_count,
 )
 
@@ -35,11 +37,11 @@ def save_phase1(
     save_freq_itemsets_with_count(prefix, freq_itemsets, freq_items)
     path_items = prefix + "FreqItems"
     _ensure_parent(path_items)
-    with open(path_items, "w") as f:
+    with open_write(path_items) as f:
         f.writelines(item + "\n" for item in freq_items)
     path_ranks = prefix + "ItemsToRank"
     _ensure_parent(path_ranks)
-    with open(path_ranks, "w") as f:
+    with open_write(path_ranks) as f:
         f.writelines(f"{item} {rank}\n" for item, rank in item_to_rank.items())
 
 
@@ -51,19 +53,19 @@ def load_phase1(
     from "item rank" lines; items sorted by rank; itemset lines split on
     ``[`` with the trailing count)."""
     item_to_rank: Dict[str, int] = {}
-    with open(prefix + "ItemsToRank") as f:
+    with _open(prefix + "ItemsToRank") as f:
         for line in f.read().splitlines():
             if not line:
                 continue
             item, rank = line.split(" ")
             item_to_rank[item] = int(rank)
 
-    with open(prefix + "FreqItems") as f:
+    with _open(prefix + "FreqItems") as f:
         freq_items = [l for l in f.read().splitlines() if l != ""]
     freq_items.sort(key=lambda i: item_to_rank[i])
 
     freq_itemsets: List[ItemsetWithCount] = []
-    with open(prefix + "freqItems") as f:
+    with _open(prefix + "freqItems") as f:
         for line in f.read().splitlines():
             if not line:
                 continue

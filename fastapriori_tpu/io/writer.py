@@ -6,6 +6,11 @@ file at ``<output>freqItemset`` / ``<output>recommends`` with byte-identical
 *content*: itemset lines print ranks in descending order mapped back to item
 strings, the whole file sorted lexicographically (Utils.scala:36-39);
 recommends are sorted by row index, one item per line (Utils.scala:48).
+
+Remote output prefixes (``hdfs://``, ``gs://``, ``memory://`` …) go through
+fsspec, mirroring the reader's ingest path — the reference wrote its
+results to HDFS (Utils.scala:36-40,48; run instructions README.md:33), so
+a remote *output* is part of the parity surface, not just input.
 """
 
 from __future__ import annotations
@@ -15,9 +20,27 @@ from typing import Iterable, Sequence, Tuple
 
 
 def _ensure_parent(path: str) -> None:
+    if "://" in path:
+        return  # remote filesystems create intermediate keys implicitly
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
+
+
+def open_write(path: str):
+    """``open(path, "w")`` with an fsspec branch for remote URLs —
+    the writer twin of ``fastapriori_tpu.io.reader._open``."""
+    if "://" in path:
+        try:
+            import fsspec
+
+            return fsspec.open(path, "w").open()
+        except ImportError as e:  # pragma: no cover - environment dependent
+            raise RuntimeError(
+                f"remote output path {path!r} requires fsspec, which is "
+                "not installed; write to a local path instead"
+            ) from e
+    return open(path, "w")
 
 
 def format_itemset_line(ranks: Iterable[int], freq_items: Sequence[str]) -> str:
@@ -38,7 +61,7 @@ def save_freq_itemsets(
     lines.sort()
     path = output_prefix + "freqItemset"
     _ensure_parent(path)
-    with open(path, "w") as f:
+    with open_write(path) as f:
         f.writelines(line + "\n" for line in lines)
     return path
 
@@ -59,7 +82,7 @@ def save_freq_itemsets_with_count(
     lines.sort()
     path = output_prefix + "freqItems"
     _ensure_parent(path)
-    with open(path, "w") as f:
+    with open_write(path) as f:
         f.writelines(line + "\n" for line in lines)
     return path
 
@@ -71,7 +94,7 @@ def save_recommends(
     recommended item (or "0") per line (Utils.scala:43-49)."""
     path = output_prefix + "recommends"
     _ensure_parent(path)
-    with open(path, "w") as f:
+    with open_write(path) as f:
         f.writelines(
             item + "\n" for _, item in sorted(recommends, key=lambda x: x[0])
         )

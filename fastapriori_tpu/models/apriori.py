@@ -213,7 +213,11 @@ class FastApriori:
                 packed_np, ctx.sharding_rows()
             )
             w = jax.device_put(w_np, ctx.sharding_vector())
-            m.update(shape=[t_pad, f_pad], digits=n_digits)
+            m.update(
+                shape=[t_pad, f_pad],
+                digits=n_digits,
+                upload_bytes=packed_np.nbytes + w_np.nbytes,
+            )
 
         # Size the row budget from the actual level-2 survivor count (a
         # one-matmul pre-pass over the already-uploaded packed bitmap)
@@ -232,7 +236,11 @@ class FastApriori:
                         packed, w, jnp.int32(data.min_count)
                     )
                 )
-                met.update(n2=n2)
+                met.update(
+                    n2=n2,
+                    macs=n_digits * t_pad * f_pad * f_pad,
+                    psum_bytes=4 * f_pad * f_pad,
+                )
             m_cap = min(
                 max(
                     _next_pow2(2 * max(n2, 1)),
@@ -260,7 +268,25 @@ class FastApriori:
                 rows, cols, counts, n_lvl, incomplete, overflow = (
                     fused.unpack_fused_result(packed_out, cfg.fused_l_max)
                 )
-                met.update(incomplete=incomplete, overflow=overflow)
+                # MAC estimate for the MFU report: level 2 is D Gram
+                # matmuls over [t_pad, f_pad]; each while-loop iteration
+                # (one per level >= 3, plus the terminating check's last
+                # full iteration) does the candidate-gen pair of
+                # [m_cap, m_cap/f_pad] matmuls plus the membership +
+                # D counting matmuls over [t_pad, m_cap, f_pad].
+                n_iters = max(int(np.count_nonzero(n_lvl)), 1)
+                met.update(
+                    incomplete=incomplete,
+                    overflow=overflow,
+                    macs=n_digits * t_pad * f_pad * f_pad
+                    + (n_iters - 1)
+                    * (
+                        2 * m_cap * m_cap * f_pad
+                        + (1 + n_digits) * t_pad * m_cap * f_pad
+                    ),
+                    psum_bytes=4 * f_pad * f_pad
+                    + (n_iters - 1) * 4 * m_cap * f_pad,
+                )
             if not incomplete:
                 ctx.record_fused_m_cap(profile, m_cap)
                 return (
@@ -335,6 +361,15 @@ class FastApriori:
             if use_pallas:
                 n_chunks = 1
                 txn_multiple = T_TILE * ctx.txn_shards
+            # CPU backends: ONE f32 matmul per phase (BLAS) instead of D
+            # int8 matmuls — XLA-CPU integer matmuls are orders slower.
+            # Exact while every count < 2^24 (counts are bounded by the
+            # raw transaction total); TPU always keeps the int8 MXU path.
+            fast_f32 = (
+                ctx.platform == "cpu"
+                and not use_pallas
+                and data.n_raw < 2**24
+            )
             packed_np, f_pad = build_packed_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
@@ -352,6 +387,8 @@ class FastApriori:
                 shape=[t_pad, f_pad],
                 digits=len(scales),
                 pallas=use_pallas,
+                fast_f32=fast_f32,
+                upload_bytes=packed_np.nbytes + w_digits_np.nbytes,
             )
 
         # Frequent k-sets live as a lex-sorted int32 [M, k] matrix between
@@ -370,11 +407,14 @@ class FastApriori:
             # the surviving pairs are transferred (local_pair_gather).
             with self.metrics.timed("level", k=2) as m:
                 cap = cfg.pair_cap
+                attempts = 0
                 while True:
+                    attempts += 1
                     idx, cnt, n2 = (
                         np.asarray(a)
                         for a in ctx.pair_gather(
-                            bitmap, w_digits, scales, min_count, f, cap
+                            bitmap, w_digits, scales, min_count, f, cap,
+                            fast_f32,
                         )
                     )
                     n2 = int(n2)
@@ -387,7 +427,13 @@ class FastApriori:
                     np.int32
                 )  # row-major upper triangle => already lex-sorted
                 levels.append((cur, cnt.astype(np.int64)))
-                m.update(candidates=f * (f - 1) // 2, frequent=n2)
+                d_eff = 1 if fast_f32 else len(scales)
+                m.update(
+                    candidates=f * (f - 1) // 2,
+                    frequent=n2,
+                    macs=attempts * d_eff * t_pad * f_pad * f_pad,
+                    psum_bytes=attempts * 4 * f_pad * f_pad,
+                )
 
         # Levels >=3 (C7 + C8), reference termination rule
         # (FastApriori.scala:111).
@@ -395,7 +441,7 @@ class FastApriori:
         while cur.shape[0] >= k:
             with self.metrics.timed("level", k=k) as m:
                 x_idx, ys = gen_candidates_arrays(cur)
-                nxt, nxt_counts = self._count_level(
+                nxt, nxt_counts, lvl_stats = self._count_level(
                     ctx,
                     bitmap,
                     w_digits,
@@ -406,9 +452,12 @@ class FastApriori:
                     min_count,
                     n_chunks,
                     use_pallas,
+                    fast_f32,
                 )
                 m.update(
-                    candidates=int(x_idx.size), frequent=nxt.shape[0]
+                    candidates=int(x_idx.size),
+                    frequent=nxt.shape[0],
+                    **lvl_stats,
                 )
             levels.append((nxt, nxt_counts))
             cur = nxt
@@ -435,18 +484,22 @@ class FastApriori:
         min_count: int,
         n_chunks: int,
         use_pallas: bool = False,
-    ) -> Tuple[np.ndarray, np.ndarray]:
+        fast_f32: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray, dict]:
         """C8 for one level, transfer-minimal: greedy chunks of at most
         P_CAP prefixes / C_CAP candidates go through the compiled-once
         gather kernel (ops/count.py local_level_gather); only each
         candidate's own count comes back.  Candidates arrive as (x_idx, ys)
         pairs ordered by (x_idx, y) from :func:`gen_candidates_arrays`;
-        returns the next level's lex-sorted matrix and its counts."""
+        returns the next level's lex-sorted matrix, its counts, and a
+        stats dict (kernel dispatches, MAC count, psum bytes) for the
+        per-level metrics."""
         cfg = self.config
         s = level.shape[1]
         empty = (
             np.empty((0, s + 1), dtype=np.int32),
             np.empty(0, dtype=np.int64),
+            {"dispatches": 0, "macs": 0, "psum_bytes": 0},
         )
         if x_idx.size == 0:
             return empty
@@ -459,7 +512,23 @@ class FastApriori:
         # per-prefix runs — each shard's budget must fit at least one run.
         # With cand_shards == 1 this is exactly the old single-block path.
         n_cs = ctx.cand_shards
-        p_sh = max(4096 // n_cs, 1)
+        # x_idx is sorted, so each unique prefix's candidates are one
+        # contiguous run; blocks take whole runs.
+        uniq_x, run_start = np.unique(x_idx, return_index=True)
+        run_end = np.concatenate([run_start[1:], [x_idx.size]])
+        # Right-size the prefix budget to THIS level's actual prefix
+        # count, in power-of-two buckets (compiles stay bounded: at most
+        # log2(4096/128) sizes) up to the 4096-row transfer-amortization
+        # cap.  A fixed 4096 made every small level pay the full padded
+        # [T, 4096] membership matmul — ~145 GMAC for a 1-candidate level
+        # at T10I4D100K scale, the whole CPU-fallback regression.
+        p_sh = min(
+            max(
+                _next_pow2(-(-uniq_x.size // n_cs)),
+                max(cfg.min_prefix_bucket // n_cs, 1),
+            ),
+            max(4096 // n_cs, 1),
+        )
         if use_pallas:
             from fastapriori_tpu.ops.pallas_level import M_TILE
 
@@ -471,10 +540,6 @@ class FastApriori:
         k_pad = cfg.level_k_max
         if s > k_pad:  # deeper than the padded width: widen (recompiles)
             k_pad = ((s + 7) // 8) * 8
-        # x_idx is sorted, so each unique prefix's candidates are one
-        # contiguous run; blocks take whole runs.
-        uniq_x, run_start = np.unique(x_idx, return_index=True)
-        run_end = np.concatenate([run_start[1:], [x_idx.size]])
         counts_all = np.empty(x_idx.size, dtype=np.int64)
         # Dispatch every chunk before fetching any result: each blocking
         # fetch costs a full host<->device round trip (tens of ms on
@@ -530,22 +595,33 @@ class FastApriori:
                     s,
                     cand_idx,
                     n_chunks,
+                    fast_f32,
                 )
             try:
                 out.copy_to_host_async()
             except (AttributeError, NotImplementedError):
                 pass
             inflight.append((placed, out))
+        # Per-dispatch cost model (for the metrics/MFU report): membership
+        # matmul [T, P_cap] x counting matmuls [P_cap, F] over the padded
+        # global shapes; psum reduces the [C_cap] candidate gather.
+        t_pad = bitmap.shape[0]
+        d_eff = 1 if fast_f32 else len(scales)
+        stats = {
+            "dispatches": len(inflight),
+            "macs": len(inflight) * (1 + d_eff) * t_pad * p_cap * f_pad,
+            "psum_bytes": len(inflight) * 4 * c_cap,
+        }
         for placed, out in inflight:
             arr = np.asarray(out)
             for ci, off, n_c in placed:
                 counts_all[ci] = arr[off : off + n_c]
         keep = counts_all >= min_count
         if not keep.any():
-            return empty
+            return empty[0], empty[1], stats
         nxt = np.concatenate(
             [level[x_idx[keep]], ys[keep, None]], axis=1
         ).astype(np.int32)
         # (x_idx, ys) is ordered by (x_idx, y) and level is lex-sorted, so
         # nxt is already lex-sorted — the invariant the next join needs.
-        return nxt, counts_all[keep]
+        return nxt, counts_all[keep], stats

@@ -93,6 +93,17 @@ def local_level_counts(
     return _psum_if(counts, axis_name)
 
 
+def _weights_f32(w_digits: jnp.ndarray, scales: Sequence[int]) -> jnp.ndarray:
+    """Reassemble the per-transaction weights from their base-128 digits as
+    float32 (exact: callers gate the f32 path on total counts < 2^24)."""
+    w = None
+    for d, scale in enumerate(scales):
+        part = w_digits[d].astype(jnp.float32)
+        part = part if scale == 1 else part * jnp.float32(scale)
+        w = part if w is None else w + part
+    return w
+
+
 def local_pair_gather(
     bitmap: jnp.ndarray,  # [T_local, F] int8
     w_digits: jnp.ndarray,  # [D, T_local] int8
@@ -101,6 +112,7 @@ def local_pair_gather(
     num_items: jnp.ndarray,  # () int32 (traced) — real F before padding
     cap: int,
     axis_name: Optional[str] = None,
+    fast_f32: bool = False,
 ) -> tuple:
     """C6, transfer-minimal form: the pair Gram matmul PLUS the threshold,
     on device.  Only surviving pairs leave the chip: returns
@@ -109,9 +121,23 @@ def local_pair_gather(
     (``i = idx // F``, ``j = idx % F``).  ``n2 > cap`` signals overflow —
     the caller retries with a doubled cap.  Replaces transferring the full
     [F, F] table (16 MB at F=2048) with ~2·cap·4 bytes.
+
+    ``fast_f32``: run the Gram matmul as ONE float32 matmul (BLAS path on
+    CPU backends, where XLA int8 matmuls are orders slower).  Exact only
+    when the caller has proven every count < 2^24.
     """
     f = bitmap.shape[1]
-    counts = _weighted_matmul(bitmap, bitmap, w_digits, scales)
+    if fast_f32:
+        b_f = bitmap.astype(jnp.float32)
+        scaled = b_f * _weights_f32(w_digits, scales)[:, None]
+        counts = lax.dot_general(
+            scaled,
+            b_f,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+    else:
+        counts = _weighted_matmul(bitmap, bitmap, w_digits, scales)
     counts = _psum_if(counts, axis_name)
     iu = jnp.arange(f)
     upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
@@ -132,6 +158,7 @@ def local_level_gather(
     n_chunks: int,
     axis_name: Optional[str] = None,
     cand_axis_name: Optional[str] = None,
+    fast_f32: bool = False,
 ) -> jnp.ndarray:
     """C8, transfer-minimal form: one compilation serves EVERY level.
 
@@ -156,12 +183,18 @@ def local_level_gather(
     positions add 0 to the membership count and padded rows match only a
     k1 of 0 (never used: k1 >= 2).  Padded ``cand_idx`` entries gather a
     garbage count that callers slice off.
+
+    ``fast_f32``: both matmuls run in float32 (BLAS on CPU backends) with
+    the weights folded into the membership mask — ONE counting matmul
+    instead of D digit matmuls.  Exact only when counts < 2^24 (caller's
+    guard); intersection sizes are bounded by F, also f32-exact.
     """
     t_loc, f_pad = bitmap.shape
     p = prefix_cols.shape[0]
     d = w_digits.shape[0]
+    onehot_dt = jnp.float32 if fast_f32 else jnp.int8
     onehot = (
-        jnp.zeros((p, f_pad), jnp.int8)
+        jnp.zeros((p, f_pad), onehot_dt)
         .at[jnp.arange(p)[:, None], prefix_cols]
         .set(1)
     )
@@ -171,6 +204,25 @@ def local_level_gather(
 
     def body(acc, xs):
         b_chunk, wd_chunk = xs  # [tc, F] int8, [D, tc] int8
+        if fast_f32:
+            b_f = b_chunk.astype(jnp.float32)
+            member = lax.dot_general(
+                b_f,
+                onehot,
+                (((1,), (1,)), ((), ())),  # contract over F -> [tc, P]
+                preferred_element_type=jnp.float32,
+            )
+            w_f = _weights_f32(wd_chunk, scales)  # [tc]
+            scaled = jnp.where(
+                member == k1.astype(jnp.float32), w_f[:, None], 0.0
+            )
+            total = lax.dot_general(
+                scaled,
+                b_f,
+                (((0,), (0,)), ((), ())),  # contract over tc -> [P, F]
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            return acc + total, None
         member = lax.dot_general(
             b_chunk,
             onehot,

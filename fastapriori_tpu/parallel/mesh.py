@@ -243,11 +243,11 @@ class DeviceContext:
 
     def pair_gather(
         self, bitmap, w_digits, scales, min_count: int, num_items: int,
-        cap: int,
+        cap: int, fast_f32: bool = False,
     ):
         """On-device pair threshold (ops/count.py local_pair_gather);
         returns (flat_idx, counts, n2) numpy-convertible arrays."""
-        key = ("pair_gather", tuple(scales), cap)
+        key = ("pair_gather", tuple(scales), cap, fast_f32)
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
@@ -255,7 +255,7 @@ class DeviceContext:
             def _local(bitmap, w_digits, min_count, num_items):
                 return count_ops.local_pair_gather(
                     bitmap, w_digits, scl, min_count, num_items, cap,
-                    axis_name=AXIS,
+                    axis_name=AXIS, fast_f32=fast_f32,
                 )
 
             self._fns[key] = jax.jit(
@@ -279,11 +279,12 @@ class DeviceContext:
         k1: int,
         cand_idx,
         n_chunks: int,
+        fast_f32: bool = False,
     ) -> jax.Array:
         """Transfer-minimal level kernel (ops/count.py
         local_level_gather): one compilation serves every level — k1 is
         traced and prefix_cols has a fixed padded width."""
-        key = ("level_gather", tuple(scales), n_chunks)
+        key = ("level_gather", tuple(scales), n_chunks, fast_f32)
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
@@ -299,6 +300,7 @@ class DeviceContext:
                     n_chunks,
                     axis_name=AXIS,
                     cand_axis_name=CAND,
+                    fast_f32=fast_f32,
                 )
 
             self._fns[key] = jax.jit(

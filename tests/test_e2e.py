@@ -96,6 +96,39 @@ def test_cli_fuzz_adversarial_tokens_matches_oracle(tmp_path, seed):
     assert (tmp_path / "out" / "recommends").read_text() == exp_rec
 
 
+def test_cli_end_to_end_remote_output_via_fsspec(tmp_path):
+    # Remote OUTPUT prefix (the reference saved its results to HDFS,
+    # Utils.scala:36-40,48): the full CLI pipeline writing freqItemset /
+    # recommends to fsspec's in-process memory filesystem, byte-identical
+    # to a local run.  Resume artifacts round-trip remotely too.
+    fsspec = pytest.importorskip("fsspec")
+    d_raw = random_dataset(5)
+    u_raw = random_dataset(15, n_txns=20)
+    inp, outp = _write_inputs(tmp_path, d_raw, u_raw)
+
+    rc = main([inp, outp, "--min-support", "0.08"])
+    assert rc == 0
+    rc = main(
+        [inp, "memory://fa_out/", "--min-support", "0.08", "--save-counts"]
+    )
+    assert rc == 0
+
+    fs = fsspec.filesystem("memory")
+    for name in ("freqItemset", "recommends"):
+        assert (
+            fs.cat(f"/fa_out/{name}").decode()
+            == (tmp_path / "out" / name).read_text()
+        )
+    # Phase-2-only resume FROM the remote artifacts into a local dir.
+    (tmp_path / "out2").mkdir()
+    outp2 = str(tmp_path / "out2") + "/"
+    rc = main([inp, outp2, "--resume-from", "memory://fa_out/"])
+    assert rc == 0
+    assert (tmp_path / "out2" / "recommends").read_text() == (
+        tmp_path / "out" / "recommends"
+    ).read_text()
+
+
 def test_reader_remote_path_via_fsspec():
     # The "://"-triggered fsspec branch (HDFS/GCS analog of the
     # reference's sc.textFile over HDFS, Utils.scala:21) — exercised with
