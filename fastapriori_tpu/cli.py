@@ -119,7 +119,29 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    """Parse args and run; user-correctable problems (missing input
+    files, malformed resume artifacts — InputError/FileNotFoundError)
+    print a one-line actionable message and return 2 instead of dumping a
+    traceback (the reference stack-traces on all of these)."""
+    from fastapriori_tpu.errors import InputError
+
+    try:
+        return _run(build_parser().parse_args(argv))
+    except InputError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except FileNotFoundError as e:
+        missing = e.filename if e.filename else str(e)
+        print(
+            f"error: input file {missing!r} not found — the input prefix "
+            "must point at D.dat and U.dat (prefix + 'D.dat', trailing "
+            "slash matters, as with the reference)",
+            file=sys.stderr,
+        )
+        return 2
+
+
+def _run(args) -> int:
     config = MinerConfig(
         min_support=args.min_support,
         num_devices=args.num_devices,

@@ -65,10 +65,19 @@ class MinerConfig:
     # Fused engine: floor for the starting per-level frequent-set row
     # budget (the budget itself is sized from the level-2 survivor count
     # pre-pass).  On overflow the engine re-compiles with a budget sized
-    # from the overflowing level's true survivor count, up to
-    # fused_m_cap_max, then falls back to the per-level engine.
+    # from the overflowing level's true survivor count, up to the
+    # memory-derived ceiling (min of fused_m_cap_max and what fits the
+    # device HBM budget — models/apriori.py _fused_m_cap_memory_limit),
+    # then falls back to the per-level engine.
     fused_m_cap: int = 512
     fused_m_cap_max: int = 32768
+    # HBM budget for sizing that ceiling.  None = read the device's
+    # bytes_limit (16 GiB assumed when the backend doesn't report one)
+    # and keep `fused_hbm_fraction` of it for the mining program — the
+    # rest covers XLA workspace/fragmentation.  Tests inject a tiny
+    # budget here to drive the salvage path without real memory pressure.
+    fused_hbm_budget_bytes: Optional[int] = None
+    fused_hbm_fraction: float = 0.5
     # Fused engine: max Apriori levels held in the output buffers.
     fused_l_max: int = 24
     # Fused engine: per-device transaction-chunk target — bounds the

@@ -1,0 +1,15 @@
+"""User-facing error type.
+
+The reference surfaces every user mistake as a raw JVM stack trace (missing
+HDFS path, malformed resume file — nothing in Main.scala/Utils.scala guards
+inputs).  Here user-correctable problems raise :class:`InputError`, which
+the CLI renders as a one-line actionable message (exit code 2) instead of a
+traceback; programmatic callers can still catch it like any exception.
+"""
+
+from __future__ import annotations
+
+
+class InputError(Exception):
+    """A problem the user can fix (missing file, malformed artifact,
+    inconsistent input data) — message is the full, actionable text."""

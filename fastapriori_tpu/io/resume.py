@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Dict, FrozenSet, List, Sequence, Tuple
 
+from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.io.reader import _open
 from fastapriori_tpu.io.writer import (
     _ensure_parent,
@@ -45,35 +46,74 @@ def save_phase1(
         f.writelines(f"{item} {rank}\n" for item, rank in item_to_rank.items())
 
 
+def _read_artifact(prefix: str, name: str) -> List[str]:
+    path = prefix + name
+    try:
+        with _open(path) as f:
+            return f.read().splitlines()
+    except FileNotFoundError:
+        raise InputError(
+            f"resume artifact {path!r} not found — --resume-from needs the "
+            "three files a --save-counts run writes (freqItems, FreqItems, "
+            "ItemsToRank) under the given prefix"
+        ) from None
+
+
 def load_phase1(
     prefix: str,
 ) -> Tuple[List[ItemsetWithCount], Dict[str, int], List[str]]:
     """Reconstruct ``(freqItemsets, itemToRank, freqItems)`` from saved
     artifacts (mirrors Utils.getAll, Utils.scala:65-81: rank map parsed
     from "item rank" lines; items sorted by rank; itemset lines split on
-    ``[`` with the trailing count)."""
+    ``[`` with the trailing count).
+
+    Malformed lines raise :class:`InputError` naming the file and line —
+    the reference's parser (hardcoded paths, blind splits) would throw a
+    bare NumberFormatException/MatchError instead."""
     item_to_rank: Dict[str, int] = {}
-    with _open(prefix + "ItemsToRank") as f:
-        for line in f.read().splitlines():
-            if not line:
-                continue
+    for lineno, line in enumerate(_read_artifact(prefix, "ItemsToRank"), 1):
+        if not line:
+            continue
+        try:
             item, rank = line.split(" ")
             item_to_rank[item] = int(rank)
+        except ValueError:
+            raise InputError(
+                f"malformed resume artifact {prefix + 'ItemsToRank'!r} "
+                f"line {lineno}: expected '<item> <rank>', got {line!r}"
+            ) from None
 
-    with _open(prefix + "FreqItems") as f:
-        freq_items = [l for l in f.read().splitlines() if l != ""]
-    freq_items.sort(key=lambda i: item_to_rank[i])
+    freq_items = [l for l in _read_artifact(prefix, "FreqItems") if l != ""]
+    try:
+        freq_items.sort(key=lambda i: item_to_rank[i])
+    except KeyError as e:
+        raise InputError(
+            f"resume artifacts disagree: item {e.args[0]!r} appears in "
+            f"{prefix + 'FreqItems'!r} but not in "
+            f"{prefix + 'ItemsToRank'!r} — the artifacts are from "
+            "different runs or were edited"
+        ) from None
 
     freq_itemsets: List[ItemsetWithCount] = []
-    with _open(prefix + "freqItems") as f:
-        for line in f.read().splitlines():
-            if not line:
-                continue
-            # "<item> <item> ...[count]" (Utils.scala:60,75-77)
-            body = line.replace("[", " ").replace("]", "")
-            parts = body.split(" ")
-            items, count = parts[:-1], int(parts[-1])
+    for lineno, line in enumerate(_read_artifact(prefix, "freqItems"), 1):
+        if not line:
+            continue
+        # "<item> <item> ...[count]" (Utils.scala:60,75-77).  Strict: the
+        # [count] suffix is required — a permissive split would silently
+        # misparse "7 8" (no count) as itemset {7} with count 8.
+        body, sep, cnt = line.rpartition("[")
+        try:
+            if not sep or not cnt.endswith("]"):
+                raise ValueError
+            count = int(cnt[:-1])
+            items = body.split(" ")
             freq_itemsets.append(
                 (frozenset(item_to_rank[i] for i in items), count)
             )
+        except (ValueError, KeyError):
+            raise InputError(
+                f"malformed resume artifact {prefix + 'freqItems'!r} "
+                f"line {lineno}: expected '<item> <item> ...[count]' with "
+                f"items from ItemsToRank, got {line!r}"
+            ) from None
     return freq_itemsets, item_to_rank, freq_items

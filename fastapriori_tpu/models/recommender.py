@@ -153,6 +153,8 @@ class AssociationRules:
         best = ctx.shard_weights_like(
             np.full(nb_pad, int(NO_MATCH), dtype=np.int32)
         )
+        best_np = None
+        prev = None  # previous chunk's best (async copy in flight)
         for c0 in range(0, r_pad, chunk):
             hi = min(c0 + chunk, r)
             n_c = hi - c0  # real rules in this chunk (0 for pure padding)
@@ -175,10 +177,26 @@ class AssociationRules:
                 c0,
                 best,
             )
+            try:
+                best.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass
+            # Early-exit on the PREVIOUS chunk's (already in-flight)
+            # result: lagging the check by one chunk keeps consecutive
+            # dispatches overlapped instead of paying a blocking
+            # host<->device round trip per chunk.  Exiting on the lagged
+            # state is exact — later chunks hold only larger rule
+            # indices, so once every basket has matched the running min
+            # cannot change.
+            if prev is not None:
+                prev_np = np.asarray(prev)
+                if (prev_np[:nb] < int(NO_MATCH)).all():
+                    best_np = prev_np
+                    break
+            prev = best
+        if best_np is None:
             best_np = np.asarray(best)
-            if (best_np[:nb] < int(NO_MATCH)).all():
-                break
-        best_np = best_np[:nb]  # from the loop's early-exit fetch
+        best_np = best_np[:nb]
         found = best_np < int(NO_MATCH)
         rec = np.where(found, consequent[np.minimum(best_np, r_pad - 1)], -1)
         return [int(x) for x in rec]

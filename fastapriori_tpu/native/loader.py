@@ -163,9 +163,15 @@ def fill_packed_bitmap(
     assert out.shape[0] >= n_baskets
     if len(indices):
         # The C filler does no bounds checks (the numpy fallback's fancy
-        # indexing would raise); fence inconsistent CSR input here.
+        # indexing would raise); fence inconsistent CSR input here.  A
+        # real exception, not an assert: under `python -O` an assert
+        # vanishes and out-of-range indices would corrupt the heap.
         lo, hi = int(indices.min()), int(indices.max())
-        assert 0 <= lo and hi < out.shape[1] * 8, (lo, hi, out.shape)
+        if lo < 0 or hi >= out.shape[1] * 8:
+            raise ValueError(
+                f"CSR item index out of range for the packed bitmap: "
+                f"min={lo}, max={hi}, columns={out.shape[1] * 8}"
+            )
     lib.fa_fill_packed_bitmap(
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),

@@ -26,7 +26,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 AXIS = "txn"
 
 
-NO_MATCH = jnp.int32(2**31 - 1)  # "no rule yet" sentinel in `best`
+# "No rule yet" sentinel in `best`.  A plain Python int, cast inside the
+# traced kernels — a module-scope jnp scalar would initialize the JAX
+# backend at import time (imports must stay backend-free so the CLI can
+# fail gracefully when the accelerator tunnel is down).
+NO_MATCH = 2**31 - 1
 
 
 def local_first_match_chunk(
@@ -59,7 +63,7 @@ def local_first_match_chunk(
     idx = jnp.where(
         eligible,
         jnp.arange(rc, dtype=jnp.int32)[None, :] + base,
-        NO_MATCH,
+        jnp.int32(NO_MATCH),
     )
     return jnp.minimum(best, jnp.min(idx, axis=1))
 

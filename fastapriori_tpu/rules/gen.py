@@ -27,6 +27,8 @@ from typing import Dict, FrozenSet, List, Sequence, Tuple
 
 import numpy as np
 
+from fastapriori_tpu.errors import InputError
+
 Rule = Tuple[FrozenSet[int], int, float]  # (antecedent, consequent, confidence)
 
 
@@ -74,13 +76,20 @@ def gen_rules(
     # Raw rules (S - {i}) -> i with confidence count(S)/count(S - {i})
     # (:129-145); the size-1 denominator is the raw occurrence count, via
     # the 1-itemset table.  Downward closure guarantees every antecedent
-    # is present (KeyError otherwise, like the reference's table lookup).
+    # is present (InputError otherwise — reachable only via corrupted
+    # --resume-from artifacts; the reference would throw a bare
+    # NoSuchElementException from its table lookup).
     raw: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
     for k in sorted(mats):
         if k < 2:
             continue
         if k - 1 not in mats:
-            raise KeyError(f"missing {k - 1}-itemset table")
+            raise InputError(
+                f"itemset table is not downward-closed: {k}-itemsets are "
+                f"present but no {k - 1}-itemsets exist to serve as rule "
+                "antecedents — the mining output (or --resume-from "
+                "artifact) is incomplete; re-mine or re-save it"
+            )
         mat, cnts = mats[k]
         pmat, pcnts = mats[k - 1]
         pview = _rows_view(pmat)
@@ -91,7 +100,13 @@ def gen_rules(
             ant = np.delete(mat, j, axis=1)  # sorted rows stay sorted
             idx, found = _lookup_rows(psorted, porder, _rows_view(ant))
             if not found.all():
-                raise KeyError("antecedent missing from itemset table")
+                bad = frozenset(ant[int(np.argmin(found))].tolist())
+                raise InputError(
+                    f"itemset table is not downward-closed: antecedent "
+                    f"{sorted(bad)} (ranks) of a {k}-itemset is missing "
+                    "from the table — the mining output (or --resume-from "
+                    "artifact) is incomplete; re-mine or re-save it"
+                )
             # IEEE double division of two int counts — identical to the
             # reference's JVM division, so >= comparisons agree exactly.
             ants.append(ant)

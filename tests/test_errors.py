@@ -1,0 +1,113 @@
+"""Friendly-failure paths: user mistakes produce one-line actionable
+messages (exit code 2), never tracebacks — the reference stack-traces on
+every one of these (missing HDFS path, blind parses in Utils.getAll,
+NoSuchElementException in the rule-table lookup)."""
+
+import pytest
+
+from fastapriori_tpu.cli import main
+from fastapriori_tpu.errors import InputError
+
+
+def test_missing_input_dir(tmp_path, capsys):
+    rc = main([str(tmp_path / "nope") + "/", str(tmp_path) + "/"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "error:" in err and ("U.dat" in err or "D.dat" in err)
+
+
+def test_missing_d_dat_only(tmp_path, capsys):
+    (tmp_path / "U.dat").write_text("1 2\n")
+    rc = main([str(tmp_path) + "/", str(tmp_path) + "/"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "error:" in err and "D.dat" in err
+
+
+def test_resume_prefix_missing(tmp_path, capsys):
+    (tmp_path / "U.dat").write_text("1 2\n")
+    rc = main(
+        [
+            str(tmp_path) + "/",
+            str(tmp_path) + "/",
+            "--resume-from",
+            str(tmp_path / "ckpt") + "/",
+        ]
+    )
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "error:" in err and "--save-counts" in err
+
+
+@pytest.mark.parametrize(
+    "name,content,needle",
+    [
+        ("ItemsToRank", "7 0\nbogus line here\n", "ItemsToRank"),
+        ("ItemsToRank", "7 notanint\n", "ItemsToRank"),
+        ("freqItems", "7[nope]\n", "freqItems"),
+        ("freqItems", "7 8\n", "freqItems"),  # no [count]
+        ("freqItems", "9[3]\n", "freqItems"),  # item missing from rank map
+    ],
+)
+def test_malformed_resume_artifacts(tmp_path, capsys, name, content, needle):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "ItemsToRank").write_text("7 0\n8 1\n")
+    (ckpt / "FreqItems").write_text("7\n8\n")
+    (ckpt / "freqItems").write_text("8 7[3]\n")
+    (ckpt / name).write_text(content)
+    (tmp_path / "U.dat").write_text("7 8\n")
+    rc = main(
+        [
+            str(tmp_path) + "/",
+            str(tmp_path) + "/",
+            "--resume-from",
+            str(ckpt) + "/",
+        ]
+    )
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "error:" in err and needle in err
+    assert "Traceback" not in err
+
+
+def test_resume_artifacts_from_different_runs(tmp_path, capsys):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "ItemsToRank").write_text("7 0\n")
+    (ckpt / "FreqItems").write_text("7\n8\n")  # 8 not in rank map
+    (ckpt / "freqItems").write_text("")
+    (tmp_path / "U.dat").write_text("7\n")
+    rc = main(
+        [
+            str(tmp_path) + "/",
+            str(tmp_path) + "/",
+            "--resume-from",
+            str(ckpt) + "/",
+        ]
+    )
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "disagree" in err and "Traceback" not in err
+
+
+def test_gen_rules_not_downward_closed():
+    from fastapriori_tpu.rules.gen import gen_rules
+
+    # 3-itemset with no 2-itemsets at all.
+    with pytest.raises(InputError, match="downward-closed"):
+        gen_rules([(frozenset({0, 1, 2}), 5), (frozenset({0}), 9)])
+
+    # 2-itemsets exist but one antecedent is absent.
+    with pytest.raises(InputError, match="downward-closed"):
+        gen_rules(
+            [
+                (frozenset({0, 1, 2}), 5),
+                (frozenset({0, 1}), 6),
+                (frozenset({0, 2}), 6),
+                # {1, 2} missing
+                (frozenset({0}), 9),
+                (frozenset({1}), 9),
+                (frozenset({2}), 9),
+            ]
+        )

@@ -142,3 +142,46 @@ def test_fused_weighted_digits():
     expected, _, _ = oracle.mine(lines, 0.05)
     got = _mine(lines, 0.05, engine="fused", num_devices=1)
     assert dict(got) == dict(expected)
+
+
+def test_fused_m_cap_memory_clamp_and_salvage():
+    """A tiny injected HBM budget must clamp the row-budget ceiling BELOW
+    the configured fused_m_cap_max (so the oversized program is never
+    compiled), and a dataset whose levels outgrow that ceiling must
+    salvage-resume through the level engine bit-exactly (VERDICT weak #5:
+    no compile-then-OOM path reachable)."""
+    lines = tokenized(
+        [" ".join(str(i) for i in range(1, 15))] * 10 + ["20 21"]
+    )
+    expected, _, _ = oracle.mine(lines, 0.5)
+    cfg = MinerConfig(
+        min_support=0.5, engine="fused", num_devices=1,
+        fused_m_cap=4, min_prefix_bucket=1, fused_m_cap_max=32768,
+        fused_hbm_budget_bytes=space_budget_for_m(256),
+    )
+    miner = FastApriori(config=cfg)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    events = {r["event"] for r in miner.metrics.records}
+    assert "fused_m_cap_clamp" in events
+    clamp = next(
+        r for r in miner.metrics.records if r["event"] == "fused_m_cap_clamp"
+    )
+    assert clamp["memory_limit"] < 32768
+    attempts = [
+        r["m_cap"]
+        for r in miner.metrics.records
+        if r["event"] == "fused_mine"
+    ]
+    # No attempt ever exceeded the memory-derived ceiling, and the run
+    # ended in a level-engine salvage (levels 5-6 need >256 rows).
+    assert attempts and max(attempts) <= clamp["memory_limit"]
+    assert "fused_fallback" in events
+
+
+def space_budget_for_m(m_target):
+    """HBM budget that admits ~m_target rows under the engine's byte
+    model (keeps the test decoupled from the model's exact constants)."""
+    # From _fused_m_cap_memory_limit's bytes_at with small t_c/f_pad the
+    # quadratic 8*m^2 term dominates; give 2x headroom over it.
+    return 16 * m_target * m_target
