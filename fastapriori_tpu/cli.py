@@ -25,7 +25,7 @@ import time
 from typing import List, Optional
 
 from fastapriori_tpu.config import DEFAULT_MIN_SUPPORT, MinerConfig
-from fastapriori_tpu.io.writer import save_freq_itemsets, save_recommends
+from fastapriori_tpu.io.writer import save_recommends
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -188,6 +188,7 @@ def _run(args) -> int:
     u_lines = read_dat(args.input + "U.dat")
 
     t1 = time.perf_counter()
+    levels = item_counts = None
     if args.resume_from:
         from fastapriori_tpu.io.resume import load_phase1
 
@@ -198,17 +199,26 @@ def _run(args) -> int:
             import jax.profiler as profiler
 
             profiler.start_trace(args.profile_dir)
+        # Matrix-form pipeline: mining result stays as level matrices all
+        # the way into the writer and rule generator — no per-itemset
+        # Python objects (multi-second at 10^6-itemset scale).
         miner = FastApriori(args.min_support, config=config)
-        freq_itemsets, item_to_rank, freq_items = miner.run_file(
-            args.input + "D.dat"
-        )
+        levels, data = miner.run_file_raw(args.input + "D.dat")
+        item_to_rank, freq_items = data.item_to_rank, data.freq_items
+        item_counts = data.item_counts
+        freq_itemsets = []
         if profiler is not None:
             profiler.stop_trace()
-        save_freq_itemsets(args.output, freq_itemsets, freq_items)
-        if args.save_counts:
-            from fastapriori_tpu.io.resume import save_phase1
+        from fastapriori_tpu.io.writer import save_freq_itemsets_levels
 
-            save_phase1(args.output, freq_itemsets, freq_items, item_to_rank)
+        save_freq_itemsets_levels(
+            args.output, levels, item_counts, freq_items,
+            with_counts_path=args.save_counts,
+        )
+        if args.save_counts:
+            from fastapriori_tpu.io.resume import save_phase1_aux
+
+            save_phase1_aux(args.output, freq_items, item_to_rank)
     print(
         "==== Total time for get freqItemsets "
         f"{int((time.perf_counter() - t1) * 1e3)}",
@@ -217,7 +227,8 @@ def _run(args) -> int:
 
     t2 = time.perf_counter()
     recommender = AssociationRules(
-        freq_itemsets, freq_items, item_to_rank, config=config
+        freq_itemsets, freq_items, item_to_rank, config=config,
+        levels=levels, item_counts=item_counts,
     )
     recommends = recommender.run(u_lines)
     save_recommends(args.output, recommends)

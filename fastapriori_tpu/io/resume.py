@@ -36,6 +36,15 @@ def save_phase1(
     ``<prefix>ItemsToRank`` ("item rank" per line, the format
     Utils.getAll parses at Utils.scala:72)."""
     save_freq_itemsets_with_count(prefix, freq_itemsets, freq_items)
+    save_phase1_aux(prefix, freq_items, item_to_rank)
+
+
+def save_phase1_aux(
+    prefix: str, freq_items: Sequence[str], item_to_rank: Dict[str, int]
+) -> None:
+    """The two small phase-1 artifacts (FreqItems, ItemsToRank); the
+    itemset table itself comes from either writer variant (frozenset or
+    matrix form)."""
     path_items = prefix + "FreqItems"
     _ensure_parent(path_items)
     with open_write(path_items) as f:

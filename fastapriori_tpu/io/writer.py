@@ -87,6 +87,67 @@ def save_freq_itemsets_with_count(
     return path
 
 
+def _level_lines(
+    levels, freq_items: Sequence[str], counts_suffix: bool
+) -> list:
+    """Format level matrices (lex-sorted int32 [N, k] member matrices with
+    counts) straight into output lines — no per-itemset Python set ever
+    exists.  Members print in descending rank order (Utils.scala:38
+    ``sortBy(-_)``): matrix rows are ascending, so the reversed row is
+    already the print order; ``numpy.char`` joins whole levels at once."""
+    import numpy as np
+
+    items_arr = np.asarray(freq_items, dtype=np.str_)
+    lines: list = []
+    for mat, cnts in levels:
+        toks = items_arr[mat[:, ::-1]]  # [N, k] descending-rank strings
+        joined = toks[:, 0]
+        for j in range(1, toks.shape[1]):
+            joined = np.char.add(np.char.add(joined, " "), toks[:, j])
+        if counts_suffix:
+            joined = np.char.add(
+                np.char.add(joined, "["),
+                np.char.add(cnts.astype(np.str_), "]"),
+            )
+        lines.extend(joined.tolist())
+    return lines
+
+
+def save_freq_itemsets_levels(
+    output_prefix: str,
+    levels,
+    item_counts,
+    freq_items: Sequence[str],
+    with_counts_path: bool = False,
+) -> str:
+    """Matrix-form twin of :func:`save_freq_itemsets` (+ optionally the
+    ``freqItems`` resume artifact of
+    :func:`save_freq_itemsets_with_count`): formats the level matrices
+    from the raw mining path (FastApriori.run_file_raw) plus the
+    1-itemsets (every rank, counts from C3).  Byte-identical output —
+    golden e2e tests compare it against the oracle's files."""
+    lines = _level_lines(levels, freq_items, counts_suffix=False)
+    lines.extend(freq_items)
+    lines.sort()
+    path = output_prefix + "freqItemset"
+    _ensure_parent(path)
+    with open_write(path) as f:
+        f.writelines(line + "\n" for line in lines)
+    if with_counts_path:
+        import numpy as np
+
+        clines = _level_lines(levels, freq_items, counts_suffix=True)
+        clines.extend(
+            f"{tok}[{int(c)}]"
+            for tok, c in zip(freq_items, np.asarray(item_counts))
+        )
+        clines.sort()
+        cpath = output_prefix + "freqItems"
+        with open_write(cpath) as f:
+            f.writelines(line + "\n" for line in clines)
+    return path
+
+
 def save_recommends(
     output_prefix: str, recommends: Sequence[Tuple[int, str]]
 ) -> str:

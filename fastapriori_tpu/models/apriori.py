@@ -83,6 +83,8 @@ def _fused_m_cap_memory_limit(
             + (3 * cfg.fused_l_max + 1) * m * 4
         )
 
+    if fixed + bytes_at(m) > budget:
+        return 0  # even the floor budget cannot fit: fused is infeasible
     while 2 * m <= cfg.fused_m_cap_max and fixed + bytes_at(2 * m) <= budget:
         m *= 2
     return m
@@ -158,6 +160,23 @@ class FastApriori:
         """Like :meth:`run` but ingesting ``D.dat`` directly from disk, so
         the native preprocessor (when built) parses raw bytes without
         Python tokenization (reference ingest: Utils.scala:21)."""
+        levels, data = self.run_file_raw(d_path)
+        return (
+            self._decode_levels(levels, data),
+            data.item_to_rank,
+            data.freq_items,
+        )
+
+    def run_file_raw(
+        self, d_path: str
+    ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], CompressedData]:
+        """Matrix-form mining: like :meth:`run_file` but the levels >= 2
+        come back as ``[(int32[N, k] member matrix, int64[N] counts), ...]``
+        with NO per-itemset Python objects (the frozenset materialization
+        of 1.35M itemsets was a multi-second host phase at Webdocs scale,
+        and every consumer — the writer's line formatting, rule gen's
+        size-grouped tables — immediately converts back to arrays anyway).
+        1-itemsets live in ``data.item_counts`` by rank."""
         from fastapriori_tpu.preprocess import preprocess_file
 
         with self.metrics.timed("preprocess", path=d_path) as m:
@@ -168,41 +187,57 @@ class FastApriori:
                 num_items=data.num_items,
                 total_count=data.total_count,
             )
-        freq_itemsets = self.mine_compressed(data)
-        return freq_itemsets, data.item_to_rank, data.freq_items
+        return self.mine_levels_raw(data), data
 
-    def mine_compressed(self, data: CompressedData) -> List[ItemsetWithCount]:
-        """Levels >=2 via device kernels, then 1-itemsets appended."""
-        one_itemsets: List[ItemsetWithCount] = [
-            (frozenset((r,)), int(c)) for r, c in enumerate(data.item_counts)
-        ]
-        f = data.num_items
-        freq_itemsets: List[ItemsetWithCount] = []
-        if f >= 2 and data.total_count > 0:
+    def mine_levels_raw(
+        self, data: CompressedData
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Levels >= 2 as lex-sorted member matrices with counts."""
+        levels: List[Tuple[np.ndarray, np.ndarray]] = []
+        if data.num_items >= 2 and data.total_count > 0:
             if self.config.engine == "fused":
-                freq_itemsets, partial = self._mine_fused(data)
-                if freq_itemsets is None:  # row budget / level bound hit
+                levels, partial = self._mine_fused(data)
+                if levels is None:  # row budget / level bound hit
                     self.metrics.emit(
                         "fused_fallback",
                         resume_levels=len(partial) if partial else 0,
                     )
-                    freq_itemsets = self._mine_levels(
-                        data, resume=partial or None
-                    )
+                    levels = self._mine_levels(data, resume=partial or None)
             else:
-                freq_itemsets = self._mine_levels(data)
-        return freq_itemsets + one_itemsets
+                levels = self._mine_levels(data)
+        return levels
+
+    def mine_compressed(self, data: CompressedData) -> List[ItemsetWithCount]:
+        """Levels >=2 via device kernels, then 1-itemsets appended."""
+        return self._decode_levels(self.mine_levels_raw(data), data)
+
+    def _decode_levels(
+        self, levels, data: CompressedData
+    ) -> List[ItemsetWithCount]:
+        """Frozenset form for API-parity callers; the production pipeline
+        (CLI) stays in matrix form and never pays this."""
+        with self.metrics.timed("decode") as m:
+            freq_itemsets: List[ItemsetWithCount] = []
+            for mat, cnts in levels:
+                freq_itemsets.extend(
+                    zip(map(frozenset, mat.tolist()), cnts.tolist())
+                )
+            m.update(n=len(freq_itemsets))
+        freq_itemsets.extend(
+            (frozenset((r,)), int(c)) for r, c in enumerate(data.item_counts)
+        )
+        return freq_itemsets
 
     # ------------------------------------------------------------------
     def _mine_fused(
         self, data: CompressedData
-    ) -> Tuple[Optional[List[ItemsetWithCount]], Optional[list]]:
+    ) -> Tuple[Optional[list], Optional[list]]:
         """Whole-loop on-device engine (ops/fused.py): one dispatch mines
         every level; on overflow retries with a budget sized from the true
-        survivor counts.  Returns ``(itemsets, None)`` on success, or
-        ``(None, complete_levels)`` when the budget cap or level bound is
-        hit — the caller resumes the level engine from the last attempt's
-        COMPLETE levels instead of recounting them."""
+        survivor counts.  Returns ``(level matrices, None)`` on success,
+        or ``(None, complete_levels)`` when the budget cap or level bound
+        is hit — the caller resumes the level engine from the last
+        attempt's COMPLETE levels instead of recounting them."""
         from fastapriori_tpu.ops import fused
 
         cfg = self.config
@@ -258,6 +293,11 @@ class FastApriori:
                 "fused_m_cap_clamp", memory_limit=m_cap_max,
                 configured=cfg.fused_m_cap_max,
             )
+        if m_cap_max < _next_pow2(cfg.fused_l_max + 2):
+            # Even the minimum viable row budget exceeds the HBM budget —
+            # go straight to the (chunked, memory-bounded) level engine.
+            self.metrics.emit("fused_skip", reason="memory")
+            return None, None
 
         with self.metrics.timed("bitmap_pack") as m:
             packed_np, f_pad = build_packed_bitmap_csr(
@@ -351,7 +391,7 @@ class FastApriori:
             if not incomplete:
                 ctx.record_fused_m_cap(profile, m_cap)
                 return (
-                    fused.decode_fused_result(rows, cols, counts, n_lvl),
+                    fused.decode_level_matrices(rows, cols, counts, n_lvl),
                     None,
                 )
             if not overflow:
@@ -378,11 +418,11 @@ class FastApriori:
     # ------------------------------------------------------------------
     def _mine_levels(
         self, data: CompressedData, resume: Optional[list] = None
-    ) -> List[ItemsetWithCount]:
-        """``resume``: complete levels salvaged from a failed fused
-        attempt (``[(member matrix, counts), ...]`` starting at level 2,
-        lex-sorted) — the loop continues from the deepest one instead of
-        recounting them."""
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Level matrices ``[(int32[N, k], int64[N] counts), ...]`` for
+        levels >= 2, lex-sorted.  ``resume``: complete levels salvaged
+        from a failed fused attempt — the loop continues from the deepest
+        one instead of recounting them."""
         cfg = self.config
         ctx = self.context
         f = data.num_items
@@ -523,15 +563,7 @@ class FastApriori:
             levels.append((nxt, nxt_counts))
             cur = nxt
             k += 1
-
-        with self.metrics.timed("decode") as m:
-            freq_itemsets: List[ItemsetWithCount] = []
-            for mat, cnts in levels:
-                freq_itemsets.extend(
-                    zip(map(frozenset, mat.tolist()), cnts.tolist())
-                )
-            m.update(n=len(freq_itemsets))
-        return freq_itemsets
+        return levels
 
     def _count_level(
         self,

@@ -23,7 +23,12 @@ from fastapriori_tpu.config import MinerConfig
 from fastapriori_tpu.ops.bitmap import build_bitmap, pad_axis
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import dedup_user_baskets
-from fastapriori_tpu.rules.gen import Rule, gen_rules, sort_rules
+from fastapriori_tpu.rules.gen import (
+    Rule,
+    gen_rules,
+    gen_rules_levels,
+    sort_rules,
+)
 from fastapriori_tpu.utils.logging import MetricsLogger
 
 
@@ -35,12 +40,20 @@ class AssociationRules:
         item_to_rank: Dict[str, int],
         config: Optional[MinerConfig] = None,
         context: Optional[DeviceContext] = None,
+        levels=None,
+        item_counts=None,
     ):
+        """``levels``/``item_counts``: matrix-form mining result
+        (FastApriori.run_file_raw) — rule generation then skips the
+        frozenset round trip entirely (rules/gen.py gen_rules_levels);
+        ``freq_itemsets`` may be empty in that case."""
         self.freq_itemsets = list(freq_itemsets)
         self.freq_items = list(freq_items)
         self.item_to_rank = dict(item_to_rank)
         self.config = config or MinerConfig()
         self._context = context
+        self._levels = levels
+        self._item_counts = item_counts
         self.metrics = MetricsLogger(enabled=self.config.log_metrics)
         # Rules depend only on the (immutable) mining result — built once
         # per instance, like the reference's single genRules pass
@@ -69,9 +82,13 @@ class AssociationRules:
             )
         if self._sorted_rules is None:
             with self.metrics.timed("gen_rules") as m:
-                self._sorted_rules = sort_rules(
-                    gen_rules(self.freq_itemsets), self.freq_items
-                )
+                if self._levels is not None:
+                    raw_rules = gen_rules_levels(
+                        self._levels, self._item_counts
+                    )
+                else:
+                    raw_rules = gen_rules(self.freq_itemsets)
+                self._sorted_rules = sort_rules(raw_rules, self.freq_items)
                 m.update(rules=len(self._sorted_rules))
         rules = self._sorted_rules
 

@@ -390,22 +390,3 @@ def decode_level_matrices(
         out.append((cur, out_counts[lvl][:n].astype(np.int64)))
         prev = cur
     return out
-
-
-def decode_fused_result(
-    out_rows: np.ndarray,
-    out_cols: np.ndarray,
-    out_counts: np.ndarray,
-    out_n: np.ndarray,
-) -> list:
-    """Host-side reconstruction of a SUCCESSFUL fused run: every stored
-    level chained and flattened to [(frozenset, count), ...] in level
-    order (the order the reference appends, FastApriori.scala:105,116)."""
-    out = []
-    for mat, cnts in decode_level_matrices(
-        out_rows, out_cols, out_counts, out_n
-    ):
-        out.extend(
-            zip(map(frozenset, mat.tolist()), map(int, cnts.tolist()))
-        )
-    return out

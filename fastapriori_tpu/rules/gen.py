@@ -72,7 +72,30 @@ def gen_rules(
         ).reshape(-1, k)
         cnts = np.fromiter((c for _, c in entries), np.int64, len(entries))
         mats[k] = (mat, cnts)
+    return _rules_from_tables(mats)
 
+
+def gen_rules_levels(levels, item_counts) -> List[Rule]:
+    """Matrix-form twin of :func:`gen_rules`: consumes the raw mining
+    path's level matrices directly (FastApriori.run_file_raw) instead of
+    rebuilding them from frozensets — the size-grouped tables ARE the
+    levels.  ``item_counts`` are the per-rank raw occurrence counts (C3),
+    the size-1 rule denominators."""
+    mats: Dict[int, Tuple[np.ndarray, np.ndarray]] = {
+        1: (
+            np.arange(len(item_counts), dtype=np.int32)[:, None],
+            np.asarray(item_counts, dtype=np.int64),
+        )
+    }
+    for mat, cnts in levels:
+        if mat.shape[0]:
+            mats[mat.shape[1]] = (mat, np.asarray(cnts, dtype=np.int64))
+    return _rules_from_tables(mats)
+
+
+def _rules_from_tables(
+    mats: Dict[int, Tuple[np.ndarray, np.ndarray]]
+) -> List[Rule]:
     # Raw rules (S - {i}) -> i with confidence count(S)/count(S - {i})
     # (:129-145); the size-1 denominator is the raw occurrence count, via
     # the 1-itemset table.  Downward closure guarantees every antecedent
