@@ -495,17 +495,7 @@ def main(argv=None) -> int:
     # level matrices are what the writer and rule generator consume
     # directly, so the per-itemset frozenset decode is not part of the
     # production path; the equality assert below decodes OUTSIDE the
-    # timed region.
-    def _decode(levels, data):
-        out = []
-        for mat, cnts in levels:
-            out.extend(zip(map(frozenset, mat.tolist()), cnts.tolist()))
-        out.extend(
-            (frozenset((r,)), int(c))
-            for r, c in enumerate(data.item_counts)
-        )
-        return out
-
+    # timed region (via the miner's own decode helper).
     t0 = time.perf_counter()
     miner.run_file_raw(d_path)
     cold = time.perf_counter() - t0
@@ -526,7 +516,7 @@ def main(argv=None) -> int:
         run_records.append(miner.metrics.records[rec_start:])
         if warm_runs[-1] > 60.0:  # huge datasets: one warm sample is enough
             break
-    result = _decode(levels, data)
+    result = miner._decode_levels(levels, data)
     # Lower-middle median: with 3 samples this is the true median; with 2
     # (the >60s early break) it picks the faster one rather than crediting
     # a transient stall as the sustained rate.

@@ -87,30 +87,23 @@ def save_freq_itemsets_with_count(
     return path
 
 
-def _level_lines(
-    levels, freq_items: Sequence[str], counts_suffix: bool
-) -> list:
+def _level_joined(levels, freq_items: Sequence[str]):
     """Format level matrices (lex-sorted int32 [N, k] member matrices with
-    counts) straight into output lines — no per-itemset Python set ever
-    exists.  Members print in descending rank order (Utils.scala:38
-    ``sortBy(-_)``): matrix rows are ascending, so the reversed row is
-    already the print order; ``numpy.char`` joins whole levels at once."""
+    counts) straight into per-level joined string arrays — no per-itemset
+    Python set ever exists.  Members print in descending rank order
+    (Utils.scala:38 ``sortBy(-_)``): matrix rows are ascending, so the
+    reversed row is already the print order; ``numpy.char`` joins whole
+    levels at once.  Yields ``(joined str array, counts)`` so callers can
+    derive the ``[count]``-suffixed form without re-joining."""
     import numpy as np
 
     items_arr = np.asarray(freq_items, dtype=np.str_)
-    lines: list = []
     for mat, cnts in levels:
         toks = items_arr[mat[:, ::-1]]  # [N, k] descending-rank strings
         joined = toks[:, 0]
         for j in range(1, toks.shape[1]):
             joined = np.char.add(np.char.add(joined, " "), toks[:, j])
-        if counts_suffix:
-            joined = np.char.add(
-                np.char.add(joined, "["),
-                np.char.add(cnts.astype(np.str_), "]"),
-            )
-        lines.extend(joined.tolist())
-    return lines
+        yield joined, cnts
 
 
 def save_freq_itemsets_levels(
@@ -126,7 +119,18 @@ def save_freq_itemsets_levels(
     from the raw mining path (FastApriori.run_file_raw) plus the
     1-itemsets (every rank, counts from C3).  Byte-identical output —
     golden e2e tests compare it against the oracle's files."""
-    lines = _level_lines(levels, freq_items, counts_suffix=False)
+    import numpy as np
+
+    lines: list = []
+    clines: list = []
+    for joined, cnts in _level_joined(levels, freq_items):
+        lines.extend(joined.tolist())
+        if with_counts_path:  # derive [count] form from the SAME join
+            suffixed = np.char.add(
+                np.char.add(joined, "["),
+                np.char.add(cnts.astype(np.str_), "]"),
+            )
+            clines.extend(suffixed.tolist())
     lines.extend(freq_items)
     lines.sort()
     path = output_prefix + "freqItemset"
@@ -134,9 +138,6 @@ def save_freq_itemsets_levels(
     with open_write(path) as f:
         f.writelines(line + "\n" for line in lines)
     if with_counts_path:
-        import numpy as np
-
-        clines = _level_lines(levels, freq_items, counts_suffix=True)
         clines.extend(
             f"{tok}[{int(c)}]"
             for tok, c in zip(freq_items, np.asarray(item_counts))

@@ -110,6 +110,7 @@ def preprocess_buffer(data: bytes, min_support: float) -> NativeResult:
     )
     if not res_ptr:
         raise MemoryError("fa_preprocess_buffer failed")
+    free_now = True
     try:
         res = res_ptr.contents
         f = int(res.n_items)
@@ -128,9 +129,21 @@ def preprocess_buffer(data: bytes, min_support: float) -> NativeResult:
             res.basket_offsets, shape=(t + 1,)
         ).copy()
         nnz = int(offsets[-1]) if t else 0
-        indices = np.ctypeslib.as_array(
-            res.basket_items, shape=(max(nnz, 1),)
-        )[:nnz].copy()
+        if nnz:
+            # Zero-copy: view the native CSR arena directly (~0.6 GB at
+            # Webdocs scale — the .copy() was a full extra second on this
+            # host).  The native result is freed when the LAST view dies:
+            # slices hold the parent array via .base, and the finalizer
+            # hangs off the parent.
+            import weakref
+
+            base = np.ctypeslib.as_array(res.basket_items, shape=(nnz,))
+            base.flags.writeable = False
+            weakref.finalize(base, lib.fa_free_result, res_ptr)
+            indices = base[:nnz]
+            free_now = False
+        else:
+            indices = np.empty(0, dtype=np.int32)
         weights = np.ctypeslib.as_array(res.weights, shape=(max(t, 1),))[
             :t
         ].copy()
@@ -144,7 +157,8 @@ def preprocess_buffer(data: bytes, min_support: float) -> NativeResult:
             weights,
         )
     finally:
-        lib.fa_free_result(res_ptr)
+        if free_now:
+            lib.fa_free_result(res_ptr)
 
 
 def fill_packed_bitmap(

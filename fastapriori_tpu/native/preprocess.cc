@@ -20,8 +20,10 @@
 
 #include <algorithm>
 #include <cctype>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
@@ -29,6 +31,26 @@
 #include <string_view>
 #include <unordered_map>
 #include <vector>
+
+// FA_NATIVE_TIMING=1 prints per-phase wall times to stderr (diagnostics
+// for the single-core preprocess budget; no effect on results).
+namespace {
+struct PhaseTimer {
+  bool on;
+  std::chrono::steady_clock::time_point t0;
+  PhaseTimer() : on(std::getenv("FA_NATIVE_TIMING") != nullptr) {
+    t0 = std::chrono::steady_clock::now();
+  }
+  void mark(const char* name) {
+    if (!on) return;
+    auto t1 = std::chrono::steady_clock::now();
+    std::fprintf(
+        stderr, "fa_native[%s]: %.3f s\n", name,
+        std::chrono::duration<double>(t1 - t0).count());
+    t0 = t1;
+  }
+};
+}  // namespace
 
 namespace {
 
@@ -123,6 +145,7 @@ struct FaResult {
 // result (free with fa_free_result) or nullptr on allocation failure.
 FaResult* fa_preprocess_buffer(const char* data, int64_t len,
                                double min_support) {
+  PhaseTimer timer;
   std::string_view buf(data, static_cast<size_t>(len));
 
   // ---- split into trimmed lines (last line may lack '\n') --------------
@@ -143,6 +166,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
       pos = nl + 1;
     }
   }
+  timer.mark("split_lines");
   const int64_t n_raw = static_cast<int64_t>(lines.size());
   const int64_t min_count =
       static_cast<int64_t>(std::ceil(min_support * static_cast<double>(n_raw)));
@@ -217,6 +241,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     }
   }
   tok_offsets.push_back(static_cast<int64_t>(tok_ids.size()));
+  timer.mark("pass1_tokenize_count");
 
   // ---- rank assignment -------------------------------------------------
   struct Item {
@@ -278,6 +303,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     }
   }
   std::free(dense_counts);
+  timer.mark("rank_assign");
 
   // ---- pass 2: basket dedup with multiplicity --------------------------
   // Replays the parsed tokens captured in pass 1 (tok_ids) — no second
@@ -286,7 +312,37 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   // per-basket heap node, no rehash-time key copies, and the final
   // marshal is one memcpy of the arena.  Insertion order = first-seen
   // order (FastApriori.scala:74 zipWithIndex over the deduped RDD).
-  std::vector<int32_t> arena;           // concatenated sorted rank lists
+  // Malloc-backed growable arena: ownership transfers to the result
+  // struct at marshal time with NO copy (the arena is ~0.6 GB at Webdocs
+  // scale and the memcpy alone was ~2.5 s on this single-core host).
+  struct I32Buf {
+    int32_t* p = nullptr;
+    size_t n = 0, cap = 0;
+    bool reserve(size_t want) {
+      if (want <= cap) return true;
+      size_t nc = cap ? cap * 2 : (1u << 20);
+      while (nc < want) nc *= 2;
+      auto* np_ = static_cast<int32_t*>(std::realloc(p, nc * sizeof(int32_t)));
+      if (!np_) return false;
+      p = np_;
+      cap = nc;
+      return true;
+    }
+    bool append(const int32_t* src, size_t k) {
+      if (!reserve(n + k)) return false;
+      std::memcpy(p + n, src, k * sizeof(int32_t));
+      n += k;
+      return true;
+    }
+  } arena;                              // concatenated sorted rank lists
+  // Upper bound: one rank per captured token.  Reserving up front keeps
+  // realloc from copying the growing arena (~1.2 GB of cumulative copy
+  // at Webdocs scale); pages are committed lazily, so over-reservation
+  // costs virtual space only.
+  if (!arena.reserve(tok_ids.size() + 1)) {
+    std::free(dense_rank);
+    return nullptr;
+  }
   std::vector<int64_t> b_off;           // [t] arena offset per basket
   std::vector<int32_t> b_len;           // [t]
   std::vector<int32_t> b_weight;        // [t] multiplicity
@@ -361,17 +417,21 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
       int64_t id = table[slot];
       if (id == -1) {  // new distinct basket
         table[slot] = static_cast<int64_t>(b_off.size());
-        b_off.push_back(static_cast<int64_t>(arena.size()));
+        b_off.push_back(static_cast<int64_t>(arena.n));
         b_len.push_back(static_cast<int32_t>(n));
         b_weight.push_back(1);
         b_hash.push_back(h);
-        arena.insert(arena.end(), scratch.begin(), scratch.end());
+        if (!arena.append(scratch.data(), n)) {
+          std::free(arena.p);
+          std::free(dense_rank);
+          return nullptr;
+        }
         // Load factor <= 0.7 keeps linear probes short.
         if (b_off.size() * 10 >= table_size * 7) grow_table();
         break;
       }
       if (b_hash[id] == h && b_len[id] == static_cast<int32_t>(n) &&
-          std::memcmp(arena.data() + b_off[id], scratch.data(),
+          std::memcmp(arena.p + b_off[id], scratch.data(),
                       n * sizeof(int32_t)) == 0) {
         ++b_weight[id];
         break;
@@ -380,11 +440,16 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     }
   }
   const int64_t t = static_cast<int64_t>(b_off.size());
-  const int64_t total_items = static_cast<int64_t>(arena.size());
+  const int64_t total_items = static_cast<int64_t>(arena.n);
+  timer.mark("pass2_dedup");
 
   // ---- marshal ---------------------------------------------------------
   auto* res = static_cast<FaResult*>(std::calloc(1, sizeof(FaResult)));
-  if (!res) return nullptr;
+  if (!res) {
+    std::free(arena.p);
+    std::free(dense_rank);
+    return nullptr;
+  }
   res->n_raw = n_raw;
   res->min_count = min_count;
   res->n_items = f;
@@ -408,20 +473,21 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   res->n_baskets = t;
   res->basket_offsets =
       static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (t + 1)));
-  res->basket_items = static_cast<int32_t*>(
-      std::malloc(sizeof(int32_t) * (total_items ? total_items : 1)));
+  // Zero-copy handoff: the arena buffer becomes the result's
+  // basket_items (fa_free_result frees it; it is malloc-family memory).
+  res->basket_items = total_items
+      ? arena.p
+      : static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
+  if (!total_items) std::free(arena.p);
   res->weights =
       static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (t ? t : 1)));
-  if (total_items) {
-    std::memcpy(res->basket_items, arena.data(),
-                arena.size() * sizeof(int32_t));
-  }
   for (int64_t i = 0; i < t; ++i) {
     res->basket_offsets[i] = b_off[i];
     res->weights[i] = b_weight[i];
   }
   res->basket_offsets[t] = total_items;
   std::free(dense_rank);
+  timer.mark("marshal");
   return res;
 }
 
