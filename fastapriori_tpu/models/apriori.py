@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fastapriori_tpu.config import MinerConfig
-from fastapriori_tpu.models.candidates import gen_candidates_arrays
+from fastapriori_tpu.models.candidates import gen_candidates_blocks
 from fastapriori_tpu.ops.bitmap import (
     build_packed_bitmap_csr,
     weight_digits,
@@ -541,25 +541,19 @@ class FastApriori:
         k = cur.shape[1] + 1
         while cur.shape[0] >= k:
             with self.metrics.timed("level", k=k) as m:
-                x_idx, ys = gen_candidates_arrays(cur)
                 nxt, nxt_counts, lvl_stats = self._count_level(
                     ctx,
                     bitmap,
                     w_digits,
                     scales,
                     cur,
-                    x_idx,
-                    ys,
+                    gen_candidates_blocks(cur),
                     min_count,
                     n_chunks,
                     use_pallas,
                     fast_f32,
                 )
-                m.update(
-                    candidates=int(x_idx.size),
-                    frequent=nxt.shape[0],
-                    **lvl_stats,
-                )
+                m.update(frequent=nxt.shape[0], **lvl_stats)
             levels.append((nxt, nxt_counts))
             cur = nxt
             k += 1
@@ -572,8 +566,7 @@ class FastApriori:
         w_digits,
         scales,
         level: np.ndarray,
-        x_idx: np.ndarray,
-        ys: np.ndarray,
+        cand_blocks,
         min_count: int,
         n_chunks: int,
         use_pallas: bool = False,
@@ -582,21 +575,22 @@ class FastApriori:
         """C8 for one level, transfer-minimal: greedy chunks of at most
         P_CAP prefixes / C_CAP candidates go through the compiled-once
         gather kernel (ops/count.py local_level_gather); only each
-        candidate's own count comes back.  Candidates arrive as (x_idx, ys)
-        pairs ordered by (x_idx, y) from :func:`gen_candidates_arrays`;
-        returns the next level's lex-sorted matrix, its counts, and a
-        stats dict (kernel dispatches, MAC count, psum bytes) for the
-        per-level metrics."""
+        candidate's own count comes back.
+
+        ``cand_blocks`` is an ITERATOR of ``(x_idx, ys)`` blocks in
+        global ``(x_idx, y)`` order (candidates.gen_candidates_blocks):
+        each block's chunks are dispatched (async) before the next block
+        is pulled, so the host's join+prune for block i+1 overlaps the
+        device counting of block i — at Webdocs scale candidate
+        generation is ~4.5 s of host work that previously idled the
+        chip.  Results are fetched only after every block is dispatched.
+        Returns the next level's lex-sorted matrix, its counts, and a
+        stats dict (candidate count, kernel dispatches, MAC count, psum
+        bytes) for the per-level metrics."""
         cfg = self.config
         s = level.shape[1]
-        empty = (
-            np.empty((0, s + 1), dtype=np.int32),
-            np.empty(0, dtype=np.int64),
-            {"dispatches": 0, "macs": 0, "psum_bytes": 0},
-        )
-        if x_idx.size == 0:
-            return empty
         f_pad = bitmap.shape[1]
+        t_pad = bitmap.shape[0]
         zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
         # Per-cand-shard capacities: the prefix rows and the candidate
         # gather are sharded over the mesh's cand axis (mesh.level_gather),
@@ -605,116 +599,129 @@ class FastApriori:
         # per-prefix runs — each shard's budget must fit at least one run.
         # With cand_shards == 1 this is exactly the old single-block path.
         n_cs = ctx.cand_shards
-        # x_idx is sorted, so each unique prefix's candidates are one
-        # contiguous run; blocks take whole runs.
-        uniq_x, run_start = np.unique(x_idx, return_index=True)
-        run_end = np.concatenate([run_start[1:], [x_idx.size]])
-        # Right-size the prefix budget to THIS level's actual prefix
-        # count, in power-of-two buckets (compiles stay bounded: at most
-        # log2(4096/128) sizes) up to the 4096-row transfer-amortization
-        # cap.  A fixed 4096 made every small level pay the full padded
-        # [T, 4096] membership matmul — ~145 GMAC for a 1-candidate level
-        # at T10I4D100K scale, the whole CPU-fallback regression.
-        p_sh = min(
-            max(
-                _next_pow2(-(-uniq_x.size // n_cs)),
-                max(cfg.min_prefix_bucket // n_cs, 1),
-            ),
-            max(4096 // n_cs, 1),
-        )
-        if use_pallas:
-            from fastapriori_tpu.ops.pallas_level import M_TILE
-
-            # Per-shard prefix rows must be a whole number of M tiles.
-            p_sh = -(-max(p_sh, M_TILE) // M_TILE) * M_TILE
-        p_cap = p_sh * n_cs
         c_sh = max(cfg.level_cand_cap // n_cs, f_pad)
         c_cap = c_sh * n_cs
         k_pad = cfg.level_k_max
         if s > k_pad:  # deeper than the padded width: widen (recompiles)
             k_pad = ((s + 7) // 8) * 8
-        counts_all = np.empty(x_idx.size, dtype=np.int64)
-        # Dispatch every chunk before fetching any result: each blocking
-        # fetch costs a full host<->device round trip (tens of ms on
-        # tunneled backends), so a level with hundreds of chunks was
-        # latency-bound.  Async dispatch + copy_to_host_async pipelines
-        # the uploads, kernels, and downloads; the collection loop below
-        # then waits on transfers that are already in flight.
-        inflight = []
-        start = 0  # index into uniq_x
-        while start < uniq_x.size:
-            prefix_cols = np.full((p_cap, k_pad), zcol, dtype=np.int32)
-            cand_idx = np.zeros(c_cap, dtype=np.int32)
-            placed = []  # (counts_all slice, offset in cand_idx, length)
-            for sh in range(n_cs):
-                if start >= uniq_x.size:
-                    break
-                hi = min(start + p_sh, uniq_x.size)
-                # Largest end with candidates <= c_sh (>= 1 prefix; a
-                # single prefix has < F <= c_sh extensions).
-                base = run_start[start]
-                end = int(
-                    np.searchsorted(
-                        run_end[start:hi] - base, c_sh, side="right"
-                    )
-                )
-                end = start + max(end, 1)
-                n_p = end - start
-                n_c = int(run_end[end - 1] - base)
-                prefix_cols[sh * p_sh : sh * p_sh + n_p, :s] = level[
-                    uniq_x[start:end]
-                ]
-                ci = slice(base, base + n_c)
-                # Row indexes are LOCAL to the shard's prefix block — each
-                # cand shard sees only its own [p_sh, F] counts matrix.
-                row_of_cand = (
-                    np.searchsorted(uniq_x, x_idx[ci]) - start
-                ).astype(np.int64)
-                cand_idx[sh * c_sh : sh * c_sh + n_c] = (
-                    row_of_cand * f_pad + ys[ci]
-                )
-                placed.append((ci, sh * c_sh, n_c))
-                start = end
-            if use_pallas:
-                out = ctx.level_gather_pallas(
-                    bitmap, w_digits, prefix_cols, s, cand_idx
-                )
-            else:
-                out = ctx.level_gather(
-                    bitmap,
-                    w_digits,
-                    scales,
-                    prefix_cols,
-                    s,
-                    cand_idx,
-                    n_chunks,
-                    fast_f32,
-                )
-            try:
-                out.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass
-            inflight.append((placed, out))
-        # Per-dispatch cost model (for the metrics/MFU report): membership
-        # matmul [T, P_cap] x counting matmuls [P_cap, F] over the padded
-        # global shapes; psum reduces the [C_cap] candidate gather.
-        t_pad = bitmap.shape[0]
         d_eff = 1 if fast_f32 else len(scales)
         stats = {
-            "dispatches": len(inflight),
-            "macs": len(inflight) * (1 + d_eff) * t_pad * p_cap * f_pad,
-            "psum_bytes": len(inflight) * 4 * c_cap,
+            "candidates": 0, "dispatches": 0, "macs": 0, "psum_bytes": 0,
         }
-        for placed, out in inflight:
+        inflight = []  # (placed, device out, block counts buffer)
+        blocks = []  # (x_idx, ys, counts buffer)
+        for x_idx, ys in cand_blocks:
+            if x_idx.size == 0:
+                continue
+            stats["candidates"] += int(x_idx.size)
+            counts_blk = np.empty(x_idx.size, dtype=np.int64)
+            blocks.append((x_idx, ys, counts_blk))
+            # x_idx is sorted, so each unique prefix's candidates are one
+            # contiguous run; chunks take whole runs.
+            uniq_x, run_start = np.unique(x_idx, return_index=True)
+            run_end = np.concatenate([run_start[1:], [x_idx.size]])
+            # Right-size the prefix budget to THIS block's actual prefix
+            # count, in power-of-two buckets (compiles stay bounded: at
+            # most log2(4096/128) sizes) up to the 4096-row
+            # transfer-amortization cap.  A fixed 4096 made every small
+            # level pay the full padded [T, 4096] membership matmul —
+            # ~145 GMAC for a 1-candidate level at T10I4D100K scale, the
+            # whole CPU-fallback regression.
+            p_sh = min(
+                max(
+                    _next_pow2(-(-uniq_x.size // n_cs)),
+                    max(cfg.min_prefix_bucket // n_cs, 1),
+                ),
+                max(4096 // n_cs, 1),
+            )
+            if use_pallas:
+                from fastapriori_tpu.ops.pallas_level import M_TILE
+
+                # Per-shard prefix rows must be whole M tiles.
+                p_sh = -(-max(p_sh, M_TILE) // M_TILE) * M_TILE
+            p_cap = p_sh * n_cs
+            start = 0  # index into uniq_x
+            while start < uniq_x.size:
+                prefix_cols = np.full((p_cap, k_pad), zcol, dtype=np.int32)
+                cand_idx = np.zeros(c_cap, dtype=np.int32)
+                placed = []  # (counts slice, offset in cand_idx, length)
+                for sh in range(n_cs):
+                    if start >= uniq_x.size:
+                        break
+                    hi = min(start + p_sh, uniq_x.size)
+                    # Largest end with candidates <= c_sh (>= 1 prefix; a
+                    # single prefix has < F <= c_sh extensions).
+                    base = run_start[start]
+                    end = int(
+                        np.searchsorted(
+                            run_end[start:hi] - base, c_sh, side="right"
+                        )
+                    )
+                    end = start + max(end, 1)
+                    n_p = end - start
+                    n_c = int(run_end[end - 1] - base)
+                    prefix_cols[sh * p_sh : sh * p_sh + n_p, :s] = level[
+                        uniq_x[start:end]
+                    ]
+                    ci = slice(base, base + n_c)
+                    # Row indexes are LOCAL to the shard's prefix block —
+                    # each cand shard sees only its own [p_sh, F] counts.
+                    row_of_cand = (
+                        np.searchsorted(uniq_x, x_idx[ci]) - start
+                    ).astype(np.int64)
+                    cand_idx[sh * c_sh : sh * c_sh + n_c] = (
+                        row_of_cand * f_pad + ys[ci]
+                    )
+                    placed.append((ci, sh * c_sh, n_c))
+                    start = end
+                if use_pallas:
+                    out = ctx.level_gather_pallas(
+                        bitmap, w_digits, prefix_cols, s, cand_idx
+                    )
+                else:
+                    out = ctx.level_gather(
+                        bitmap,
+                        w_digits,
+                        scales,
+                        prefix_cols,
+                        s,
+                        cand_idx,
+                        n_chunks,
+                        fast_f32,
+                    )
+                try:
+                    out.copy_to_host_async()
+                except (AttributeError, NotImplementedError):
+                    pass
+                inflight.append((placed, out, counts_blk))
+                # Per-dispatch cost model (metrics/MFU): membership matmul
+                # [T, P_cap] + counting matmuls [P_cap, F] over padded
+                # global shapes; psum reduces the [C_cap] gather.
+                stats["dispatches"] += 1
+                stats["macs"] += (1 + d_eff) * t_pad * p_cap * f_pad
+                stats["psum_bytes"] += 4 * c_cap
+        empty = (
+            np.empty((0, s + 1), dtype=np.int32),
+            np.empty(0, dtype=np.int64),
+            stats,
+        )
+        if not blocks:
+            return empty
+        # Collect: every dispatch is already in flight, so these waits
+        # overlap each other and any remaining device work.
+        for placed, out, counts_blk in inflight:
             arr = np.asarray(out)
             for ci, off, n_c in placed:
-                counts_all[ci] = arr[off : off + n_c]
+                counts_blk[ci] = arr[off : off + n_c]
+        x_idx = np.concatenate([b[0] for b in blocks])
+        ys = np.concatenate([b[1] for b in blocks])
+        counts_all = np.concatenate([b[2] for b in blocks])
         keep = counts_all >= min_count
         if not keep.any():
-            return empty[0], empty[1], stats
+            return empty
         nxt = np.concatenate(
             [level[x_idx[keep]], ys[keep, None]], axis=1
         ).astype(np.int32)
-        # (x_idx, ys) is ordered by (x_idx, y) and level is lex-sorted, so
+        # Blocks arrive in (x_idx, y) order and level is lex-sorted, so
         # nxt is already lex-sorted — the invariant the next join needs.
         return nxt, counts_all[keep], stats

@@ -141,6 +141,8 @@ struct FaResult {
   int32_t* weights;         // [T'] multiplicities
 };
 
+void fa_free_result(FaResult* res);
+
 // data/len: raw file bytes.  Not nul-terminated.  Returns a heap-allocated
 // result (free with fa_free_result) or nullptr on allocation failure.
 FaResult* fa_preprocess_buffer(const char* data, int64_t len,
@@ -458,6 +460,12 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   for (const auto& item : freq) items_len += item.tok.size() + 1;
   res->items_buf = static_cast<char*>(std::malloc(items_len ? items_len : 1));
   res->items_buf_len = items_len ? items_len - 1 : 0;  // drop trailing '\n'
+  if (!res->items_buf) {
+    std::free(arena.p);
+    std::free(dense_rank);
+    std::free(res);
+    return nullptr;
+  }
   {
     char* p = res->items_buf;
     for (const auto& item : freq) {
@@ -468,7 +476,6 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   }
   res->item_counts =
       static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (f ? f : 1)));
-  for (int32_t r = 0; r < f; ++r) res->item_counts[r] = freq[r].count;
 
   res->n_baskets = t;
   res->basket_offsets =
@@ -481,6 +488,15 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   if (!total_items) std::free(arena.p);
   res->weights =
       static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (t ? t : 1)));
+  if (!res->item_counts || !res->basket_offsets || !res->basket_items ||
+      !res->weights) {
+    // fa_free_result tolerates the partially-filled struct (free(nullptr)
+    // is a no-op); basket_items is the arena or its own malloc either way.
+    std::free(dense_rank);
+    fa_free_result(res);
+    return nullptr;
+  }
+  for (int32_t r = 0; r < f; ++r) res->item_counts[r] = freq[r].count;
   for (int64_t i = 0; i < t; ++i) {
     res->basket_offsets[i] = b_off[i];
     res->weights[i] = b_weight[i];
