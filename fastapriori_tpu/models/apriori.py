@@ -189,13 +189,47 @@ class FastApriori:
             )
         return self.mine_levels_raw(data), data
 
+    def run_file_sharded(
+        self, d_path: str
+    ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], CompressedData]:
+        """Multi-host mining: every process calls this (SPMD); each
+        preprocesses only its own byte range of ``d_path``
+        (preprocess.preprocess_file_sharded) and uploads its rows of the
+        global bitmap in place — the bulk data never crosses hosts, the
+        distributed analog of the reference's C3/C4 Spark passes.  The
+        returned level matrices are replicated (identical on every
+        process)."""
+        from fastapriori_tpu.preprocess import preprocess_file_sharded
+
+        with self.metrics.timed("preprocess", path=d_path) as m:
+            data = preprocess_file_sharded(
+                d_path, self.config.min_support
+            )
+            m.update(
+                n_raw=data.n_raw,
+                min_count=data.min_count,
+                num_items=data.num_items,
+                local_count=data.total_count,
+                global_count=data.shard.global_count,
+            )
+        return self.mine_levels_raw(data), data
+
     def mine_levels_raw(
         self, data: CompressedData
     ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Levels >= 2 as lex-sorted member matrices with counts."""
         levels: List[Tuple[np.ndarray, np.ndarray]] = []
-        if data.num_items >= 2 and data.total_count > 0:
-            if self.config.engine == "fused":
+        total = (
+            data.shard.global_count if data.shard else data.total_count
+        )
+        if data.num_items >= 2 and total > 0:
+            if self.config.engine == "fused" and data.shard is not None:
+                # Sharded ingest v1 runs the level engine (the fused
+                # whole-loop program would need its own process-local
+                # upload path); fall through without a fused attempt.
+                self.metrics.emit("fused_skip", reason="sharded_ingest")
+                levels = self._mine_levels(data)
+            elif self.config.engine == "fused":
                 levels, partial = self._mine_fused(data)
                 if levels is None:  # row budget / level bound hit
                     self.metrics.emit(
@@ -438,6 +472,8 @@ class FastApriori:
             # unrolls at most MAX_DIGITS weight digits, and its blocks
             # span the full item width — beyond ~2048 padded items the
             # resident [tile, F] blocks exceed VMEM.
+            shard = data.shard
+            total = shard.global_count if shard else data.total_count
             use_pallas = cfg.level_use_pallas
             if use_pallas:
                 from fastapriori_tpu.ops.pallas_level import (
@@ -446,9 +482,16 @@ class FastApriori:
                 )
                 from fastapriori_tpu.ops.bitmap import pad_axis
 
-                max_w = (
-                    int(data.weights.max()) if data.total_count else 1
-                )
+                # GLOBAL max weight when sharded: every process must make
+                # the same eligibility decision (SPMD), and the uniform
+                # digit count must fit the kernel's static bound even on
+                # processes whose own shard has only light baskets.
+                if shard is not None:
+                    max_w = shard.max_weight
+                else:
+                    max_w = (
+                        int(data.weights.max()) if data.total_count else 1
+                    )
                 n_digits = 1
                 while 128**n_digits <= max_w:
                     n_digits += 1
@@ -456,12 +499,19 @@ class FastApriori:
                     use_pallas = False
                 if pad_axis(f + 1, cfg.item_tile) > 2048:
                     use_pallas = False
-            per_dev = -(-data.total_count // ctx.txn_shards)
+            # Per-device rows are padded to the LARGEST shard in sharded
+            # mode, so size the scan chunking from that (an n_chunks
+            # derived from the even global split would under-chunk and
+            # break the per-chunk HBM bound under shard imbalance).
+            if shard is not None:
+                # (divisibility is asserted in the sharded branch below)
+                per_dev = -(
+                    -max(shard.local_counts)
+                    // max(ctx.txn_shards // shard.num_processes, 1)
+                )
+            else:
+                per_dev = -(-total // ctx.txn_shards)
             n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
-            txn_multiple = max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
-            if use_pallas:
-                n_chunks = 1
-                txn_multiple = T_TILE * ctx.txn_shards
             # CPU backends: ONE f32 matmul per phase (BLAS) instead of D
             # int8 matmuls — XLA-CPU integer matmuls are orders slower.
             # Exact while every count < 2^24 (counts are bounded by the
@@ -471,19 +521,72 @@ class FastApriori:
                 and not use_pallas
                 and data.n_raw < 2**24
             )
-            packed_np, f_pad = build_packed_bitmap_csr(
-                data.basket_indices,
-                data.basket_offsets,
-                f,
-                txn_multiple,
-                cfg.item_tile,
-            )
-            t_pad = packed_np.shape[0]
-            w_digits_np, scales = weight_digits(data.weights, t_pad)
-            # Bit-packed transfer + on-device unpack: 8x less host->device
-            # traffic (the dominant cost of this phase on tunneled chips).
-            bitmap = ctx.upload_packed(packed_np)
-            w_digits = ctx.shard_weight_digits(w_digits_np)
+            if shard is None:
+                txn_multiple = (
+                    max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
+                )
+                if use_pallas:
+                    n_chunks = 1
+                    txn_multiple = T_TILE * ctx.txn_shards
+                packed_np, f_pad = build_packed_bitmap_csr(
+                    data.basket_indices,
+                    data.basket_offsets,
+                    f,
+                    txn_multiple,
+                    cfg.item_tile,
+                )
+                t_pad = packed_np.shape[0]
+                w_digits_np, scales = weight_digits(data.weights, t_pad)
+                # Bit-packed transfer + on-device unpack: 8x less
+                # host->device traffic (the dominant cost of this phase
+                # on tunneled chips).
+                bitmap = ctx.upload_packed(packed_np)
+                w_digits = ctx.shard_weight_digits(w_digits_np)
+            else:
+                # Multi-host sharded ingest: this process holds only its
+                # shard's baskets; each process pads its rows to the SAME
+                # local count (max over shards, aligned so per-device
+                # rows split into n_chunks equal scan chunks) and the
+                # global bitmap is assembled with zero cross-host data
+                # movement.  Digit count is globally uniform (SPMD needs
+                # identical static shapes on every process).
+                from fastapriori_tpu.ops.bitmap import pad_axis
+
+                n_proc = shard.num_processes
+                assert ctx.txn_shards % n_proc == 0 and ctx.cand_shards == 1, (
+                    "sharded ingest needs a 1-D txn mesh with devices "
+                    f"divisible by processes (txn_shards={ctx.txn_shards}, "
+                    f"cand={ctx.cand_shards}, processes={n_proc})"
+                )
+                local_devices = ctx.txn_shards // n_proc
+                local_multiple = (
+                    max(cfg.txn_tile, 32) * local_devices * n_chunks
+                )
+                if use_pallas:
+                    n_chunks = 1
+                    local_multiple = T_TILE * local_devices
+                local_pad = max(
+                    pad_axis(c, local_multiple) for c in shard.local_counts
+                )
+                packed_np, f_pad = build_packed_bitmap_csr(
+                    data.basket_indices,
+                    data.basket_offsets,
+                    f,
+                    local_pad,  # every shard pads to the same row count
+                    cfg.item_tile,
+                )
+                assert packed_np.shape[0] == local_pad, (
+                    packed_np.shape, local_pad
+                )
+                t_pad = local_pad * n_proc
+                n_digits = 1
+                while 128**n_digits <= shard.max_weight:
+                    n_digits += 1
+                w_digits_np, scales = weight_digits(
+                    data.weights, local_pad, min_digits=n_digits
+                )
+                bitmap = ctx.upload_packed_local(packed_np)
+                w_digits = ctx.shard_weight_digits_local(w_digits_np)
             m.update(
                 shape=[t_pad, f_pad],
                 digits=len(scales),

@@ -82,6 +82,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8),
         ]
         fill.restype = None
+    count = getattr(lib, "fa_count_buffer", None)
+    if count is not None:
+        count.restype = ctypes.POINTER(_FaCounts)
+        count.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.fa_free_counts.argtypes = [ctypes.POINTER(_FaCounts)]
+        lib.fa_free_counts.restype = None
+        cwr = lib.fa_compress_with_ranks
+        cwr.restype = ctypes.POINTER(_FaResult)
+        cwr.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
     _lib = lib
     return _lib
 
@@ -95,6 +110,88 @@ NativeResult = Tuple[
     np.ndarray,  # basket_offsets int64[T'+1]
     np.ndarray,  # weights int32[T']
 ]
+
+
+class _FaCounts(ctypes.Structure):
+    _fields_ = [
+        ("n_lines", ctypes.c_int64),
+        ("n_tokens", ctypes.c_int64),
+        ("tokens_buf", ctypes.c_void_p),
+        ("tokens_buf_len", ctypes.c_int64),
+        ("counts", ctypes.POINTER(ctypes.c_int64)),
+    ]
+
+
+def count_buffer(data: bytes) -> Tuple[int, List[str], np.ndarray]:
+    """Sharded-ingest phase 1: (line count, distinct tokens, occurrence
+    counts) for one byte range.  Raises if the native library (or a stale
+    build of it) is unavailable."""
+    lib = get_lib()
+    if lib is None or getattr(lib, "fa_count_buffer", None) is None:
+        raise RuntimeError(
+            "native sharded-ingest entry points unavailable; rebuild with "
+            "`make -C fastapriori_tpu/native`"
+        )
+    res_ptr = lib.fa_count_buffer(data, len(data))
+    if not res_ptr:
+        raise MemoryError("fa_count_buffer failed")
+    try:
+        res = res_ptr.contents
+        n = int(res.n_tokens)
+        raw = ctypes.string_at(res.tokens_buf, res.tokens_buf_len)
+        tokens = raw.decode("utf-8").split("\n") if n else []
+        assert len(tokens) == n, (len(tokens), n)
+        counts = np.ctypeslib.as_array(res.counts, shape=(max(n, 1),))[
+            :n
+        ].copy()
+        return int(res.n_lines), tokens, counts
+    finally:
+        lib.fa_free_counts(res_ptr)
+
+
+def compress_with_ranks(
+    data: bytes, freq_items: List[str]
+) -> Tuple[int, np.ndarray, np.ndarray, np.ndarray]:
+    """Sharded-ingest phase 2: compress one byte range against the GLOBAL
+    rank table.  Returns (local line count, basket_indices,
+    basket_offsets, weights) — CSR over this shard's distinct baskets."""
+    lib = get_lib()
+    if lib is None or getattr(lib, "fa_compress_with_ranks", None) is None:
+        raise RuntimeError(
+            "native sharded-ingest entry points unavailable; rebuild with "
+            "`make -C fastapriori_tpu/native`"
+        )
+    ranks_blob = "\n".join(freq_items).encode("utf-8")
+    res_ptr = lib.fa_compress_with_ranks(
+        data, len(data), ranks_blob, len(ranks_blob), len(freq_items)
+    )
+    if not res_ptr:
+        raise MemoryError("fa_compress_with_ranks failed")
+    free_now = True
+    try:
+        res = res_ptr.contents
+        t = int(res.n_baskets)
+        offsets = np.ctypeslib.as_array(
+            res.basket_offsets, shape=(t + 1,)
+        ).copy()
+        nnz = int(offsets[-1]) if t else 0
+        if nnz:
+            import weakref
+
+            base = np.ctypeslib.as_array(res.basket_items, shape=(nnz,))
+            base.flags.writeable = False
+            weakref.finalize(base, lib.fa_free_result, res_ptr)
+            indices = base[:nnz]
+            free_now = False
+        else:
+            indices = np.empty(0, dtype=np.int32)
+        weights = np.ctypeslib.as_array(res.weights, shape=(max(t, 1),))[
+            :t
+        ].copy()
+        return int(res.n_raw), indices, offsets, weights
+    finally:
+        if free_now:
+            lib.fa_free_result(res_ptr)
 
 
 def preprocess_buffer(data: bytes, min_support: float) -> NativeResult:

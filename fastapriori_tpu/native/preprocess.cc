@@ -525,6 +525,367 @@ void fa_fill_packed_bitmap(const int64_t* offsets, const int32_t* items,
   }
 }
 
+// ---- sharded-ingest split phases -------------------------------------
+// Multi-host ingest (preprocess.py preprocess_file_sharded): each process
+// counts its own byte-range (fa_count_buffer), the per-token counts merge
+// globally on the host, and each process then compresses its range
+// against the GLOBAL rank table (fa_compress_with_ranks).  Identical
+// baskets in different shards stay separate rows with their own
+// multiplicities — weighted counts are unaffected, so cross-shard dedup
+// is unnecessary for correctness (it is only a compression).
+//
+// KNOWN DEBT: these two functions repeat the line-split/tokenizer and
+// basket-dedup machinery of fa_preprocess_buffer rather than sharing
+// factored helpers.  Any change to tokenization or dedup semantics must
+// be applied to all copies; the contract tests pin them together
+// (tests/test_native.py equality vs the Python path, and
+// tests/test_distributed.py's sharded-vs-oracle bit-exactness).
+
+struct FaCounts {
+  int64_t n_lines;
+  int64_t n_tokens;    // distinct tokens seen in this buffer
+  char* tokens_buf;    // '\n'-joined distinct tokens (arbitrary order)
+  int64_t tokens_buf_len;
+  int64_t* counts;     // [n_tokens] occurrence counts
+};
+
+void fa_free_counts(FaCounts* res) {
+  if (!res) return;
+  std::free(res->tokens_buf);
+  std::free(res->counts);
+  std::free(res);
+}
+
+FaCounts* fa_count_buffer(const char* data, int64_t len) {
+  std::string_view buf(data, static_cast<size_t>(len));
+  int64_t* dense_counts =
+      static_cast<int64_t*>(std::calloc(kDenseCap, sizeof(int64_t)));
+  std::unordered_map<std::string_view, int64_t> side;
+  side.reserve(1 << 14);
+  int64_t max_dense_id = -1;
+  int64_t n_lines = 0;
+  size_t pos = 0;
+  while (pos <= buf.size()) {
+    size_t nl = buf.find('\n', pos);
+    size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
+    if (nl == std::string_view::npos && pos == buf.size()) break;
+    std::string_view line = buf.substr(pos, end - pos);
+    size_t b = 0, e = line.size();
+    while (b < e && static_cast<unsigned char>(line[b]) <= 0x20) ++b;
+    while (e > b && static_cast<unsigned char>(line[e - 1]) <= 0x20) --e;
+    line = line.substr(b, e - b);
+    ++n_lines;
+    if (line.empty()) {
+      ++side[std::string_view("")];  // Java split("") -> [""]
+    } else {
+      const char* p = line.data();
+      const char* endp = p + line.size();
+      while (p < endp) {
+        while (p < endp && is_ws(static_cast<unsigned char>(*p))) ++p;
+        if (p >= endp) break;
+        const char* start = p;
+        int64_t v = 0;
+        bool digits_only = dense_counts != nullptr;
+        while (p < endp && !is_ws(static_cast<unsigned char>(*p))) {
+          unsigned char c = static_cast<unsigned char>(*p) - '0';
+          if (c > 9) {
+            digits_only = false;
+          } else if (p - start < 7) {
+            v = v * 10 + c;
+          }
+          ++p;
+        }
+        size_t n = static_cast<size_t>(p - start);
+        if (digits_only && n <= 7 && !(start[0] == '0' && n > 1)) {
+          ++dense_counts[v];
+          if (v > max_dense_id) max_dense_id = v;
+        } else {
+          ++side[std::string_view(start, n)];
+        }
+      }
+    }
+    if (nl == std::string_view::npos) break;
+    pos = nl + 1;
+  }
+
+  auto* res = static_cast<FaCounts*>(std::calloc(1, sizeof(FaCounts)));
+  if (!res) {
+    std::free(dense_counts);
+    return nullptr;
+  }
+  res->n_lines = n_lines;
+  std::vector<std::pair<std::string, int64_t>> items;
+  for (int64_t id = 0; id <= max_dense_id; ++id) {
+    if (dense_counts[id] > 0) {
+      items.emplace_back(std::to_string(id), dense_counts[id]);
+    }
+  }
+  for (const auto& [tok, c] : side) {
+    items.emplace_back(std::string(tok), c);
+  }
+  std::free(dense_counts);
+  res->n_tokens = static_cast<int64_t>(items.size());
+  int64_t buf_len = 0;
+  for (const auto& [tok, c] : items) buf_len += tok.size() + 1;
+  res->tokens_buf = static_cast<char*>(std::malloc(buf_len ? buf_len : 1));
+  res->counts = static_cast<int64_t*>(
+      std::malloc(sizeof(int64_t) * (items.empty() ? 1 : items.size())));
+  if (!res->tokens_buf || !res->counts) {
+    fa_free_counts(res);
+    return nullptr;
+  }
+  res->tokens_buf_len = buf_len ? buf_len - 1 : 0;  // drop trailing '\n'
+  char* w = res->tokens_buf;
+  for (size_t i = 0; i < items.size(); ++i) {
+    std::memcpy(w, items[i].first.data(), items[i].first.size());
+    w += items[i].first.size();
+    *w++ = '\n';
+    res->counts[i] = items[i].second;
+  }
+  return res;
+}
+
+// ranks_buf: '\n'-joined item tokens in GLOBAL rank order (f of them).
+// Returns an FaResult whose baskets/weights cover only this buffer's
+// lines; item_counts is zeroed and items_buf empty (the caller owns the
+// global tables).
+FaResult* fa_compress_with_ranks(const char* data, int64_t len,
+                                 const char* ranks_buf, int64_t ranks_len,
+                                 int32_t f) {
+  std::string_view buf(data, static_cast<size_t>(len));
+  // Rank lookup tables keyed like the tokenizer emits: canonical small
+  // decimals through a dense array, everything else via the hash map.
+  int64_t max_dense_id = -1;
+  std::vector<std::pair<std::string_view, int32_t>> side_entries;
+  std::vector<std::pair<int64_t, int32_t>> dense_entries;
+  {
+    std::string_view rb(ranks_buf, static_cast<size_t>(ranks_len));
+    size_t pos = 0;
+    int32_t r = 0;
+    while (r < f) {
+      size_t nl = rb.find('\n', pos);
+      size_t end = (nl == std::string_view::npos) ? rb.size() : nl;
+      std::string_view tok = rb.substr(pos, end - pos);
+      int64_t id = fast_id(tok);
+      if (id >= 0) {
+        dense_entries.emplace_back(id, r + 1);
+        if (id > max_dense_id) max_dense_id = id;
+      } else {
+        side_entries.emplace_back(tok, r + 1);
+      }
+      ++r;
+      if (nl == std::string_view::npos) break;
+      pos = nl + 1;
+    }
+    if (r != f) return nullptr;  // malformed rank table
+  }
+  int32_t* dense_rank = nullptr;
+  if (max_dense_id >= 0) {
+    dense_rank = static_cast<int32_t*>(
+        std::calloc(max_dense_id + 1, sizeof(int32_t)));
+    if (!dense_rank) return nullptr;
+    for (const auto& [id, r] : dense_entries) dense_rank[id] = r;
+  }
+  std::unordered_map<std::string_view, int32_t> side_rank;
+  side_rank.reserve(side_entries.size() * 2 + 8);
+  for (const auto& [tok, r] : side_entries) side_rank[tok] = r;
+
+  // Pass 2 over this buffer only (re-tokenizes; there is no pass-1
+  // capture here — the extra scan is per-shard and parallel across
+  // processes).  Same bitset fast path and arena dedup as
+  // fa_preprocess_buffer.
+  struct I32Buf {
+    int32_t* p = nullptr;
+    size_t n = 0, cap = 0;
+    bool reserve(size_t want) {
+      if (want <= cap) return true;
+      size_t nc = cap ? cap * 2 : (1u << 20);
+      while (nc < want) nc *= 2;
+      auto* np_ = static_cast<int32_t*>(std::realloc(p, nc * sizeof(int32_t)));
+      if (!np_) return false;
+      p = np_;
+      cap = nc;
+      return true;
+    }
+    bool append(const int32_t* src, size_t k) {
+      if (!reserve(n + k)) return false;
+      std::memcpy(p + n, src, k * sizeof(int32_t));
+      n += k;
+      return true;
+    }
+  } arena;
+  std::vector<int64_t> b_off;
+  std::vector<int32_t> b_len, b_weight;
+  std::vector<uint64_t> b_hash;
+  size_t table_size = 1 << 12;
+  std::vector<int64_t> table(table_size, -1);
+  auto hash_basket = [](const int32_t* p, size_t n) {
+    uint64_t h = 0x243F6A8885A308D3ull ^ n;
+    for (size_t i = 0; i < n; ++i) {
+      h ^= static_cast<uint32_t>(p[i]);
+      h *= 0x9E3779B97F4A7C15ull;
+      h ^= h >> 29;
+    }
+    return h;
+  };
+  auto grow_table = [&]() {
+    table_size *= 2;
+    std::fill(table.begin(), table.end(), -1);
+    table.resize(table_size, -1);
+    const size_t mask = table_size - 1;
+    for (size_t id = 0; id < b_off.size(); ++id) {
+      size_t slot = static_cast<size_t>(b_hash[id]) & mask;
+      while (table[slot] != -1) slot = (slot + 1) & mask;
+      table[slot] = static_cast<int64_t>(id);
+    }
+  };
+  std::vector<int32_t> scratch;
+  const size_t n_words = (static_cast<size_t>(f) + 63) / 64;
+  const bool use_bitset = f > 0 && f <= 4096;
+  std::vector<uint64_t> rank_bits(use_bitset ? n_words : 0, 0);
+  int64_t n_lines = 0;
+  size_t pos = 0;
+  while (pos <= buf.size()) {
+    size_t nl = buf.find('\n', pos);
+    size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
+    if (nl == std::string_view::npos && pos == buf.size()) break;
+    std::string_view line = buf.substr(pos, end - pos);
+    size_t b = 0, e = line.size();
+    while (b < e && static_cast<unsigned char>(line[b]) <= 0x20) ++b;
+    while (e > b && static_cast<unsigned char>(line[e - 1]) <= 0x20) --e;
+    line = line.substr(b, e - b);
+    ++n_lines;
+    scratch.clear();
+    auto add_rank = [&](int32_t r) {
+      if (!r) return;
+      if (use_bitset) {
+        uint32_t rr = static_cast<uint32_t>(r - 1);
+        rank_bits[rr >> 6] |= 1ull << (rr & 63);
+      } else {
+        scratch.push_back(r - 1);
+      }
+    };
+    if (line.empty()) {
+      auto it = side_rank.find(std::string_view(""));
+      if (it != side_rank.end()) add_rank(it->second);
+    } else {
+      const char* p = line.data();
+      const char* endp = p + line.size();
+      while (p < endp) {
+        while (p < endp && is_ws(static_cast<unsigned char>(*p))) ++p;
+        if (p >= endp) break;
+        const char* start = p;
+        int64_t v = 0;
+        bool digits_only = true;
+        while (p < endp && !is_ws(static_cast<unsigned char>(*p))) {
+          unsigned char c = static_cast<unsigned char>(*p) - '0';
+          if (c > 9) {
+            digits_only = false;
+          } else if (p - start < 7) {
+            v = v * 10 + c;
+          }
+          ++p;
+        }
+        size_t n = static_cast<size_t>(p - start);
+        int32_t r = 0;
+        if (digits_only && n <= 7 && !(start[0] == '0' && n > 1)) {
+          if (dense_rank && v <= max_dense_id) r = dense_rank[v];
+        } else {
+          auto it = side_rank.find(std::string_view(start, n));
+          if (it != side_rank.end()) r = it->second;
+        }
+        add_rank(r);
+      }
+    }
+    if (use_bitset) {
+      for (size_t wi = 0; wi < n_words; ++wi) {
+        uint64_t w = rank_bits[wi];
+        if (!w) continue;
+        rank_bits[wi] = 0;
+        do {
+          scratch.push_back(static_cast<int32_t>(
+              (wi << 6) + static_cast<size_t>(__builtin_ctzll(w))));
+          w &= w - 1;
+        } while (w);
+      }
+    } else {
+      std::sort(scratch.begin(), scratch.end());
+      scratch.erase(std::unique(scratch.begin(), scratch.end()),
+                    scratch.end());
+    }
+    const size_t n = scratch.size();
+    if (n > 1) {
+      const uint64_t h = hash_basket(scratch.data(), n);
+      const size_t mask = table_size - 1;
+      size_t slot = static_cast<size_t>(h) & mask;
+      while (true) {
+        int64_t id = table[slot];
+        if (id == -1) {
+          table[slot] = static_cast<int64_t>(b_off.size());
+          b_off.push_back(static_cast<int64_t>(arena.n));
+          b_len.push_back(static_cast<int32_t>(n));
+          b_weight.push_back(1);
+          b_hash.push_back(h);
+          if (!arena.append(scratch.data(), n)) {
+            std::free(arena.p);
+            std::free(dense_rank);
+            return nullptr;
+          }
+          if (b_off.size() * 10 >= table_size * 7) grow_table();
+          break;
+        }
+        if (b_hash[id] == h && b_len[id] == static_cast<int32_t>(n) &&
+            std::memcmp(arena.p + b_off[id], scratch.data(),
+                        n * sizeof(int32_t)) == 0) {
+          ++b_weight[id];
+          break;
+        }
+        slot = (slot + 1) & mask;
+      }
+    }
+    if (nl == std::string_view::npos) break;
+    pos = nl + 1;
+  }
+  const int64_t t = static_cast<int64_t>(b_off.size());
+  const int64_t total_items = static_cast<int64_t>(arena.n);
+
+  auto* res = static_cast<FaResult*>(std::calloc(1, sizeof(FaResult)));
+  if (!res) {
+    std::free(arena.p);
+    std::free(dense_rank);
+    return nullptr;
+  }
+  res->n_raw = n_lines;
+  res->min_count = 0;
+  res->n_items = f;
+  res->n_baskets = t;
+  res->items_buf = static_cast<char*>(std::malloc(1));
+  res->items_buf_len = 0;
+  res->item_counts =
+      static_cast<int64_t*>(std::calloc(f ? f : 1, sizeof(int64_t)));
+  res->basket_offsets =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (t + 1)));
+  res->basket_items = total_items
+      ? arena.p
+      : static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
+  if (!total_items) std::free(arena.p);
+  res->weights =
+      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (t ? t : 1)));
+  if (!res->items_buf || !res->item_counts || !res->basket_offsets ||
+      !res->basket_items || !res->weights) {
+    std::free(dense_rank);
+    fa_free_result(res);
+    return nullptr;
+  }
+  for (int64_t i = 0; i < t; ++i) {
+    res->basket_offsets[i] = b_off[i];
+    res->weights[i] = b_weight[i];
+  }
+  res->basket_offsets[t] = total_items;
+  std::free(dense_rank);
+  return res;
+}
+
 void fa_free_result(FaResult* res) {
   if (!res) return;
   std::free(res->items_buf);

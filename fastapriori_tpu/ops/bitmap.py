@@ -120,13 +120,17 @@ def pad_weights(weights: np.ndarray, txn_pad: int) -> np.ndarray:
     return out
 
 
-def weight_digits(weights: np.ndarray, txn_pad: int) -> Tuple[np.ndarray, List[int]]:
+def weight_digits(
+    weights: np.ndarray, txn_pad: int, min_digits: int = 1
+) -> Tuple[np.ndarray, List[int]]:
     """Decompose int32 weights into base-128 int8 digits.
 
     Returns ``(digits int8[D, T_pad], scales)`` with
     ``weights == Σ_d scales[d] * digits[d]`` and ``scales[d] = 128**d``.
     D is data-dependent but tiny (1 unless some basket repeats >= 128
-    times), and static per compilation.
+    times), and static per compilation.  ``min_digits`` pads D with zero
+    digits — multi-host shards must agree on D even when only one shard
+    holds a heavy basket (SPMD requires identical static shapes).
     """
     w = pad_weights(weights, txn_pad).astype(np.int64)
     digits: List[np.ndarray] = []
@@ -137,6 +141,6 @@ def weight_digits(weights: np.ndarray, txn_pad: int) -> Tuple[np.ndarray, List[i
         scales.append(scale)
         w //= 128
         scale *= 128
-        if not (w > 0).any():
+        if not (w > 0).any() and len(digits) >= min_digits:
             break
     return np.stack(digits, axis=0), scales

@@ -39,6 +39,28 @@ def initialize_distributed(**kwargs) -> None:
     jax.distributed.initialize(**kwargs)
 
 
+def allgather_bytes(blob: bytes) -> list:
+    """Exchange one bytes blob per process; returns the list in process
+    order.  The cross-host transport for sharded ingest's tiny global
+    tables (item counts, shard sizes) — the analog of the reference's
+    collect-to-driver for C3 (FastApriori.scala:58); the BULK data (the
+    basket shards) never crosses hosts.  Single-process: [blob]."""
+    if jax.process_count() == 1:
+        return [blob]
+    from jax.experimental import multihost_utils
+
+    lens = multihost_utils.process_allgather(
+        np.array([len(blob)], dtype=np.int64)
+    ).reshape(-1)
+    m = int(lens.max())
+    arr = np.zeros(max(m, 1), dtype=np.uint8)
+    arr[: len(blob)] = np.frombuffer(blob, np.uint8)
+    gathered = multihost_utils.process_allgather(arr)
+    return [
+        bytes(gathered[i, : int(lens[i])]) for i in range(gathered.shape[0])
+    ]
+
+
 class DeviceContext:
     """Owns the (txn × cand) device mesh and the jitted counting kernels.
 
@@ -92,15 +114,7 @@ class DeviceContext:
             bitmap, NamedSharding(self.mesh, P(AXIS, None))
         )
 
-    def upload_packed(self, packed: np.ndarray) -> jax.Array:
-        """Upload an already bit-packed ``uint8[T, F//8]`` bitmap (e.g.
-        from ops/bitmap.py build_packed_bitmap_csr) sharded over the txn
-        axis and unpack it on device into the resident int8 form."""
-        assert packed.shape[0] % self.txn_shards == 0, (
-            packed.shape,
-            self.txn_shards,
-        )
-        arr = jax.device_put(packed, self.sharding_rows())
+    def _unpack_fn(self):
         if "unpack" not in self._fns:
             from fastapriori_tpu.ops.fused import _unpack
 
@@ -113,12 +127,58 @@ class DeviceContext:
                 ),
                 donate_argnums=0,  # free the packed buffer after unpack
             )
-        return self._fns["unpack"](arr)
+        return self._fns["unpack"]
+
+    def upload_packed(self, packed: np.ndarray) -> jax.Array:
+        """Upload an already bit-packed ``uint8[T, F//8]`` bitmap (e.g.
+        from ops/bitmap.py build_packed_bitmap_csr) sharded over the txn
+        axis and unpack it on device into the resident int8 form."""
+        assert packed.shape[0] % self.txn_shards == 0, (
+            packed.shape,
+            self.txn_shards,
+        )
+        arr = jax.device_put(packed, self.sharding_rows())
+        return self._unpack_fn()(arr)
 
     def shard_weight_digits(self, w_digits: np.ndarray) -> jax.Array:
         """Place the [D, T] digit matrix with T sharded."""
         return jax.device_put(
             w_digits, NamedSharding(self.mesh, P(None, AXIS))
+        )
+
+    # -- multi-host sharded ingest ---------------------------------------
+    # Each process holds only ITS rows of the global bitmap (sharded
+    # ingest, preprocess.py preprocess_file_sharded); the global array is
+    # assembled without any cross-host data movement — the mesh's device
+    # order is process-major, so process p's rows are exactly the rows
+    # the txn sharding assigns to p's devices.
+    def upload_packed_local(self, packed_local: np.ndarray) -> jax.Array:
+        """Multi-process twin of :meth:`upload_packed`: ``packed_local``
+        is THIS process's rows (uniform count across processes)."""
+        if jax.process_count() == 1:
+            return self.upload_packed(packed_local)
+        global_shape = (
+            packed_local.shape[0] * jax.process_count(),
+            packed_local.shape[1],
+        )
+        arr = jax.make_array_from_process_local_data(
+            self.sharding_rows(), packed_local, global_shape
+        )
+        return self._unpack_fn()(arr)
+
+    def shard_weight_digits_local(self, w_digits_local: np.ndarray):
+        """Multi-process twin of :meth:`shard_weight_digits` ([D, T_local]
+        per process, T sharded globally)."""
+        if jax.process_count() == 1:
+            return self.shard_weight_digits(w_digits_local)
+        global_shape = (
+            w_digits_local.shape[0],
+            w_digits_local.shape[1] * jax.process_count(),
+        )
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(None, AXIS)),
+            w_digits_local,
+            global_shape,
         )
 
     def shard_weights_like(self, x: np.ndarray) -> jax.Array:

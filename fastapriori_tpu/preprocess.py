@@ -33,6 +33,26 @@ from fastapriori_tpu.utils.order import item_sort_key
 
 
 @dataclasses.dataclass
+class ShardInfo:
+    """Present when a CompressedData holds one PROCESS's shard of the
+    transactions (multi-host sharded ingest, :func:`preprocess_file_sharded`):
+    the basket CSR covers only this process's byte range of D.dat, while
+    every scalar/table field (n_raw, min_count, freq_items, item_counts)
+    is GLOBAL.  Identical baskets in different shards stay separate rows
+    with their own multiplicities — weighted support counts are identical
+    with or without cross-shard dedup."""
+
+    process_id: int
+    num_processes: int
+    local_counts: List[int]  # distinct-basket count per process
+    max_weight: int  # GLOBAL max multiplicity (uniform digit count)
+
+    @property
+    def global_count(self) -> int:
+        return sum(self.local_counts)
+
+
+@dataclasses.dataclass
 class CompressedData:
     """Output of phase 1 preprocessing — the miner's entire input.
 
@@ -49,6 +69,7 @@ class CompressedData:
     basket_indices: np.ndarray  # int32[nnz] flattened sorted ranks
     basket_offsets: np.ndarray  # int64[T'+1]
     weights: np.ndarray  # int32[T'] multiplicities
+    shard: Optional[ShardInfo] = None  # multi-host sharded ingest
 
     @property
     def num_items(self) -> int:
@@ -237,3 +258,133 @@ def dedup_user_baskets(
     baskets = [np.asarray(k, dtype=np.int32) for k in order]
     indexes = [index_map[k] for k in order]
     return baskets, indexes, empty
+
+
+# ----------------------------------------------------------------------
+# Multi-host sharded ingest (the distributed analog of the reference's
+# C3/C4 Spark passes, FastApriori.scala:52-85): each PROCESS reads and
+# compresses only its own byte range of D.dat; only the tiny per-token
+# count tables cross hosts (parallel/mesh.py allgather_bytes).  Identical
+# baskets in different shards stay separate rows with their own
+# multiplicities — weighted support counts are unchanged, so cross-shard
+# dedup is unnecessary for correctness.
+
+
+def shard_byte_range(size: int, idx: int, n: int) -> Tuple[int, int]:
+    """Nominal byte range for shard ``idx`` of ``n``; the reader aligns
+    the start forward to the first line beginning at/after it (shard 0
+    starts at 0), and reads through the end of the line straddling the
+    nominal end — every line lands in exactly one shard."""
+    return (size * idx) // n, (size * (idx + 1)) // n
+
+
+def read_shard(path: str, idx: int, n: int) -> bytes:
+    """Read shard ``idx``'s lines (see :func:`shard_byte_range`)."""
+    import os
+
+    size = os.path.getsize(path)
+    lo, hi = shard_byte_range(size, idx, n)
+    with open(path, "rb") as fh:
+        if lo > 0:
+            # Align forward: skip the partial line the previous shard owns.
+            fh.seek(lo - 1)
+            prev = fh.read(1)
+            if prev != b"\n":
+                fh.readline()
+            lo = fh.tell()
+        else:
+            fh.seek(0)
+        data = fh.read(max(hi - lo, 0))
+        if not data:
+            return b""
+        # Extend through the end of the straddling line.
+        if not data.endswith(b"\n"):
+            data += fh.readline()
+        return data
+
+
+def preprocess_file_sharded(
+    path: str,
+    min_support: float,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    allgather=None,
+) -> CompressedData:
+    """Phase-1 preprocessing of THIS process's shard of ``D.dat`` against
+    globally merged item counts.  Every process must call this (SPMD);
+    the returned CompressedData carries global tables + local baskets and
+    a :class:`ShardInfo` the mining engine uses to build its slice of the
+    global bitmap (``jax.make_array_from_process_local_data``).
+
+    ``process_id``/``num_processes``/``allgather`` default to the live
+    ``jax.distributed`` world; tests inject their own to exercise the
+    logic without multiple processes."""
+    import pickle
+
+    if allgather is None:
+        from fastapriori_tpu.parallel.mesh import allgather_bytes as allgather
+    if process_id is None or num_processes is None:
+        import jax
+
+        process_id = jax.process_index()
+        num_processes = jax.process_count()
+
+    from fastapriori_tpu.native.loader import (
+        compress_with_ranks,
+        count_buffer,
+    )
+
+    data = read_shard(path, process_id, num_processes)
+    n_lines, tokens, counts = count_buffer(data)
+
+    # Merge the per-process count tables (all tiny next to the data).
+    blobs = allgather(
+        pickle.dumps((n_lines, tokens, counts), protocol=4)
+    )
+    assert len(blobs) == num_processes, (len(blobs), num_processes)
+    merged: Dict[str, int] = {}
+    n_raw = 0
+    for blob in blobs:
+        nl, toks, cnts = pickle.loads(blob)
+        n_raw += nl
+        for tok, c in zip(toks, cnts.tolist()):
+            merged[tok] = merged.get(tok, 0) + c
+    min_count = math.ceil(min_support * n_raw)
+    # Identical global ranks on every process: same sort key as the
+    # single-host paths (utils/order.py — deterministic tie-break).
+    freq = [(t, c) for t, c in merged.items() if c >= min_count]
+    freq.sort(key=item_sort_key)
+    freq_items = [t for t, _ in freq]
+    item_counts = np.array([c for _, c in freq], dtype=np.int64)
+
+    _, indices, offsets, weights = compress_with_ranks(data, freq_items)
+
+    # Per-process distinct-basket counts + global max weight (uniform
+    # padding and digit count across processes).
+    local_blob = pickle.dumps(
+        (len(weights), int(weights.max()) if len(weights) else 1),
+        protocol=4,
+    )
+    local_counts: List[int] = []
+    max_w = 1
+    for blob in allgather(local_blob):
+        t_loc, w_loc = pickle.loads(blob)
+        local_counts.append(t_loc)
+        max_w = max(max_w, w_loc)
+
+    return CompressedData(
+        n_raw=n_raw,
+        min_count=min_count,
+        freq_items=freq_items,
+        item_to_rank={item: r for r, item in enumerate(freq_items)},
+        item_counts=item_counts,
+        basket_indices=indices,
+        basket_offsets=offsets,
+        weights=weights,
+        shard=ShardInfo(
+            process_id=process_id,
+            num_processes=num_processes,
+            local_counts=local_counts,
+            max_weight=max_w,
+        ),
+    )
