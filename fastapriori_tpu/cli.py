@@ -174,10 +174,38 @@ def _run(args) -> int:
                 file=sys.stderr,
             )
             return 2
+    n_proc, proc_id = 1, 0
     if args.distributed:
         from fastapriori_tpu.parallel.mesh import initialize_distributed
 
-        initialize_distributed()
+        try:
+            initialize_distributed()
+        except RuntimeError as e:
+            # "should only be called once" = the launcher already
+            # initialized jax.distributed — fine, proceed.  Any OTHER
+            # RuntimeError (e.g. "must be called before any JAX
+            # computations") means a real multi-process launch would
+            # silently degrade to N independent runs racing on the same
+            # output files — fail loudly instead.
+            if "once" not in str(e):
+                print(
+                    f"error: --distributed initialization failed: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+        except ValueError as e:
+            # Incomplete/absent coordinator config — surface jax's own
+            # message (it names the missing piece) and proceed
+            # single-process.
+            print(
+                f"--distributed: {e} — running single-process "
+                "(initialize jax.distributed in the launcher, or set "
+                "the cluster environment it auto-detects)",
+                file=sys.stderr,
+            )
+        import jax
+
+        n_proc, proc_id = jax.process_count(), jax.process_index()
 
     # Imports deferred so --help works without initializing a backend.
     from fastapriori_tpu.models.apriori import FastApriori
@@ -203,22 +231,28 @@ def _run(args) -> int:
         # the way into the writer and rule generator — no per-itemset
         # Python objects (multi-second at 10^6-itemset scale).
         miner = FastApriori(args.min_support, config=config)
-        levels, data = miner.run_file_raw(args.input + "D.dat")
+        if n_proc > 1:
+            # Multi-host: each process preprocesses only its own byte
+            # range of D.dat (sharded ingest); results are replicated.
+            levels, data = miner.run_file_sharded(args.input + "D.dat")
+        else:
+            levels, data = miner.run_file_raw(args.input + "D.dat")
         item_to_rank, freq_items = data.item_to_rank, data.freq_items
         item_counts = data.item_counts
         freq_itemsets = []
         if profiler is not None:
             profiler.stop_trace()
-        from fastapriori_tpu.io.writer import save_freq_itemsets_levels
+        if proc_id == 0:  # one writer, like the reference's driver
+            from fastapriori_tpu.io.writer import save_freq_itemsets_levels
 
-        save_freq_itemsets_levels(
-            args.output, levels, item_counts, freq_items,
-            with_counts_path=args.save_counts,
-        )
-        if args.save_counts:
-            from fastapriori_tpu.io.resume import save_phase1_aux
+            save_freq_itemsets_levels(
+                args.output, levels, item_counts, freq_items,
+                with_counts_path=args.save_counts,
+            )
+            if args.save_counts:
+                from fastapriori_tpu.io.resume import save_phase1_aux
 
-            save_phase1_aux(args.output, freq_items, item_to_rank)
+                save_phase1_aux(args.output, freq_items, item_to_rank)
     print(
         "==== Total time for get freqItemsets "
         f"{int((time.perf_counter() - t1) * 1e3)}",
@@ -226,12 +260,18 @@ def _run(args) -> int:
     )
 
     t2 = time.perf_counter()
-    recommender = AssociationRules(
-        freq_itemsets, freq_items, item_to_rank, config=config,
-        levels=levels, item_counts=item_counts,
-    )
-    recommends = recommender.run(u_lines)
-    save_recommends(args.output, recommends)
+    if proc_id == 0:
+        recommender = AssociationRules(
+            freq_itemsets, freq_items, item_to_rank, config=config,
+            levels=levels, item_counts=item_counts,
+        )
+        # Multi-process: the recommender's containment kernel shards
+        # baskets over the GLOBAL mesh, which would need its own
+        # process-local placement; phase 2 is pure host code with no
+        # collectives, so process 0 alone runs it (host first-match
+        # scan) and the others skip straight to exit.
+        recommends = recommender.run(u_lines, use_device=n_proc == 1)
+        save_recommends(args.output, recommends)
     print(
         "==== Total time for get recommends "
         f"{int((time.perf_counter() - t2) * 1e3)}",

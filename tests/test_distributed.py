@@ -90,12 +90,87 @@ if int(pid) == 0:
 """
 
 
+_CHILD_CLI = r"""
+import sys
+import jax
+
+coordinator, n_proc, pid, inp, outp = sys.argv[1:6]
+jax.config.update("jax_platforms", "cpu")
+from fastapriori_tpu.parallel.mesh import initialize_distributed
+
+initialize_distributed(
+    coordinator_address=coordinator,
+    num_processes=int(n_proc),
+    process_id=int(pid),
+)
+from fastapriori_tpu.cli import main
+
+rc = main([inp, outp, "--min-support", "0.05", "--distributed",
+           "--engine", "level"])
+sys.exit(rc)
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def test_two_process_cli_end_to_end(tmp_path):
+    """The full CLI under --distributed with 2 processes: sharded ingest
+    for mining, host first-match for recommendation, process 0 writing
+    byte-exact output files."""
+    d_raw = ["1 2 3"] * 40 + random_dataset(4, n_txns=120, n_items=20)
+    u_raw = random_dataset(14, n_txns=25, n_items=20)
+    (tmp_path / "in").mkdir()
+    (tmp_path / "out").mkdir()
+    (tmp_path / "in" / "D.dat").write_text(
+        "".join(l + "\n" for l in d_raw)
+    )
+    (tmp_path / "in" / "U.dat").write_text(
+        "".join(l + "\n" for l in u_raw)
+    )
+    inp = str(tmp_path / "in") + "/"
+    outp = str(tmp_path / "out") + "/"
+
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_CPU_DEVICES")
+    }
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _CHILD_CLI,
+                f"127.0.0.1:{port}", "2", str(pid), inp, outp,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("2-process jax.distributed run timed out (ports/env)")
+    for rc, out, err in outs:
+        assert rc == 0, err.decode()[-3000:]
+
+    d_lines = [l.split() for l in d_raw]
+    u_lines = [l.split() for l in u_raw]
+    exp_freq, exp_rec = oracle.run_pipeline(d_lines, u_lines, 0.05)
+    assert (tmp_path / "out" / "freqItemset").read_text() == exp_freq
+    assert (tmp_path / "out" / "recommends").read_text() == exp_rec
 
 
 def test_two_process_sharded_ingest_matches_oracle(tmp_path):
