@@ -260,17 +260,17 @@ def _run(args) -> int:
     )
 
     t2 = time.perf_counter()
+    # Phase 2 runs on EVERY process: the containment kernel shards the
+    # (deduplicated) user baskets over the global mesh, so each process
+    # computes only its own rows and one allgather reassembles the
+    # result — the work is genuinely divided, not duplicated.  Process 0
+    # writes, like the reference's driver.
+    recommender = AssociationRules(
+        freq_itemsets, freq_items, item_to_rank, config=config,
+        levels=levels, item_counts=item_counts,
+    )
+    recommends = recommender.run(u_lines)
     if proc_id == 0:
-        recommender = AssociationRules(
-            freq_itemsets, freq_items, item_to_rank, config=config,
-            levels=levels, item_counts=item_counts,
-        )
-        # Multi-process: the recommender's containment kernel shards
-        # baskets over the GLOBAL mesh, which would need its own
-        # process-local placement; phase 2 is pure host code with no
-        # collectives, so process 0 alone runs it (host first-match
-        # scan) and the others skip straight to exit.
-        recommends = recommender.run(u_lines, use_device=n_proc == 1)
         save_recommends(args.output, recommends)
     print(
         "==== Total time for get recommends "

@@ -71,8 +71,18 @@ class AssociationRules:
 
     # ------------------------------------------------------------------
     def run(
-        self, user_lines: Sequence[Sequence[str]], use_device: bool = True
+        self,
+        user_lines: Sequence[Sequence[str]],
+        use_device: Optional[bool] = None,
     ) -> List[Tuple[int, str]]:
+        """``use_device=None`` auto-selects: the containment-matmul path
+        for real workloads, the host first-match scan when the problem is
+        small (distinct-baskets × rules below 3·10^7 — the host scan
+        early-exits per user so its true cost is far below that product,
+        while the device path carries ~seconds of fixed dispatch and
+        transfer costs, especially on tunneled chips).  Deterministic in
+        the inputs, so every process of a multi-host run picks the same
+        path."""
         with self.metrics.timed("user_dedup") as m:
             baskets, indexes, empty = dedup_user_baskets(
                 user_lines, self.item_to_rank
@@ -100,6 +110,13 @@ class AssociationRules:
                 out.extend((i, "0") for i in rows)
             return out
 
+        if use_device is None:
+            # The host scan early-exits at each user's first match, so
+            # its real cost is far below users × rules; the device path
+            # carries ~seconds of fixed dispatch/transfer cost on
+            # tunneled chips.  3e7 keeps small jobs on the host while
+            # movielens-scale (16K users × 10^5 rules) goes on device.
+            use_device = len(baskets) * len(rules) >= 30_000_000
         with self.metrics.timed("first_match", device=use_device):
             if use_device:
                 recs = self._device_first_match(baskets, rules)
@@ -157,6 +174,16 @@ class AssociationRules:
         basket_len = np.zeros(nb_pad, dtype=np.int32)
         basket_len[:nb] = [len(b) for b in baskets]
 
+        # Multi-process: every process has the full (replicated) user
+        # table but places only ITS row slice of the sharded arrays; the
+        # chunk kernel has no collectives, so processes may even stop at
+        # different chunks — one process_allgather at the end reassembles
+        # the global best vector.
+        import jax
+
+        n_proc = jax.process_count()
+        row = ctx.local_row_slice(nb_pad) if n_proc > 1 else slice(None)
+
         r = len(rules)
         chunk = pad_axis(max(1, cfg.rule_chunk), 128)  # lane-aligned
         r_pad = pad_axis(r, chunk)
@@ -165,10 +192,16 @@ class AssociationRules:
         consequent = np.zeros(r_pad, dtype=np.int32)
         consequent[:r] = [c for _, c, _ in rules]
 
-        baskets_dev = ctx.shard_bitmap(basket_mat)
-        basket_len_dev = ctx.shard_weights_like(basket_len)
-        best = ctx.shard_weights_like(
-            np.full(nb_pad, int(NO_MATCH), dtype=np.int32)
+        baskets_dev = ctx.shard_rows_local(basket_mat[row])
+        basket_len_dev = ctx.shard_rows_local(basket_len[row])
+        best = ctx.shard_rows_local(
+            np.full(nb_pad, int(NO_MATCH), dtype=np.int32)[row]
+        )
+        # The early exit (and its lagged fetch) watches only THIS
+        # process's rows; rows this process can check are its local ones.
+        local_hi = min(row.stop, nb) if n_proc > 1 else nb
+        local_done = (
+            slice(row.start, local_hi) if n_proc > 1 else slice(0, nb)
         )
         best_np = None
         prev = None  # previous chunk's best (async copy in flight)
@@ -204,15 +237,28 @@ class AssociationRules:
             # host<->device round trip per chunk.  Exiting on the lagged
             # state is exact — later chunks hold only larger rule
             # indices, so once every basket has matched the running min
-            # cannot change.
+            # cannot change.  Multi-process: each process watches only
+            # its own rows (the chunk kernel has no collectives, so
+            # processes may stop at different chunks safely).
             if prev is not None:
-                prev_np = np.asarray(prev)
-                if (prev_np[:nb] < int(NO_MATCH)).all():
+                prev_np = ctx.local_rows(prev)
+                # Clamped: a tail process whose entire slice is padding
+                # has n_real == 0 and exits after its first chunk.
+                n_real = max(0, local_done.stop - local_done.start)
+                if (prev_np[:n_real] < int(NO_MATCH)).all():
                     best_np = prev_np
                     break
             prev = best
         if best_np is None:
-            best_np = np.asarray(best)
+            best_np = ctx.local_rows(best)
+        if n_proc > 1:
+            # Reassemble the global vector (one collective; every
+            # process reaches here exactly once).
+            from jax.experimental import multihost_utils
+
+            best_np = multihost_utils.process_allgather(
+                best_np
+            ).reshape(-1)
         best_np = best_np[:nb]
         found = best_np < int(NO_MATCH)
         rec = np.where(found, consequent[np.minimum(best_np, r_pad - 1)], -1)

@@ -181,6 +181,49 @@ class DeviceContext:
             global_shape,
         )
 
+    def shard_rows_local(self, local: np.ndarray) -> jax.Array:
+        """Rows-on-txn placement from per-process row slices (all
+        processes must pass the same local row count)."""
+        if jax.process_count() == 1:
+            if local.ndim == 1:
+                return self.shard_weights_like(local)
+            return self.shard_bitmap(local)
+        global_shape = (
+            local.shape[0] * jax.process_count(),
+        ) + local.shape[1:]
+        spec = P(AXIS, *([None] * (local.ndim - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), local, global_shape
+        )
+
+    def local_row_slice(self, n_rows_global: int) -> slice:
+        """This process's contiguous row range of a txn-sharded array
+        (device order is process-major)."""
+        n_proc = jax.process_count()
+        assert n_rows_global % n_proc == 0, (n_rows_global, n_proc)
+        per = n_rows_global // n_proc
+        p = jax.process_index()
+        return slice(p * per, (p + 1) * per)
+
+    def local_rows(self, arr) -> np.ndarray:
+        """This process's rows of a txn-sharded device array as numpy
+        (whole array when single-process).  Inverse of
+        :meth:`shard_rows_local`; lives here so every placement
+        invariant (process-major row order, cand-axis REPLICATION — a
+        2-D mesh holds cand_shards identical copies of each row block,
+        which must be deduplicated, not concatenated) stays in one
+        place."""
+        if jax.process_count() == 1:
+            return np.asarray(arr)
+        seen = {}
+        for s in arr.addressable_shards:
+            start = s.index[0].start or 0
+            if start not in seen:
+                seen[start] = s.data
+        return np.concatenate(
+            [np.asarray(seen[k]) for k in sorted(seen)]
+        )
+
     def shard_weights_like(self, x: np.ndarray) -> jax.Array:
         """Place a 1-D per-transaction (or per-basket) vector sharded over
         the txn axis."""
