@@ -331,7 +331,7 @@ def _recommend_workload(args, raw, d_path) -> int:
         itemsets, freq_items, item_to_rank, config=cfg,
         context=miner.context,
     )
-    rec.run(u_lines[:128])  # warm the containment kernel
+    rec.run(u_lines[:128], use_device=True)  # warm the containment kernel
     t0 = time.perf_counter()
     out = rec.run(u_lines)
     wall = time.perf_counter() - t0

@@ -189,6 +189,7 @@ class AssociationRules:
         r_pad = pad_axis(r, chunk)
         ant_rows = [np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules]
         lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
+        k_max = int(lens.max()) if r else 1
         consequent = np.zeros(r_pad, dtype=np.int32)
         consequent[:r] = [c for _, c, _ in rules]
 
@@ -205,15 +206,27 @@ class AssociationRules:
         )
         best_np = None
         prev = None  # previous chunk's best (async copy in flight)
-        for c0 in range(0, r_pad, chunk):
+        zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
+        # The lagged early-exit fetch is a host<->device round trip
+        # (~65 ms on tunneled chips); checking every chunk made a
+        # 100-chunk scan round-trip-bound.  Check every CHECK_EVERY
+        # chunks: at most that many extra chunks dispatch past the match
+        # point, while fetch round trips drop by the same factor.
+        CHECK_EVERY = 8
+        for step, c0 in enumerate(range(0, r_pad, chunk)):
             hi = min(c0 + chunk, r)
             n_c = hi - c0  # real rules in this chunk (0 for pure padding)
-            ant_c = np.zeros((chunk, f_pad), dtype=np.int8)
+            # Compact [chunk, k_max] column-index form (padding -> the
+            # zero column); the kernel scatters to one-hot on device.
+            ant_c = np.full((chunk, k_max), zcol, dtype=np.int32)
             if n_c > 0:
                 rows = np.repeat(
                     np.arange(n_c, dtype=np.int64), lens[c0:hi]
                 )
-                ant_c[rows, np.concatenate(ant_rows[c0:hi])] = 1
+                cols = np.concatenate(
+                    [np.arange(n, dtype=np.int64) for n in lens[c0:hi]]
+                )
+                ant_c[rows, cols] = np.concatenate(ant_rows[c0:hi])
             size_c = np.full(chunk, f + 1, dtype=np.int32)  # pad: never hits
             size_c[:n_c] = lens[c0:hi]
             cons_c = np.zeros(chunk, dtype=np.int32)
@@ -227,10 +240,14 @@ class AssociationRules:
                 c0,
                 best,
             )
-            try:
-                best.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass
+            if (step + 1) % CHECK_EVERY == 0:
+                # Start the D2H copy only for the state the NEXT check
+                # will actually read — copying every chunk wasted 7/8 of
+                # the transfers on the same link the chunk uploads use.
+                try:
+                    best.copy_to_host_async()
+                except (AttributeError, NotImplementedError):
+                    pass
             # Early-exit on the PREVIOUS chunk's (already in-flight)
             # result: lagging the check by one chunk keeps consecutive
             # dispatches overlapped instead of paying a blocking
@@ -240,7 +257,7 @@ class AssociationRules:
             # cannot change.  Multi-process: each process watches only
             # its own rows (the chunk kernel has no collectives, so
             # processes may stop at different chunks safely).
-            if prev is not None:
+            if prev is not None and step % CHECK_EVERY == 0:
                 prev_np = ctx.local_rows(prev)
                 # Clamped: a tail process whose entire slice is padding
                 # has n_real == 0 and exits after its first chunk.

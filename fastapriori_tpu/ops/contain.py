@@ -36,7 +36,9 @@ NO_MATCH = 2**31 - 1
 def local_first_match_chunk(
     baskets: jnp.ndarray,  # [Nb_local, F] int8
     basket_len: jnp.ndarray,  # [Nb_local] int32
-    antecedents: jnp.ndarray,  # [Rc, F] int8 — ONE priority chunk
+    ant_cols: jnp.ndarray,  # [Rc, K] int32 — ONE priority chunk's
+    #   antecedent item ranks; padding positions point at the guaranteed
+    #   all-zero bitmap column (F_pad - 1), padding ROWS are all-padding
     ant_size: jnp.ndarray,  # [Rc] int32
     consequent: jnp.ndarray,  # [Rc] int32
     base: jnp.ndarray,  # () int32 — global index of this chunk's first rule
@@ -48,8 +50,17 @@ def local_first_match_chunk(
     batch analog processes rules in priority-ordered chunks and keeps a
     running minimum, so the caller can stop dispatching chunks once every
     basket has matched — and the [Nb, R] eligibility matrix never exists
-    at full R, only [Nb, Rc] per step."""
-    rc = antecedents.shape[0]
+    at full R, only [Nb, Rc] per step.
+
+    Antecedents arrive COMPACT ([Rc, K] column indexes, like the level
+    engine's prefix_cols) and scatter to the one-hot [Rc, F] form on
+    device: the dense form was ~13 MB per chunk over the host link at
+    movielens scale (f_pad ~1.7K) vs ~400 KB compact — chunk uploads,
+    not compute, dominated the scan on tunneled chips."""
+    from fastapriori_tpu.ops.bitmap import scatter_one_hot
+
+    rc = ant_cols.shape[0]
+    antecedents = scatter_one_hot(ant_cols, baskets.shape[1])
     overlap = lax.dot_general(
         baskets,
         antecedents,

@@ -458,12 +458,9 @@ class DeviceContext:
             interpret = self.platform == "cpu"
 
             def _local(bitmap, w_digits, prefix_cols, k1, cand_idx):
-                p = prefix_cols.shape[0]
-                s_mat = (
-                    jnp.zeros((p, bitmap.shape[1]), jnp.int8)
-                    .at[jnp.arange(p)[:, None], prefix_cols]
-                    .set(1)
-                )
+                from fastapriori_tpu.ops.bitmap import scatter_one_hot
+
+                s_mat = scatter_one_hot(prefix_cols, bitmap.shape[1])
                 counts = level_counts_pallas(
                     bitmap, w_digits, s_mat, k1, interpret=interpret
                 )

@@ -43,11 +43,11 @@ def test_recommender_single_vs_multi_device():
     rec1 = AssociationRules(
         itemsets, freq_items, item_to_rank,
         context=DeviceContext(num_devices=1),
-    ).run(u_lines)
+    ).run(u_lines, use_device=True)
     rec8 = AssociationRules(
         itemsets, freq_items, item_to_rank,
         context=DeviceContext(num_devices=8),
-    ).run(u_lines)
+    ).run(u_lines, use_device=True)
     assert sorted(rec1) == sorted(rec8)
 
 
@@ -98,5 +98,5 @@ def test_2d_mesh_full_pipeline_with_fused_engine():
     ctx = DeviceContext(num_devices=8, cand_devices=2)
     got, i2r, fi = FastApriori(config=cfg, context=ctx).run(d_lines)
     assert dict(got) == dict(exp_sets)
-    rec = AssociationRules(got, fi, i2r, config=cfg, context=ctx).run(u_lines)
+    rec = AssociationRules(got, fi, i2r, config=cfg, context=ctx).run(u_lines, use_device=True)
     assert sorted(rec) == sorted(exp_rec)

@@ -95,3 +95,25 @@ def test_level_engine_pallas_wired_path_on_tpu():
         )
     ).run(lines)
     assert dict(got) == dict(expected)
+
+
+@pytest.mark.parametrize("engine", ["fused", "level"])
+def test_engines_on_chip_match_oracle(engine):
+    """Both mining engines end-to-end on the real accelerator vs the
+    oracle (the CPU suite pins JAX to 8 virtual host devices; this is
+    the same assertion on actual hardware)."""
+    _require_accelerator()
+    from fastapriori_tpu import oracle
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.models.apriori import FastApriori
+
+    rng = np.random.default_rng(23)
+    lines = [
+        [str(x) for x in rng.choice(50, size=rng.integers(2, 11), replace=False)]
+        for _ in range(3000)
+    ] + [["1", "2", "3"]] * 200  # heavy duplicate: >127 weight digit path
+    expected, _, _ = oracle.mine(lines, 0.03)
+    got, _, _ = FastApriori(
+        config=MinerConfig(min_support=0.03, engine=engine)
+    ).run(lines)
+    assert dict(got) == dict(expected)
