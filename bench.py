@@ -332,9 +332,17 @@ def _recommend_workload(args, raw, d_path) -> int:
         context=miner.context,
     )
     rec.run(u_lines[:128], use_device=True)  # warm the containment kernel
-    t0 = time.perf_counter()
-    out = rec.run(u_lines)
-    wall = time.perf_counter() - t0
+    # Same sampling policy as the mining workload: lower-middle median of
+    # up to 3 warm runs (the first full-size run still pays one-off
+    # backend costs on tunneled chips — 2x the steady rate).
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = rec.run(u_lines)
+        walls.append(time.perf_counter() - t0)
+        if walls[-1] > 60.0:
+            break
+    wall = sorted(walls)[(len(walls) - 1) // 2]
     assert len(out) == n_users
     print(
         f"recommend: {n_users} users in {wall:.2f}s "
