@@ -16,7 +16,17 @@
 //     size <= 1, dedupe identical baskets with int32 multiplicity
 //     (FastApriori.scala:66-79); first-seen order.
 //
-// C ABI only (loaded via ctypes): fa_preprocess_buffer / fa_free_result.
+// Three entry points share the helpers below (ONE tokenizer, ONE dedup):
+//   - fa_preprocess_buffer: the whole pipeline for a single host;
+//   - fa_count_buffer + fa_compress_with_ranks: the split phases of the
+//     multi-host SHARDED ingest (preprocess.py preprocess_file_sharded) —
+//     each process counts and compresses only its own byte range against
+//     globally merged rank tables.  Identical baskets in different shards
+//     stay separate rows with their own multiplicities; weighted counts
+//     are unaffected, so cross-shard dedup is unnecessary.
+//
+// C ABI only (loaded via ctypes): fa_preprocess_buffer / fa_count_buffer /
+// fa_compress_with_ranks / fa_fill_packed_bitmap / fa_free_*.
 
 #include <algorithm>
 #include <cctype>
@@ -62,9 +72,8 @@ inline bool is_ws(unsigned char c) {
 // Dense fast path: most datasets use small decimal item ids.  A token in
 // CANONICAL decimal form (single "0", or leading digit 1-9, all digits, at
 // most 7 of them) maps to a slot in a dense array, bypassing the string
-// hash maps in both passes.  Canonical-form only: "007", "+7" and "7" are
-// DIFFERENT tokens for counting purposes and must not collide.  Returns
-// -1 when the token doesn't qualify (string-map path).
+// hash maps.  Canonical-form only: "007", "+7" and "7" are DIFFERENT
+// tokens for counting purposes and must not collide.
 constexpr int64_t kDenseCap = 10'000'000;  // ids 0..9,999,999 (<= 7 digits)
 
 inline int64_t fast_id(std::string_view s) {
@@ -123,6 +132,203 @@ bool bigint_less(const BigInt& v, const BigInt& w) {
   return v.negative ? (v.digits != w.digits && !less) : less;
 }
 
+// ---- shared scan machinery (ONE copy for all three entry points) -----
+
+// Split on '\n' (last line may lack it), trim with Java String.trim
+// semantics (chars <= 0x20), call fn(trimmed_line) per line.
+template <class Fn>
+inline void for_each_trimmed_line(std::string_view buf, Fn&& fn) {
+  size_t pos = 0;
+  while (pos <= buf.size()) {
+    size_t nl = buf.find('\n', pos);
+    size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
+    if (nl == std::string_view::npos && pos == buf.size()) break;
+    std::string_view line = buf.substr(pos, end - pos);
+    size_t b = 0, e = line.size();
+    while (b < e && static_cast<unsigned char>(line[b]) <= 0x20) ++b;
+    while (e > b && static_cast<unsigned char>(line[e - 1]) <= 0x20) --e;
+    fn(line.substr(b, e - b));
+    if (nl == std::string_view::npos) break;
+    pos = nl + 1;
+  }
+}
+
+// Tokenize one trimmed NON-EMPTY line on whitespace runs; per token call
+// fn(token_view, dense_id_or_minus1).  The canonical-decimal value
+// accumulates during the walk, so classification costs no second scan;
+// semantics identical to splitting then testing fast_id().  (Empty lines
+// are the caller's business: Java split("") yields one empty token.)
+template <class Fn>
+inline void for_each_token(std::string_view line, Fn&& fn) {
+  const char* p = line.data();
+  const char* end = p + line.size();
+  while (p < end) {
+    while (p < end && is_ws(static_cast<unsigned char>(*p))) ++p;
+    if (p >= end) break;
+    const char* start = p;
+    int64_t v = 0;
+    bool digits_only = true;
+    while (p < end && !is_ws(static_cast<unsigned char>(*p))) {
+      unsigned char c = static_cast<unsigned char>(*p) - '0';
+      if (c > 9) {
+        digits_only = false;
+      } else if (p - start < 7) {  // beyond 7 digits: non-dense anyway
+        v = v * 10 + c;
+      }
+      ++p;
+    }
+    size_t n = static_cast<size_t>(p - start);
+    bool dense = digits_only && n <= 7 && !(start[0] == '0' && n > 1);
+    fn(std::string_view(start, n), dense ? v : -1);
+  }
+}
+
+// Malloc-backed growable int32 buffer whose ownership can transfer into
+// a result struct with NO copy (the dedup arena is ~0.6 GB at Webdocs
+// scale and the marshal memcpy alone was ~2.5 s on a single-core host).
+struct I32Buf {
+  int32_t* p = nullptr;
+  size_t n = 0, cap = 0;
+  bool reserve(size_t want) {
+    if (want <= cap) return true;
+    size_t nc = cap ? cap * 2 : (1u << 20);
+    while (nc < want) nc *= 2;
+    auto* np_ = static_cast<int32_t*>(std::realloc(p, nc * sizeof(int32_t)));
+    if (!np_) return false;
+    p = np_;
+    cap = nc;
+    return true;
+  }
+  bool append(const int32_t* src, size_t k) {
+    if (!reserve(n + k)) return false;
+    std::memcpy(p + n, src, k * sizeof(int32_t));
+    n += k;
+    return true;
+  }
+  void free_buf() {
+    std::free(p);
+    p = nullptr;
+    n = cap = 0;
+  }
+};
+
+// Distinct-basket accumulator: open-addressing index over (hash, arena
+// slice) — no per-basket heap node, no rehash-time key copies; the final
+// marshal hands the arena over pointer-for-pointer.  Insertion order =
+// first-seen order (FastApriori.scala:74 zipWithIndex over the deduped
+// RDD).
+struct BasketDeduper {
+  I32Buf arena;                  // concatenated sorted rank lists
+  std::vector<int64_t> b_off;    // [t] arena offset per basket
+  std::vector<int32_t> b_len;    // [t]
+  std::vector<int32_t> b_weight; // [t] multiplicity
+  std::vector<uint64_t> b_hash;  // [t] cached for table growth
+  size_t table_size = 1 << 12;   // power of two
+  std::vector<int64_t> table = std::vector<int64_t>(1 << 12, -1);
+
+  static uint64_t hash_basket(const int32_t* p, size_t n) {
+    uint64_t h = 0x243F6A8885A308D3ull ^ n;  // word-wise mix, not per-byte
+    for (size_t i = 0; i < n; ++i) {
+      h ^= static_cast<uint32_t>(p[i]);
+      h *= 0x9E3779B97F4A7C15ull;
+      h ^= h >> 29;
+    }
+    return h;
+  }
+
+  void grow_table() {
+    table_size *= 2;
+    std::fill(table.begin(), table.end(), -1);
+    table.resize(table_size, -1);
+    const size_t mask = table_size - 1;
+    for (size_t id = 0; id < b_off.size(); ++id) {
+      size_t slot = static_cast<size_t>(b_hash[id]) & mask;
+      while (table[slot] != -1) slot = (slot + 1) & mask;
+      table[slot] = static_cast<int64_t>(id);
+    }
+  }
+
+  // Insert one sorted, deduplicated rank list (n >= 2).  False on OOM.
+  bool insert(const int32_t* ranks, size_t n) {
+    const uint64_t h = hash_basket(ranks, n);
+    const size_t mask = table_size - 1;
+    size_t slot = static_cast<size_t>(h) & mask;
+    while (true) {
+      int64_t id = table[slot];
+      if (id == -1) {  // new distinct basket
+        table[slot] = static_cast<int64_t>(b_off.size());
+        b_off.push_back(static_cast<int64_t>(arena.n));
+        b_len.push_back(static_cast<int32_t>(n));
+        b_weight.push_back(1);
+        b_hash.push_back(h);
+        if (!arena.append(ranks, n)) return false;
+        // Load factor <= 0.7 keeps linear probes short.
+        if (b_off.size() * 10 >= table_size * 7) grow_table();
+        return true;
+      }
+      if (b_hash[id] == h && b_len[id] == static_cast<int32_t>(n) &&
+          std::memcmp(arena.p + b_off[id], ranks,
+                      n * sizeof(int32_t)) == 0) {
+        ++b_weight[id];
+        return true;
+      }
+      slot = (slot + 1) & mask;
+    }
+  }
+};
+
+// Per-line sorted-unique rank collection.  Small-F fast path: an F-bit
+// set makes dedup free and a ctz walk emits sorted ranks in O(F/64 + n)
+// instead of sort+unique's O(n log n); F is minSupport-bounded
+// (hundreds on the benchmark corpora), so the per-line clear is a few
+// words.  Ranks arrive as rank+1 (0 = not frequent, ignored).
+struct RankCollector {
+  std::vector<int32_t> scratch;
+  std::vector<uint64_t> bits;
+  size_t n_words = 0;
+  bool use_bitset = false;
+
+  explicit RankCollector(int32_t f) {
+    n_words = (static_cast<size_t>(f) + 63) / 64;
+    use_bitset = f > 0 && f <= 4096;
+    if (use_bitset) bits.assign(n_words, 0);
+  }
+  inline void add(int32_t r_plus_1) {
+    if (!r_plus_1) return;
+    if (use_bitset) {
+      uint32_t rr = static_cast<uint32_t>(r_plus_1 - 1);
+      bits[rr >> 6] |= 1ull << (rr & 63);
+    } else {
+      scratch.push_back(r_plus_1 - 1);
+    }
+  }
+  // Returns the sorted unique ranks for the current line (and clears
+  // the bitset for the next one).
+  inline const std::vector<int32_t>& finish() {
+    if (use_bitset) {
+      scratch.clear();
+      for (size_t wi = 0; wi < n_words; ++wi) {
+        uint64_t w = bits[wi];
+        if (!w) continue;
+        bits[wi] = 0;
+        do {
+          scratch.push_back(static_cast<int32_t>(
+              (wi << 6) + static_cast<size_t>(__builtin_ctzll(w))));
+          w &= w - 1;
+        } while (w);
+      }
+    } else {
+      std::sort(scratch.begin(), scratch.end());
+      scratch.erase(std::unique(scratch.begin(), scratch.end()),
+                    scratch.end());
+    }
+    return scratch;
+  }
+  inline void reset_list() {
+    if (!use_bitset) scratch.clear();
+  }
+};
+
 }  // namespace
 
 extern "C" {
@@ -143,35 +349,48 @@ struct FaResult {
 
 void fa_free_result(FaResult* res);
 
+}  // extern "C"
+
+namespace {
+
+// Marshal a BasketDeduper into an FaResult (zero-copy arena handoff —
+// on success the arena pointer belongs to the result).  Returns false on
+// OOM, leaving the arena owned by the deduper for the caller to free.
+bool marshal_baskets(BasketDeduper& dd, FaResult* res) {
+  const int64_t t = static_cast<int64_t>(dd.b_off.size());
+  const int64_t total_items = static_cast<int64_t>(dd.arena.n);
+  res->n_baskets = t;
+  res->basket_offsets =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (t + 1)));
+  res->basket_items = total_items
+      ? dd.arena.p
+      : static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
+  res->weights =
+      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (t ? t : 1)));
+  if (!res->basket_offsets || !res->basket_items || !res->weights) {
+    if (res->basket_items == dd.arena.p) res->basket_items = nullptr;
+    return false;
+  }
+  for (int64_t i = 0; i < t; ++i) {
+    res->basket_offsets[i] = dd.b_off[i];
+    res->weights[i] = dd.b_weight[i];
+  }
+  res->basket_offsets[t] = total_items;
+  if (total_items) dd.arena.p = nullptr;  // ownership transferred
+  else dd.arena.free_buf();
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
 // data/len: raw file bytes.  Not nul-terminated.  Returns a heap-allocated
 // result (free with fa_free_result) or nullptr on allocation failure.
 FaResult* fa_preprocess_buffer(const char* data, int64_t len,
                                double min_support) {
   PhaseTimer timer;
   std::string_view buf(data, static_cast<size_t>(len));
-
-  // ---- split into trimmed lines (last line may lack '\n') --------------
-  std::vector<std::string_view> lines;
-  {
-    size_t pos = 0;
-    while (pos <= buf.size()) {
-      size_t nl = buf.find('\n', pos);
-      size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
-      if (nl == std::string_view::npos && pos == buf.size()) break;
-      std::string_view line = buf.substr(pos, end - pos);
-      // trim (Java String.trim: chars <= 0x20)
-      size_t b = 0, e = line.size();
-      while (b < e && static_cast<unsigned char>(line[b]) <= 0x20) ++b;
-      while (e > b && static_cast<unsigned char>(line[e - 1]) <= 0x20) --e;
-      lines.push_back(line.substr(b, e - b));
-      if (nl == std::string_view::npos) break;
-      pos = nl + 1;
-    }
-  }
-  timer.mark("split_lines");
-  const int64_t n_raw = static_cast<int64_t>(lines.size());
-  const int64_t min_count =
-      static_cast<int64_t>(std::ceil(min_support * static_cast<double>(n_raw)));
 
   // ---- pass 1: occurrence counts + parsed-token capture ----------------
   // Dense array for canonical small-integer tokens (the overwhelmingly
@@ -182,9 +401,8 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   // line-major with ``tok_offsets`` line boundaries): a dense id >= 0, or
   // ``-(side_index+1)`` pointing into ``side_toks`` for non-dense tokens
   // (deduped via the counts map).  Pass 2 then never touches the raw
-  // bytes again — on a 1 GB file the second tokenize+parse scan was half
-  // the preprocessing cost; the parsed form replays at memory bandwidth
-  // (~4 bytes/token vs ~3.3 raw bytes + parse per token).
+  // bytes again — on a 1 GB file a second tokenize+parse scan was half
+  // the preprocessing cost; the parsed form replays at memory bandwidth.
   int64_t* dense_counts =
       static_cast<int64_t*>(std::calloc(kDenseCap, sizeof(int64_t)));
   // token -> (occurrence count, index into side_toks)
@@ -194,7 +412,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   std::vector<int32_t> tok_ids;
   std::vector<int64_t> tok_offsets;
   tok_ids.reserve(static_cast<size_t>(len / 4 + 16));
-  tok_offsets.reserve(lines.size() + 1);
+  tok_offsets.reserve(static_cast<size_t>(len / 64 + 16));
   auto side_token = [&](std::string_view tok) {
     auto [it, inserted] = counts.try_emplace(
         tok, 0, static_cast<int32_t>(side_toks.size()));
@@ -203,47 +421,28 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     tok_ids.push_back(-(it->second.second + 1));
   };
   int64_t max_dense_id = -1;
-  // Tokenize and parse in ONE walk over each line's bytes: the canonical-
-  // decimal value accumulates while scanning the token, so the separate
-  // fast_id() re-scan of every token is gone (pass 1 previously touched
-  // each byte twice).  Semantics identical to splitting on is_ws runs and
-  // then testing fast_id: dense iff all digits, no leading zero (except a
-  // single "0"), at most 7 of them.
-  for (auto line : lines) {
+  int64_t n_raw = 0;
+  for_each_trimmed_line(buf, [&](std::string_view line) {
+    ++n_raw;
     tok_offsets.push_back(static_cast<int64_t>(tok_ids.size()));
     if (line.empty()) {
       side_token(std::string_view(""));  // Java split("") -> [""]
-      continue;
+      return;
     }
-    const char* p = line.data();
-    const char* end = p + line.size();
-    while (p < end) {
-      while (p < end && is_ws(static_cast<unsigned char>(*p))) ++p;
-      if (p >= end) break;
-      const char* start = p;
-      int64_t v = 0;
-      bool digits_only = dense_counts != nullptr;
-      while (p < end && !is_ws(static_cast<unsigned char>(*p))) {
-        unsigned char c = static_cast<unsigned char>(*p) - '0';
-        if (c > 9) {
-          digits_only = false;
-        } else if (p - start < 7) {  // beyond 7 digits: non-dense anyway
-          v = v * 10 + c;
-        }
-        ++p;
-      }
-      size_t n = static_cast<size_t>(p - start);
-      if (digits_only && n <= 7 && !(start[0] == '0' && n > 1)) {
-        ++dense_counts[v];
-        if (v > max_dense_id) max_dense_id = v;
-        tok_ids.push_back(static_cast<int32_t>(v));
+    for_each_token(line, [&](std::string_view tok, int64_t dense_id) {
+      if (dense_id >= 0 && dense_counts) {
+        ++dense_counts[dense_id];
+        if (dense_id > max_dense_id) max_dense_id = dense_id;
+        tok_ids.push_back(static_cast<int32_t>(dense_id));
       } else {
-        side_token(std::string_view(start, n));
+        side_token(tok);
       }
-    }
-  }
+    });
+  });
   tok_offsets.push_back(static_cast<int64_t>(tok_ids.size()));
   timer.mark("pass1_tokenize_count");
+  const int64_t min_count =
+      static_cast<int64_t>(std::ceil(min_support * static_cast<double>(n_raw)));
 
   // ---- rank assignment -------------------------------------------------
   struct Item {
@@ -309,146 +508,37 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
 
   // ---- pass 2: basket dedup with multiplicity --------------------------
   // Replays the parsed tokens captured in pass 1 (tok_ids) — no second
-  // scan of the raw bytes.  Distinct baskets live concatenated in a flat
-  // arena with an open-addressing index over (hash, arena slice): no
-  // per-basket heap node, no rehash-time key copies, and the final
-  // marshal is one memcpy of the arena.  Insertion order = first-seen
-  // order (FastApriori.scala:74 zipWithIndex over the deduped RDD).
-  // Malloc-backed growable arena: ownership transfers to the result
-  // struct at marshal time with NO copy (the arena is ~0.6 GB at Webdocs
-  // scale and the memcpy alone was ~2.5 s on this single-core host).
-  struct I32Buf {
-    int32_t* p = nullptr;
-    size_t n = 0, cap = 0;
-    bool reserve(size_t want) {
-      if (want <= cap) return true;
-      size_t nc = cap ? cap * 2 : (1u << 20);
-      while (nc < want) nc *= 2;
-      auto* np_ = static_cast<int32_t*>(std::realloc(p, nc * sizeof(int32_t)));
-      if (!np_) return false;
-      p = np_;
-      cap = nc;
-      return true;
-    }
-    bool append(const int32_t* src, size_t k) {
-      if (!reserve(n + k)) return false;
-      std::memcpy(p + n, src, k * sizeof(int32_t));
-      n += k;
-      return true;
-    }
-  } arena;                              // concatenated sorted rank lists
+  // scan of the raw bytes.
+  BasketDeduper dd;
   // Upper bound: one rank per captured token.  Reserving up front keeps
   // realloc from copying the growing arena (~1.2 GB of cumulative copy
   // at Webdocs scale); pages are committed lazily, so over-reservation
   // costs virtual space only.
-  if (!arena.reserve(tok_ids.size() + 1)) {
+  if (!dd.arena.reserve(tok_ids.size() + 1)) {
     std::free(dense_rank);
     return nullptr;
   }
-  std::vector<int64_t> b_off;           // [t] arena offset per basket
-  std::vector<int32_t> b_len;           // [t]
-  std::vector<int32_t> b_weight;        // [t] multiplicity
-  std::vector<uint64_t> b_hash;         // [t] cached for table growth
-  size_t table_size = 1 << 12;          // power of two
-  std::vector<int64_t> table(table_size, -1);
-  auto hash_basket = [](const int32_t* p, size_t n) {
-    uint64_t h = 0x243F6A8885A308D3ull ^ n;  // word-wise mix, not per-byte
-    for (size_t i = 0; i < n; ++i) {
-      h ^= static_cast<uint32_t>(p[i]);
-      h *= 0x9E3779B97F4A7C15ull;
-      h ^= h >> 29;
-    }
-    return h;
-  };
-  auto grow_table = [&]() {
-    table_size *= 2;
-    std::fill(table.begin(), table.end(), -1);
-    table.resize(table_size, -1);
-    const size_t mask = table_size - 1;
-    for (size_t id = 0; id < b_off.size(); ++id) {
-      size_t slot = static_cast<size_t>(b_hash[id]) & mask;
-      while (table[slot] != -1) slot = (slot + 1) & mask;
-      table[slot] = static_cast<int64_t>(id);
-    }
-  };
-  std::vector<int32_t> scratch;
-  // Small-F fast path: collect each line's ranks into an F-bit set —
-  // dedup is free and a ctz walk emits them sorted in O(F/64 + n) instead
-  // of sort+unique's O(n log n).  F is minSupport-bounded (hundreds on
-  // the benchmark corpora), so the per-line clear is a few words.
-  const size_t n_words = (static_cast<size_t>(f) + 63) / 64;
-  const bool use_bitset = f > 0 && f <= 4096;
-  std::vector<uint64_t> rank_bits(use_bitset ? n_words : 0, 0);
+  RankCollector rc(f);
   for (int64_t li = 0; li < n_raw; ++li) {
-    scratch.clear();
-    if (use_bitset) {
-      for (int64_t ti = tok_offsets[li]; ti < tok_offsets[li + 1]; ++ti) {
-        int32_t id = tok_ids[ti];
-        int32_t r = id >= 0 ? dense_rank[id] : side_rank[-id - 1];
-        if (r) {
-          uint32_t rr = static_cast<uint32_t>(r - 1);
-          rank_bits[rr >> 6] |= 1ull << (rr & 63);
-        }
-      }
-      for (size_t wi = 0; wi < n_words; ++wi) {
-        uint64_t w = rank_bits[wi];
-        if (!w) continue;
-        rank_bits[wi] = 0;
-        do {
-          scratch.push_back(static_cast<int32_t>(
-              (wi << 6) + static_cast<size_t>(__builtin_ctzll(w))));
-          w &= w - 1;
-        } while (w);
-      }
-    } else {
-      for (int64_t ti = tok_offsets[li]; ti < tok_offsets[li + 1]; ++ti) {
-        int32_t id = tok_ids[ti];
-        int32_t r = id >= 0 ? dense_rank[id] : side_rank[-id - 1];
-        if (r) scratch.push_back(r - 1);
-      }
-      std::sort(scratch.begin(), scratch.end());
-      scratch.erase(std::unique(scratch.begin(), scratch.end()),
-                    scratch.end());
+    rc.reset_list();
+    for (int64_t ti = tok_offsets[li]; ti < tok_offsets[li + 1]; ++ti) {
+      int32_t id = tok_ids[ti];
+      rc.add(id >= 0 ? dense_rank[id] : side_rank[-id - 1]);
     }
-    const size_t n = scratch.size();
-    if (n <= 1) continue;
-    const uint64_t h = hash_basket(scratch.data(), n);
-    const size_t mask = table_size - 1;
-    size_t slot = static_cast<size_t>(h) & mask;
-    while (true) {
-      int64_t id = table[slot];
-      if (id == -1) {  // new distinct basket
-        table[slot] = static_cast<int64_t>(b_off.size());
-        b_off.push_back(static_cast<int64_t>(arena.n));
-        b_len.push_back(static_cast<int32_t>(n));
-        b_weight.push_back(1);
-        b_hash.push_back(h);
-        if (!arena.append(scratch.data(), n)) {
-          std::free(arena.p);
-          std::free(dense_rank);
-          return nullptr;
-        }
-        // Load factor <= 0.7 keeps linear probes short.
-        if (b_off.size() * 10 >= table_size * 7) grow_table();
-        break;
-      }
-      if (b_hash[id] == h && b_len[id] == static_cast<int32_t>(n) &&
-          std::memcmp(arena.p + b_off[id], scratch.data(),
-                      n * sizeof(int32_t)) == 0) {
-        ++b_weight[id];
-        break;
-      }
-      slot = (slot + 1) & mask;
+    const auto& ranks = rc.finish();
+    if (ranks.size() <= 1) continue;
+    if (!dd.insert(ranks.data(), ranks.size())) {
+      dd.arena.free_buf();
+      std::free(dense_rank);
+      return nullptr;
     }
   }
-  const int64_t t = static_cast<int64_t>(b_off.size());
-  const int64_t total_items = static_cast<int64_t>(arena.n);
   timer.mark("pass2_dedup");
 
   // ---- marshal ---------------------------------------------------------
   auto* res = static_cast<FaResult*>(std::calloc(1, sizeof(FaResult)));
   if (!res) {
-    std::free(arena.p);
+    dd.arena.free_buf();
     std::free(dense_rank);
     return nullptr;
   }
@@ -460,10 +550,15 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   for (const auto& item : freq) items_len += item.tok.size() + 1;
   res->items_buf = static_cast<char*>(std::malloc(items_len ? items_len : 1));
   res->items_buf_len = items_len ? items_len - 1 : 0;  // drop trailing '\n'
-  if (!res->items_buf) {
-    std::free(arena.p);
+  res->item_counts =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (f ? f : 1)));
+  bool ok = res->items_buf && res->item_counts && marshal_baskets(dd, res);
+  if (!ok) {
+    // fa_free_result tolerates the partially-filled struct
+    // (free(nullptr) is a no-op); the arena is still the deduper's.
+    dd.arena.free_buf();
     std::free(dense_rank);
-    std::free(res);
+    fa_free_result(res);
     return nullptr;
   }
   {
@@ -474,34 +569,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
       *p++ = '\n';
     }
   }
-  res->item_counts =
-      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (f ? f : 1)));
-
-  res->n_baskets = t;
-  res->basket_offsets =
-      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (t + 1)));
-  // Zero-copy handoff: the arena buffer becomes the result's
-  // basket_items (fa_free_result frees it; it is malloc-family memory).
-  res->basket_items = total_items
-      ? arena.p
-      : static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
-  if (!total_items) std::free(arena.p);
-  res->weights =
-      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (t ? t : 1)));
-  if (!res->item_counts || !res->basket_offsets || !res->basket_items ||
-      !res->weights) {
-    // fa_free_result tolerates the partially-filled struct (free(nullptr)
-    // is a no-op); basket_items is the arena or its own malloc either way.
-    std::free(dense_rank);
-    fa_free_result(res);
-    return nullptr;
-  }
   for (int32_t r = 0; r < f; ++r) res->item_counts[r] = freq[r].count;
-  for (int64_t i = 0; i < t; ++i) {
-    res->basket_offsets[i] = b_off[i];
-    res->weights[i] = b_weight[i];
-  }
-  res->basket_offsets[t] = total_items;
   std::free(dense_rank);
   timer.mark("marshal");
   return res;
@@ -526,20 +594,6 @@ void fa_fill_packed_bitmap(const int64_t* offsets, const int32_t* items,
 }
 
 // ---- sharded-ingest split phases -------------------------------------
-// Multi-host ingest (preprocess.py preprocess_file_sharded): each process
-// counts its own byte-range (fa_count_buffer), the per-token counts merge
-// globally on the host, and each process then compresses its range
-// against the GLOBAL rank table (fa_compress_with_ranks).  Identical
-// baskets in different shards stay separate rows with their own
-// multiplicities — weighted counts are unaffected, so cross-shard dedup
-// is unnecessary for correctness (it is only a compression).
-//
-// KNOWN DEBT: these two functions repeat the line-split/tokenizer and
-// basket-dedup machinery of fa_preprocess_buffer rather than sharing
-// factored helpers.  Any change to tokenization or dedup semantics must
-// be applied to all copies; the contract tests pin them together
-// (tests/test_native.py equality vs the Python path, and
-// tests/test_distributed.py's sharded-vs-oracle bit-exactness).
 
 struct FaCounts {
   int64_t n_lines;
@@ -564,49 +618,21 @@ FaCounts* fa_count_buffer(const char* data, int64_t len) {
   side.reserve(1 << 14);
   int64_t max_dense_id = -1;
   int64_t n_lines = 0;
-  size_t pos = 0;
-  while (pos <= buf.size()) {
-    size_t nl = buf.find('\n', pos);
-    size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
-    if (nl == std::string_view::npos && pos == buf.size()) break;
-    std::string_view line = buf.substr(pos, end - pos);
-    size_t b = 0, e = line.size();
-    while (b < e && static_cast<unsigned char>(line[b]) <= 0x20) ++b;
-    while (e > b && static_cast<unsigned char>(line[e - 1]) <= 0x20) --e;
-    line = line.substr(b, e - b);
+  for_each_trimmed_line(buf, [&](std::string_view line) {
     ++n_lines;
     if (line.empty()) {
       ++side[std::string_view("")];  // Java split("") -> [""]
-    } else {
-      const char* p = line.data();
-      const char* endp = p + line.size();
-      while (p < endp) {
-        while (p < endp && is_ws(static_cast<unsigned char>(*p))) ++p;
-        if (p >= endp) break;
-        const char* start = p;
-        int64_t v = 0;
-        bool digits_only = dense_counts != nullptr;
-        while (p < endp && !is_ws(static_cast<unsigned char>(*p))) {
-          unsigned char c = static_cast<unsigned char>(*p) - '0';
-          if (c > 9) {
-            digits_only = false;
-          } else if (p - start < 7) {
-            v = v * 10 + c;
-          }
-          ++p;
-        }
-        size_t n = static_cast<size_t>(p - start);
-        if (digits_only && n <= 7 && !(start[0] == '0' && n > 1)) {
-          ++dense_counts[v];
-          if (v > max_dense_id) max_dense_id = v;
-        } else {
-          ++side[std::string_view(start, n)];
-        }
-      }
+      return;
     }
-    if (nl == std::string_view::npos) break;
-    pos = nl + 1;
-  }
+    for_each_token(line, [&](std::string_view tok, int64_t dense_id) {
+      if (dense_id >= 0 && dense_counts) {
+        ++dense_counts[dense_id];
+        if (dense_id > max_dense_id) max_dense_id = dense_id;
+      } else {
+        ++side[tok];
+      }
+    });
+  });
 
   auto* res = static_cast<FaCounts*>(std::calloc(1, sizeof(FaCounts)));
   if (!res) {
@@ -653,8 +679,8 @@ FaResult* fa_compress_with_ranks(const char* data, int64_t len,
                                  const char* ranks_buf, int64_t ranks_len,
                                  int32_t f) {
   std::string_view buf(data, static_cast<size_t>(len));
-  // Rank lookup tables keyed like the tokenizer emits: canonical small
-  // decimals through a dense array, everything else via the hash map.
+  // Rank lookup tables keyed like the tokenizer classifies: canonical
+  // small decimals through a dense array, everything else via the map.
   int64_t max_dense_id = -1;
   std::vector<std::pair<std::string_view, int32_t>> side_entries;
   std::vector<std::pair<int64_t, int32_t>> dense_entries;
@@ -690,198 +716,66 @@ FaResult* fa_compress_with_ranks(const char* data, int64_t len,
   side_rank.reserve(side_entries.size() * 2 + 8);
   for (const auto& [tok, r] : side_entries) side_rank[tok] = r;
 
-  // Pass 2 over this buffer only (re-tokenizes; there is no pass-1
-  // capture here — the extra scan is per-shard and parallel across
-  // processes).  Same bitset fast path and arena dedup as
-  // fa_preprocess_buffer.
-  struct I32Buf {
-    int32_t* p = nullptr;
-    size_t n = 0, cap = 0;
-    bool reserve(size_t want) {
-      if (want <= cap) return true;
-      size_t nc = cap ? cap * 2 : (1u << 20);
-      while (nc < want) nc *= 2;
-      auto* np_ = static_cast<int32_t*>(std::realloc(p, nc * sizeof(int32_t)));
-      if (!np_) return false;
-      p = np_;
-      cap = nc;
-      return true;
-    }
-    bool append(const int32_t* src, size_t k) {
-      if (!reserve(n + k)) return false;
-      std::memcpy(p + n, src, k * sizeof(int32_t));
-      n += k;
-      return true;
-    }
-  } arena;
-  std::vector<int64_t> b_off;
-  std::vector<int32_t> b_len, b_weight;
-  std::vector<uint64_t> b_hash;
-  size_t table_size = 1 << 12;
-  std::vector<int64_t> table(table_size, -1);
-  auto hash_basket = [](const int32_t* p, size_t n) {
-    uint64_t h = 0x243F6A8885A308D3ull ^ n;
-    for (size_t i = 0; i < n; ++i) {
-      h ^= static_cast<uint32_t>(p[i]);
-      h *= 0x9E3779B97F4A7C15ull;
-      h ^= h >> 29;
-    }
-    return h;
-  };
-  auto grow_table = [&]() {
-    table_size *= 2;
-    std::fill(table.begin(), table.end(), -1);
-    table.resize(table_size, -1);
-    const size_t mask = table_size - 1;
-    for (size_t id = 0; id < b_off.size(); ++id) {
-      size_t slot = static_cast<size_t>(b_hash[id]) & mask;
-      while (table[slot] != -1) slot = (slot + 1) & mask;
-      table[slot] = static_cast<int64_t>(id);
-    }
-  };
-  std::vector<int32_t> scratch;
-  const size_t n_words = (static_cast<size_t>(f) + 63) / 64;
-  const bool use_bitset = f > 0 && f <= 4096;
-  std::vector<uint64_t> rank_bits(use_bitset ? n_words : 0, 0);
+  // One pass over this buffer (re-tokenizes; there is no pass-1 capture
+  // here — the extra scan is per-shard and parallel across processes).
+  BasketDeduper dd;
+  RankCollector rc(f);
   int64_t n_lines = 0;
-  size_t pos = 0;
-  while (pos <= buf.size()) {
-    size_t nl = buf.find('\n', pos);
-    size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
-    if (nl == std::string_view::npos && pos == buf.size()) break;
-    std::string_view line = buf.substr(pos, end - pos);
-    size_t b = 0, e = line.size();
-    while (b < e && static_cast<unsigned char>(line[b]) <= 0x20) ++b;
-    while (e > b && static_cast<unsigned char>(line[e - 1]) <= 0x20) --e;
-    line = line.substr(b, e - b);
+  bool oom = false;
+  // On dedup OOM the remaining lines are still split/trimmed (the
+  // callback just skips their work) — a known, accepted cost: OOM here
+  // is terminal for the shard anyway, and a bool-returning line walker
+  // isn't worth complicating the shared helper for.
+  for_each_trimmed_line(buf, [&](std::string_view line) {
+    if (oom) return;
     ++n_lines;
-    scratch.clear();
-    auto add_rank = [&](int32_t r) {
-      if (!r) return;
-      if (use_bitset) {
-        uint32_t rr = static_cast<uint32_t>(r - 1);
-        rank_bits[rr >> 6] |= 1ull << (rr & 63);
-      } else {
-        scratch.push_back(r - 1);
-      }
-    };
+    rc.reset_list();
     if (line.empty()) {
       auto it = side_rank.find(std::string_view(""));
-      if (it != side_rank.end()) add_rank(it->second);
+      if (it != side_rank.end()) rc.add(it->second);
     } else {
-      const char* p = line.data();
-      const char* endp = p + line.size();
-      while (p < endp) {
-        while (p < endp && is_ws(static_cast<unsigned char>(*p))) ++p;
-        if (p >= endp) break;
-        const char* start = p;
-        int64_t v = 0;
-        bool digits_only = true;
-        while (p < endp && !is_ws(static_cast<unsigned char>(*p))) {
-          unsigned char c = static_cast<unsigned char>(*p) - '0';
-          if (c > 9) {
-            digits_only = false;
-          } else if (p - start < 7) {
-            v = v * 10 + c;
+      for_each_token(line, [&](std::string_view tok, int64_t dense_id) {
+        if (dense_id >= 0) {
+          if (dense_rank && dense_id <= max_dense_id) {
+            rc.add(dense_rank[dense_id]);
           }
-          ++p;
-        }
-        size_t n = static_cast<size_t>(p - start);
-        int32_t r = 0;
-        if (digits_only && n <= 7 && !(start[0] == '0' && n > 1)) {
-          if (dense_rank && v <= max_dense_id) r = dense_rank[v];
         } else {
-          auto it = side_rank.find(std::string_view(start, n));
-          if (it != side_rank.end()) r = it->second;
+          auto it = side_rank.find(tok);
+          if (it != side_rank.end()) rc.add(it->second);
         }
-        add_rank(r);
-      }
+      });
     }
-    if (use_bitset) {
-      for (size_t wi = 0; wi < n_words; ++wi) {
-        uint64_t w = rank_bits[wi];
-        if (!w) continue;
-        rank_bits[wi] = 0;
-        do {
-          scratch.push_back(static_cast<int32_t>(
-              (wi << 6) + static_cast<size_t>(__builtin_ctzll(w))));
-          w &= w - 1;
-        } while (w);
-      }
-    } else {
-      std::sort(scratch.begin(), scratch.end());
-      scratch.erase(std::unique(scratch.begin(), scratch.end()),
-                    scratch.end());
-    }
-    const size_t n = scratch.size();
-    if (n > 1) {
-      const uint64_t h = hash_basket(scratch.data(), n);
-      const size_t mask = table_size - 1;
-      size_t slot = static_cast<size_t>(h) & mask;
-      while (true) {
-        int64_t id = table[slot];
-        if (id == -1) {
-          table[slot] = static_cast<int64_t>(b_off.size());
-          b_off.push_back(static_cast<int64_t>(arena.n));
-          b_len.push_back(static_cast<int32_t>(n));
-          b_weight.push_back(1);
-          b_hash.push_back(h);
-          if (!arena.append(scratch.data(), n)) {
-            std::free(arena.p);
-            std::free(dense_rank);
-            return nullptr;
-          }
-          if (b_off.size() * 10 >= table_size * 7) grow_table();
-          break;
-        }
-        if (b_hash[id] == h && b_len[id] == static_cast<int32_t>(n) &&
-            std::memcmp(arena.p + b_off[id], scratch.data(),
-                        n * sizeof(int32_t)) == 0) {
-          ++b_weight[id];
-          break;
-        }
-        slot = (slot + 1) & mask;
-      }
-    }
-    if (nl == std::string_view::npos) break;
-    pos = nl + 1;
+    const auto& ranks = rc.finish();
+    if (ranks.size() <= 1) return;
+    if (!dd.insert(ranks.data(), ranks.size())) oom = true;
+  });
+  if (oom) {
+    dd.arena.free_buf();
+    std::free(dense_rank);
+    return nullptr;
   }
-  const int64_t t = static_cast<int64_t>(b_off.size());
-  const int64_t total_items = static_cast<int64_t>(arena.n);
 
   auto* res = static_cast<FaResult*>(std::calloc(1, sizeof(FaResult)));
   if (!res) {
-    std::free(arena.p);
+    dd.arena.free_buf();
     std::free(dense_rank);
     return nullptr;
   }
   res->n_raw = n_lines;
   res->min_count = 0;
   res->n_items = f;
-  res->n_baskets = t;
   res->items_buf = static_cast<char*>(std::malloc(1));
   res->items_buf_len = 0;
   res->item_counts =
       static_cast<int64_t*>(std::calloc(f ? f : 1, sizeof(int64_t)));
-  res->basket_offsets =
-      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (t + 1)));
-  res->basket_items = total_items
-      ? arena.p
-      : static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
-  if (!total_items) std::free(arena.p);
-  res->weights =
-      static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (t ? t : 1)));
-  if (!res->items_buf || !res->item_counts || !res->basket_offsets ||
-      !res->basket_items || !res->weights) {
+  bool ok =
+      res->items_buf && res->item_counts && marshal_baskets(dd, res);
+  if (!ok) {
+    dd.arena.free_buf();
     std::free(dense_rank);
     fa_free_result(res);
     return nullptr;
   }
-  for (int64_t i = 0; i < t; ++i) {
-    res->basket_offsets[i] = b_off[i];
-    res->weights[i] = b_weight[i];
-  }
-  res->basket_offsets[t] = total_items;
   std::free(dense_rank);
   return res;
 }
