@@ -118,7 +118,7 @@ class DeviceContext:
         if "unpack" not in self._fns:
             from fastapriori_tpu.ops.fused import _unpack
 
-            self._fns["unpack"] = jax.jit(
+            inner = jax.jit(
                 jax.shard_map(
                     _unpack,
                     mesh=self.mesh,
@@ -127,6 +127,22 @@ class DeviceContext:
                 ),
                 donate_argnums=0,  # free the packed buffer after unpack
             )
+
+            def unpack(arr):
+                # The donation exists to FREE the packed buffer promptly;
+                # it can never be reused for the 8x-larger unpacked
+                # output, and jax warns about exactly that on every run —
+                # suppress the known-benign warning, keep the early free.
+                import warnings
+
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable",
+                    )
+                    return inner(arr)
+
+            self._fns["unpack"] = unpack
         return self._fns["unpack"]
 
     def upload_packed(self, packed: np.ndarray) -> jax.Array:
