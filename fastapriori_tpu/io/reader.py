@@ -14,16 +14,23 @@ from __future__ import annotations
 import re
 from typing import List, Tuple
 
-_WS = re.compile(r"\s+")
+# Java semantics, NOT Python's: String.trim() removes chars <= 0x20 (so
+# control bytes like \x01 are trimmed, but \xa0 — which Python's
+# str.strip() would eat — is kept), and regex \s is ASCII-only
+# ([ \t\n\x0B\f\r]; Python's \s on str would also split on unicode
+# spaces).  The native scanner (native/preprocess.cc is_ws/trim) and the
+# reference (Utils.scala:21) both use the Java rules.
+_WS = re.compile(r"[ \t\n\x0B\f\r]+")
+_TRIM = "".join(chr(i) for i in range(0x21))
 
 
 def tokenize_line(line: str) -> List[str]:
     """Java-compatible ``line.trim().split("\\s+")`` (Utils.scala:21).
 
-    ``re.split(r"\\s+", "")`` returns ``[""]``, matching Java's behavior of
-    returning a single empty token for an empty (trimmed) string, which
-    Python's plain ``str.split()`` would not."""
-    return _WS.split(line.strip())
+    Splitting the empty (trimmed) string returns ``[""]``, matching
+    Java's single empty token, which Python's plain ``str.split()``
+    would not."""
+    return _WS.split(line.strip(_TRIM))
 
 
 def _open(path: str):
@@ -40,10 +47,25 @@ def _open(path: str):
     return open(path, "r")
 
 
+def split_lines_java(content: str) -> List[str]:
+    """Split on ``\\n`` ONLY, dropping the empty tail a trailing newline
+    leaves — the record-splitting rule of the native scanner
+    (native/preprocess.cc for_each_trimmed_line) and of Spark textFile.
+    Python's ``str.splitlines()`` would additionally split on \\x0b,
+    \\x0c, \\x1c-\\x1e, \\x85 and unicode line separators, silently
+    changing line counts (and therefore minCount) on such bytes."""
+    if not content:
+        return []
+    lines = content.split("\n")
+    if lines[-1] == "":
+        lines.pop()
+    return lines
+
+
 def read_dat(path: str) -> List[List[str]]:
     """Read one ``*.dat`` file into a list of token lists, one per line."""
     with _open(path) as f:
-        return [tokenize_line(line) for line in f.read().splitlines()]
+        return [tokenize_line(line) for line in split_lines_java(f.read())]
 
 
 def read_input_dir(input_prefix: str) -> Tuple[List[List[str]], List[List[str]]]:

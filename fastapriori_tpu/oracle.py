@@ -37,6 +37,8 @@ from typing import Dict, List, Sequence, Tuple
 
 ItemSet = frozenset  # of int ranks
 
+_TRIM = "".join(chr(i) for i in range(0x21))  # Java String.trim charset
+
 
 def tokenize(line: str) -> List[str]:
     """Java-compatible ``line.trim().split("\\s+")``.
@@ -45,12 +47,23 @@ def tokenize(line: str) -> List[str]:
     empty token — which Python's ``str.split()`` would drop.  ``re.split``
     reproduces the Java behavior exactly (Utils.scala:21).
     """
-    return re.split(r"\s+", line.strip())
+    # Java rules, not Python's: trim() removes chars <= 0x20 and regex
+    # \s is ASCII-only (see io/reader.py tokenize_line).
+    return re.split(r"[ \t\n\x0B\f\r]+", line.strip(_TRIM))
 
 
 def read_lines(path: str) -> List[List[str]]:
+    # Split on '\n' only (drop the trailing-newline tail) — the same
+    # record rule as the native scanner and Spark textFile; Python's
+    # splitlines() would also split on \x0b/\x0c/\x1c-\x1e/\x85 etc.
     with open(path, "r") as f:
-        return [tokenize(line) for line in f.read().splitlines()]
+        content = f.read()
+    if not content:
+        return []
+    lines = content.split("\n")
+    if lines[-1] == "":
+        lines.pop()
+    return [tokenize(line) for line in lines]
 
 
 def item_sort_key(item_count: Tuple[str, int]):

@@ -203,7 +203,9 @@ def preprocess(
     ``native``: force (True) or forbid (False) the C++ fast path; None
     auto-selects it when the extension is built and the input is large.
     """
-    if _use_native(native, len(transactions)):
+    if _use_native(native, len(transactions)) and _tokens_serialize_exactly(
+        transactions
+    ):
         from fastapriori_tpu.native.loader import (
             join_transactions,
             preprocess_buffer,
@@ -213,6 +215,24 @@ def preprocess(
             preprocess_buffer(join_transactions(transactions), min_support)
         )
     return _python_preprocess(transactions, min_support)
+
+
+def _tokens_serialize_exactly(transactions) -> bool:
+    """True iff re-serializing the token lists for the native byte
+    scanner round-trips exactly: a token whose FIRST or LAST char is
+    <= 0x20 (e.g. a bare "\\x01" token from a "7 \\x01 8" line) would be
+    eaten by the scanner's Java-trim at a line edge or glued to a
+    neighbor, changing item identity.  Tokens cannot contain ASCII \\s
+    (the tokenizer split on it), so interior control chars are safe.
+    Such tokens route to the Python path instead; file inputs
+    (preprocess_file) scan the raw bytes and never re-serialize.  An
+    empty token is safe only as a line's SOLE token (the empty-line
+    form, which serializes to an empty line)."""
+    return all(
+        (len(line) == 1 and line[0] == "")
+        or all(t and t[0] > "\x20" and t[-1] > "\x20" for t in line)
+        for line in transactions
+    )
 
 
 def preprocess_file(

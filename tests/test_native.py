@@ -258,3 +258,84 @@ def test_sharded_preprocess_equivalent_support(tmp_path, n_shards):
         return g
 
     assert (weighted_gram(shards) == weighted_gram([plain])).all()
+
+
+def test_tokenization_java_semantics_raw_bytes(tmp_path):
+    """The SAME raw file bytes through the native scanner and the Python
+    path (which must not re-serialize): control chars at line edges are
+    Java-trimmed, interior ones are tokens, and only '\\n' terminates a
+    record (str.splitlines' extra terminators \\x0b/\\x1c/\\x85 must NOT
+    split lines — they change n_raw and therefore minCount)."""
+    raw = (
+        "\x01 7 8\n"     # control char trimmed at the start
+        "7 8 \x02\n"     # ...and at the end
+        "7 \x01 8\n"     # mid-line control char is its own token
+        "7\x0b8 9\n"     # \x0b IS ASCII \s in Java: splits tokens, not lines
+        "7\x1c8 9\n"     # \x1c is NOT whitespace and NOT a terminator
+        "\x03\x04\n"     # trims to empty -> [""]
+        "7 8\n"
+        "7 8"            # no trailing newline
+    )
+    p = tmp_path / "D.dat"
+    p.write_bytes(raw.encode("utf-8"))
+    a = preprocess_file(str(p), 0.2, native=True)
+    b = preprocess_file(str(p), 0.2, native=False)
+    assert a.n_raw == b.n_raw == 8
+    _assert_equal(a, b)
+    # '7\x1c8' must survive as one (infrequent) token — check via a run
+    # with min_support 0 on the python path only.
+    from fastapriori_tpu.io.reader import read_dat
+
+    lines = read_dat(str(p))
+    assert lines[4] == ["7\x1c8", "9"]
+    assert lines[3] == ["7", "8", "9"]
+
+
+def test_preprocess_in_memory_edge_tokens_fall_back():
+    """In-memory token lists whose tokens could not survive the native
+    byte round trip (leading/trailing chars <= 0x20) must route to the
+    Python path and still produce Java-exact results."""
+    lines = [["\x01a", "b"], ["\x01a", "b"], ["a", "b"], ["b", "\x01a"]]
+    # Even an explicit native=True must not ship these tokens through
+    # the lossy byte round trip — the guard falls back to Python.
+    a = preprocess(lines, 0.3, native=True)
+    b = preprocess(lines, 0.3, native=False)
+    assert a.freq_items == b.freq_items
+    assert (a.item_counts == b.item_counts).all()
+    assert (a.weights == b.weights).all()
+    assert "\x01a" in a.freq_items  # identity preserved
+
+
+def test_tokenization_java_semantics_control_and_unicode():
+    """Java String.trim removes chars <= 0x20 (so \\x01 at the ends goes)
+    while regex \\s is ASCII-only (so \\xa0 never splits or trims) — the
+    Python tokenizer, the oracle, and the native scanner must agree on
+    these edge bytes (Utils.scala:21 semantics; Python's str.strip() and
+    unicode-aware \\s would both diverge)."""
+    from fastapriori_tpu import oracle
+    from fastapriori_tpu.io.reader import tokenize_line
+    from fastapriori_tpu.preprocess import preprocess
+
+    lines_raw = [
+        "\x01 7 8",      # control char trimmed at the start
+        "7 8 \x02",      # ...and at the end
+        "a\xa0b 7",      # \xa0 is NOT whitespace in Java: one token
+        "7 \x01 8",      # mid-line control char is its own token
+        "\x03\x04",      # trims to empty -> [""]
+        "7 8",
+    ]
+    py_tokens = [tokenize_line(l) for l in lines_raw]
+    assert py_tokens[0] == ["7", "8"]
+    assert py_tokens[1] == ["7", "8"]
+    assert py_tokens[2] == ["a\xa0b", "7"]
+    assert py_tokens[3] == ["7", "\x01", "8"]
+    assert py_tokens[4] == [""]
+    assert py_tokens[5] == ["7", "8"]
+    assert [oracle.tokenize(l) for l in lines_raw] == py_tokens
+
+    # Native vs Python end-to-end on the same raw bytes.
+    a = preprocess(py_tokens, 0.3, native=True)
+    b = preprocess(py_tokens, 0.3, native=False)
+    assert a.freq_items == b.freq_items
+    assert (a.item_counts == b.item_counts).all()
+    assert (a.weights == b.weights).all()
