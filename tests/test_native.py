@@ -339,3 +339,46 @@ def test_tokenization_java_semantics_control_and_unicode():
     assert a.freq_items == b.freq_items
     assert (a.item_counts == b.item_counts).all()
     assert (a.weights == b.weights).all()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_sharded_preprocess_adversarial_boundaries(tmp_path, seed):
+    """Byte-range sharding against adversarial content: control bytes at
+    line edges, \\x0b/\\x1c mid-token, blank and whitespace-only lines,
+    varying line lengths, no trailing newline — the shards must
+    partition the bytes exactly and conserve the total line count (a
+    byte-alignment bug would double- or zero-count the boundary line,
+    shifting n_raw and minCount).  Weighted-support equivalence over the
+    union is covered by test_sharded_preprocess_equivalent_support."""
+    import random
+
+    from fastapriori_tpu.native.loader import count_buffer
+    from fastapriori_tpu.preprocess import preprocess_file, read_shard
+
+    rng = random.Random(seed)
+    pool = ["7", "8", "9", "10", "\x01", "a\x0bb", "7\x1c8", "007", "x"]
+    lines = []
+    for _ in range(rng.randint(40, 120)):
+        r = rng.random()
+        if r < 0.08:
+            lines.append("")
+        elif r < 0.12:
+            lines.append("  \t ")
+        else:
+            lines.append(
+                " ".join(rng.choices(pool, k=rng.randint(1, 7)))
+            )
+    raw = "\n".join(lines)
+    if rng.random() < 0.5:
+        raw += "\n"
+    path = tmp_path / "D.dat"
+    path.write_bytes(raw.encode("utf-8"))
+
+    plain = preprocess_file(str(path), 0.1)
+    full = path.read_bytes()
+    for n in (2, 3, 4, 7):
+        parts = [read_shard(str(path), i, n) for i in range(n)]
+        assert b"".join(parts) == full, (seed, n)
+        # Line-count conservation through the split phases.
+        tot = sum(count_buffer(p)[0] for p in parts)
+        assert tot == plain.n_raw, (seed, n, tot, plain.n_raw)
