@@ -125,19 +125,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     traceback (the reference stack-traces on all of these)."""
     from fastapriori_tpu.errors import InputError
 
+    args = build_parser().parse_args(argv)
     try:
-        return _run(build_parser().parse_args(argv))
+        return _run(args)
     except InputError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     except FileNotFoundError as e:
         missing = e.filename if e.filename else str(e)
-        print(
-            f"error: input file {missing!r} not found — the input prefix "
-            "must point at D.dat and U.dat (prefix + 'D.dat', trailing "
-            "slash matters, as with the reference)",
-            file=sys.stderr,
-        )
+        # The D.dat/U.dat hint only fits the two ingest reads; a
+        # FileNotFoundError from elsewhere in the run (--profile-dir,
+        # output writes — which may share the input prefix) must name
+        # its actual path, not blame the input prefix.
+        ingest = (args.input + "D.dat", args.input + "U.dat")
+        if isinstance(missing, str) and missing in ingest:
+            print(
+                f"error: input file {missing!r} not found — the input "
+                "prefix must point at D.dat and U.dat (prefix + 'D.dat', "
+                "trailing slash matters, as with the reference)",
+                file=sys.stderr,
+            )
+        else:
+            print(f"error: file {missing!r} not found", file=sys.stderr)
         return 2
 
 

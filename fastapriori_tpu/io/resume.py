@@ -15,7 +15,7 @@ from __future__ import annotations
 from typing import Dict, FrozenSet, List, Sequence, Tuple
 
 from fastapriori_tpu.errors import InputError
-from fastapriori_tpu.io.reader import _open
+from fastapriori_tpu.io.reader import _open, split_lines_java
 from fastapriori_tpu.io.writer import (
     _ensure_parent,
     open_write,
@@ -58,8 +58,12 @@ def save_phase1_aux(
 def _read_artifact(prefix: str, name: str) -> List[str]:
     path = prefix + name
     try:
+        # \n-only splitting (split_lines_java): an item token containing
+        # \x85, \x1c-\x1e or U+2028 is legal (not Java \s), and
+        # str.splitlines() would split artifacts the writer itself
+        # produced into bogus lines.
         with _open(path) as f:
-            return f.read().splitlines()
+            return split_lines_java(f.read())
     except FileNotFoundError:
         raise InputError(
             f"resume artifact {path!r} not found — --resume-from needs the "

@@ -182,6 +182,8 @@ class AssociationRules:
         import jax
 
         n_proc = jax.process_count()
+        # local_row_slice guards the sharding invariants itself
+        # (InputError on a non-divisible or 2-D-across-processes mesh).
         row = ctx.local_row_slice(nb_pad) if n_proc > 1 else slice(None)
 
         r = len(rules)

@@ -300,5 +300,14 @@ def preprocess_file(path: str, min_support: float) -> NativeResult:
 
 def join_transactions(transactions: Sequence[Sequence[str]]) -> bytes:
     """Re-serialize token lists so the buffer path can run on in-memory
-    data (tokens contain no whitespace, so this round-trips exactly)."""
-    return "\n".join(" ".join(t) for t in transactions).encode("utf-8")
+    data (tokens contain no whitespace, so this round-trips exactly).
+
+    The trailing newline is load-bearing: without it a final [""] line
+    (the empty-line form) would serialize to a buffer ending in "\\n"
+    with nothing after it and be silently dropped by the scanner,
+    shifting n_raw and therefore minCount."""
+    if not transactions:
+        return b""
+    return ("\n".join(" ".join(t) for t in transactions) + "\n").encode(
+        "utf-8"
+    )

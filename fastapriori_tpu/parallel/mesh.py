@@ -214,9 +214,26 @@ class DeviceContext:
 
     def local_row_slice(self, n_rows_global: int) -> slice:
         """This process's contiguous row range of a txn-sharded array
-        (device order is process-major)."""
+        (device order is process-major).
+
+        Guards its own invariants — process-major, evenly divisible txn
+        sharding with no cand axis spanning processes — with a real
+        exception (an assert would vanish under ``python -O`` and the
+        caller would silently mis-slice)."""
         n_proc = jax.process_count()
-        assert n_rows_global % n_proc == 0, (n_rows_global, n_proc)
+        if (
+            self.cand_shards != 1
+            or self.txn_shards % n_proc != 0
+            or n_rows_global % n_proc != 0
+        ):
+            from fastapriori_tpu.errors import InputError
+
+            raise InputError(
+                "multi-process row sharding needs a 1-D txn mesh with "
+                "devices and rows divisible by processes (txn_shards="
+                f"{self.txn_shards}, cand_shards={self.cand_shards}, "
+                f"rows={n_rows_global}, processes={n_proc})"
+            )
         per = n_rows_global // n_proc
         p = jax.process_index()
         return slice(p * per, (p + 1) * per)

@@ -217,20 +217,35 @@ def preprocess(
     return _python_preprocess(transactions, min_support)
 
 
+_JAVA_WS = frozenset(" \t\n\x0b\f\r")  # Java \s
+
+
 def _tokens_serialize_exactly(transactions) -> bool:
     """True iff re-serializing the token lists for the native byte
     scanner round-trips exactly: a token whose FIRST or LAST char is
     <= 0x20 (e.g. a bare "\\x01" token from a "7 \\x01 8" line) would be
     eaten by the scanner's Java-trim at a line edge or glued to a
-    neighbor, changing item identity.  Tokens cannot contain ASCII \\s
-    (the tokenizer split on it), so interior control chars are safe.
+    neighbor, and a token containing Java \\s ANYWHERE (e.g. "a b",
+    only possible via the public transactions= API — the tokenizer
+    itself splits on \\s) would be re-split into different items.
+    Interior control chars that are not Java \\s are safe to keep.
     Such tokens route to the Python path instead; file inputs
     (preprocess_file) scan the raw bytes and never re-serialize.  An
     empty token is safe only as a line's SOLE token (the empty-line
-    form, which serializes to an empty line)."""
+    form, which serializes to an empty line); a ZERO-token line has no
+    serialized form at all and routes to the Python path."""
     return all(
         (len(line) == 1 and line[0] == "")
-        or all(t and t[0] > "\x20" and t[-1] > "\x20" for t in line)
+        or (
+            bool(line)
+            and all(
+                t
+                and t[0] > "\x20"
+                and t[-1] > "\x20"
+                and _JAVA_WS.isdisjoint(t)
+                for t in line
+            )
+        )
         for line in transactions
     )
 

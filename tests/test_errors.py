@@ -91,6 +91,44 @@ def test_resume_artifacts_from_different_runs(tmp_path, capsys):
     assert "disagree" in err and "Traceback" not in err
 
 
+def test_resume_round_trips_non_java_ws_line_bytes(tmp_path):
+    """Item tokens containing \\x85 / \\x1c / U+2028 are legal (not Java
+    \\s, so never split by the tokenizer); artifacts the writer itself
+    produced must load back — str.splitlines() would shred them."""
+    from fastapriori_tpu.io.resume import load_phase1, save_phase1
+
+    items = ["a\x85b", "c\x1cd", "e f"]
+    item_to_rank = {t: r for r, t in enumerate(items)}
+    itemsets = [(frozenset({0, 1}), 7), (frozenset({0}), 9),
+                (frozenset({1}), 8), (frozenset({2}), 8)]
+    prefix = str(tmp_path / "ckpt") + "/"
+    save_phase1(prefix, itemsets, items, item_to_rank)
+    got_sets, got_ranks, got_items = load_phase1(prefix)
+    assert got_items == items
+    assert got_ranks == item_to_rank
+    assert sorted(got_sets, key=lambda x: sorted(x[0])) == sorted(
+        itemsets, key=lambda x: sorted(x[0])
+    )
+
+
+def test_filenotfound_outside_input_not_mislabeled(
+    tmp_path, capsys, monkeypatch
+):
+    """A FileNotFoundError raised past ingest (profile dir, output
+    writes) must name its actual path, not blame the input prefix."""
+    import fastapriori_tpu.cli as cli
+
+    def boom(args):
+        raise FileNotFoundError(2, "No such file", "/somewhere/else/trace")
+
+    monkeypatch.setattr(cli, "_run", boom)
+    rc = main([str(tmp_path) + "/", str(tmp_path) + "/"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "/somewhere/else/trace" in err
+    assert "D.dat" not in err and "Traceback" not in err
+
+
 def test_gen_rules_not_downward_closed():
     from fastapriori_tpu.rules.gen import gen_rules
 

@@ -55,3 +55,51 @@ def test_empty_dataset():
     assert data.num_items == 0
     assert data.total_count == 0
     assert data.n_raw == 0
+
+
+@pytest.mark.parametrize("bad", ["a b", "a\tb", "a\x0bb", "a\fb", "a\rb"])
+def test_interior_whitespace_token_keeps_identity(bad):
+    """A token with interior Java \\s (possible only via the public
+    transactions= API) cannot round-trip through the native
+    join/re-tokenize path — it must route to the Python path and stay
+    ONE item, not silently split into two."""
+    lines = [[bad, "c"]] * 10
+    data = preprocess(lines, 0.5, native=True)
+    ref = preprocess(lines, 0.5, native=False)
+    assert bad in data.freq_items
+    assert data.freq_items == ref.freq_items
+    assert data.item_to_rank == ref.item_to_rank
+
+
+def test_zero_token_line_routes_python():
+    """[] has no serialized form (it would be indistinguishable from
+    [""]); it must route to the Python path, not vacuously pass the
+    serialization gate and grow a phantom '' item on the native path."""
+    lines = [["a", "b"], []] * 10
+    nat = preprocess(lines, 0.4, native=True)
+    ref = preprocess(lines, 0.4, native=False)
+    assert nat.n_raw == ref.n_raw == 20
+    assert nat.freq_items == ref.freq_items
+    assert "" not in nat.freq_items
+
+
+def test_trailing_empty_line_not_dropped_native():
+    """A final [""] transaction must still count toward n_raw (and thus
+    minCount) on the native join/re-scan path — join_transactions'
+    trailing newline is what keeps it visible to the scanner."""
+    lines = [["a", "b"], ["a", "b"], ["b", "c"], [""]]
+    nat = preprocess(lines, 0.5, native=True)
+    ref = preprocess(lines, 0.5, native=False)
+    assert nat.n_raw == ref.n_raw == 4
+    assert nat.min_count == ref.min_count == 2
+    assert nat.freq_items == ref.freq_items
+
+
+def test_interior_non_ws_control_char_native_ok():
+    """Interior control chars that are NOT Java \\s (\\x1c) round-trip
+    through the native scanner — identical results on both paths."""
+    lines = [["a\x1cb", "c"]] * 10
+    nat = preprocess(lines, 0.5, native=True)
+    ref = preprocess(lines, 0.5, native=False)
+    assert "a\x1cb" in ref.freq_items
+    assert nat.freq_items == ref.freq_items
