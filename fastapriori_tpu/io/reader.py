@@ -20,8 +20,24 @@ from typing import List, Tuple
 # ([ \t\n\x0B\f\r]; Python's \s on str would also split on unicode
 # spaces).  The native scanner (native/preprocess.cc is_ws/trim) and the
 # reference (Utils.scala:21) both use the Java rules.
-_WS = re.compile(r"[ \t\n\x0B\f\r]+")
+JAVA_WS = frozenset(" \t\n\x0b\f\r")  # regex \s under Java semantics
+_WS = re.compile("[" + "".join(sorted(JAVA_WS)) + "]+")
 _TRIM = "".join(chr(i) for i in range(0x21))
+
+
+def _require_fsspec(path: str):
+    """The fsspec module, or a RuntimeError naming the remote path —
+    shared by every remote-capable opener so the policy (scheme
+    detection, error text) lives in one place."""
+    try:
+        import fsspec
+
+        return fsspec
+    except ImportError as e:  # pragma: no cover - environment dependent
+        raise RuntimeError(
+            f"remote path {path!r} requires fsspec, which is not "
+            "installed; copy the file locally instead"
+        ) from e
 
 
 def tokenize_line(line: str) -> List[str]:
@@ -35,15 +51,7 @@ def tokenize_line(line: str) -> List[str]:
 
 def _open(path: str):
     if "://" in path:
-        try:
-            import fsspec
-
-            return fsspec.open(path, "r").open()
-        except ImportError as e:  # pragma: no cover - environment dependent
-            raise RuntimeError(
-                f"remote path {path!r} requires fsspec, which is not "
-                "installed; copy the file locally instead"
-            ) from e
+        return _require_fsspec(path).open(path, "r").open()
     return open(path, "r")
 
 

@@ -223,13 +223,7 @@ class FastApriori:
             data.shard.global_count if data.shard else data.total_count
         )
         if data.num_items >= 2 and total > 0:
-            if self.config.engine == "fused" and data.shard is not None:
-                # Sharded ingest v1 runs the level engine (the fused
-                # whole-loop program would need its own process-local
-                # upload path); fall through without a fused attempt.
-                self.metrics.emit("fused_skip", reason="sharded_ingest")
-                levels = self._mine_levels(data)
-            elif self.config.engine == "fused":
+            if self.config.engine == "fused":
                 levels, partial = self._mine_fused(data)
                 if levels is None:  # row budget / level bound hit
                     self.metrics.emit(
@@ -286,11 +280,37 @@ class FastApriori:
         from fastapriori_tpu.ops.bitmap import pad_axis
 
         t0 = len(data.weights)
-        per_dev = -(-t0 // ctx.txn_shards)
-        n_chunks = max(1, -(-per_dev // cfg.fused_txn_chunk))
-        txn_multiple = max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
-        t_pad = pad_axis(t0, txn_multiple)
-        max_w = int(data.weights.max()) if data.total_count else 1
+        shard = data.shard
+        if shard is not None:
+            # Sharded ingest: this process holds only its shard's baskets.
+            # Shapes must be identical on every process (SPMD), so pad
+            # each process's rows to the SAME local count (max over
+            # shards) and derive the digit count from the GLOBAL max
+            # weight.  Rows are process-major, matching the mesh's device
+            # order, so the global bitmap assembles with zero cross-host
+            # data movement (shard_rows_local) — the fused analog of the
+            # level engine's sharded branch.
+            n_proc = shard.num_processes
+            if ctx.txn_shards % n_proc != 0 or ctx.cand_shards != 1:
+                self.metrics.emit("fused_skip", reason="mesh_shape")
+                return None, None
+            local_devices = max(ctx.txn_shards // n_proc, 1)
+            per_dev = -(-max(shard.local_counts) // local_devices)
+            n_chunks = max(1, -(-per_dev // cfg.fused_txn_chunk))
+            local_multiple = (
+                max(cfg.txn_tile, 32) * local_devices * n_chunks
+            )
+            local_pad = max(
+                pad_axis(c, local_multiple) for c in shard.local_counts
+            )
+            t_pad = local_pad * n_proc
+            max_w = shard.max_weight
+        else:
+            per_dev = -(-t0 // ctx.txn_shards)
+            n_chunks = max(1, -(-per_dev // cfg.fused_txn_chunk))
+            txn_multiple = max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
+            local_pad = t_pad = pad_axis(t0, txn_multiple)
+            max_w = int(data.weights.max()) if data.total_count else 1
         n_digits = 1
         while 128**n_digits <= max_w:
             n_digits += 1
@@ -334,20 +354,30 @@ class FastApriori:
             return None, None
 
         with self.metrics.timed("bitmap_pack") as m:
+            # This process's rows only (local_pad == t_pad when not
+            # sharded); shard_rows_local assembles the global arrays
+            # process-major without moving bulk data across hosts.
             packed_np, f_pad = build_packed_bitmap_csr(
                 data.basket_indices,
                 data.basket_offsets,
                 f,
-                txn_multiple,
+                local_pad,
                 cfg.item_tile,
             )
-            assert packed_np.shape[0] == t_pad, (packed_np.shape, t_pad)
-            w_np = np.zeros(t_pad, dtype=np.int32)
-            w_np[: data.total_count] = data.weights
-            packed = jax.device_put(
-                packed_np, ctx.sharding_rows()
+            assert packed_np.shape[0] == local_pad, (
+                packed_np.shape, local_pad
             )
-            w = jax.device_put(w_np, ctx.sharding_vector())
+            w_np = np.zeros(local_pad, dtype=np.int32)
+            w_np[: data.total_count] = data.weights
+            if shard is not None:
+                # Process-local rows -> global array, no cross-host bulk.
+                packed = ctx.shard_rows_local(packed_np)
+                w = ctx.shard_rows_local(w_np)
+            else:
+                # Replicated ingest: every process holds the FULL arrays
+                # (shard_rows_local would mistake them for local slices).
+                packed = jax.device_put(packed_np, ctx.sharding_rows())
+                w = jax.device_put(w_np, ctx.sharding_vector())
             m.update(
                 shape=[t_pad, f_pad],
                 digits=n_digits,

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from fastapriori_tpu.io.reader import JAVA_WS
 from fastapriori_tpu.utils.order import item_sort_key
 
 
@@ -217,9 +218,6 @@ def preprocess(
     return _python_preprocess(transactions, min_support)
 
 
-_JAVA_WS = frozenset(" \t\n\x0b\f\r")  # Java \s
-
-
 def _tokens_serialize_exactly(transactions) -> bool:
     """True iff re-serializing the token lists for the native byte
     scanner round-trips exactly: a token whose FIRST or LAST char is
@@ -242,7 +240,7 @@ def _tokens_serialize_exactly(transactions) -> bool:
                 t
                 and t[0] > "\x20"
                 and t[-1] > "\x20"
-                and _JAVA_WS.isdisjoint(t)
+                and JAVA_WS.isdisjoint(t)
                 for t in line
             )
         )
@@ -313,13 +311,26 @@ def shard_byte_range(size: int, idx: int, n: int) -> Tuple[int, int]:
     return (size * idx) // n, (size * (idx + 1)) // n
 
 
-def read_shard(path: str, idx: int, n: int) -> bytes:
-    """Read shard ``idx``'s lines (see :func:`shard_byte_range`)."""
+def _open_ranged(path: str):
+    """``(binary file handle, total size)`` — fsspec for remote URLs, so
+    a multi-host run can byte-range-shard a remote ``D.dat`` (the
+    reference read its input off HDFS, Utils.scala:21; each process here
+    seeks/reads ONLY its own range, never the whole object)."""
+    if "://" in path:
+        from fastapriori_tpu.io.reader import _require_fsspec
+
+        fs, rpath = _require_fsspec(path).core.url_to_fs(path)
+        return fs.open(rpath, "rb"), fs.size(rpath)
     import os
 
-    size = os.path.getsize(path)
+    return open(path, "rb"), os.path.getsize(path)
+
+
+def read_shard(path: str, idx: int, n: int) -> bytes:
+    """Read shard ``idx``'s lines (see :func:`shard_byte_range`)."""
+    fh, size = _open_ranged(path)
     lo, hi = shard_byte_range(size, idx, n)
-    with open(path, "rb") as fh:
+    with fh:
         if lo > 0:
             # Align forward: skip the partial line the previous shard owns.
             fh.seek(lo - 1)

@@ -55,7 +55,7 @@ _CHILD_SHARDED = r"""
 import json, sys
 import jax
 
-coordinator, n_proc, pid, d_path, out_path = sys.argv[1:6]
+coordinator, n_proc, pid, d_path, out_path, engine, remote = sys.argv[1:8]
 jax.config.update("jax_platforms", "cpu")
 from fastapriori_tpu.parallel.mesh import initialize_distributed
 
@@ -66,15 +66,32 @@ initialize_distributed(
 )
 assert jax.process_count() == int(n_proc)
 
+if remote == "1":
+    # Remote-URL ingest: stage the bytes into THIS process's in-memory
+    # filesystem and point the sharded reader at the URL — exercises the
+    # fsspec ranged-read path (fs.size + seek) under real multi-process.
+    import fsspec
+
+    with open(d_path, "rb") as f:
+        raw = f.read()
+    with fsspec.open("memory://dist_in/D.dat", "wb") as f:
+        f.write(raw)
+    d_path = "memory://dist_in/D.dat"
+
 from fastapriori_tpu.config import MinerConfig
 from fastapriori_tpu.models.apriori import FastApriori
 
-miner = FastApriori(config=MinerConfig(min_support=0.05, engine="level"))
+miner = FastApriori(config=MinerConfig(min_support=0.05, engine=engine))
 levels, data = miner.run_file_sharded(d_path)
 # This process really only preprocessed its shard...
 assert data.shard is not None
 assert data.shard.num_processes == int(n_proc)
 assert data.total_count < data.shard.global_count
+if engine == "fused":
+    # The fused whole-loop program must have run to completion (a hint
+    # is recorded only on success), not silently fallen back.
+    assert miner.context._fused_hints, "fused engine fell back"
+    assert not miner.context._fused_fails
 # ...yet the mined result is global and replicated.
 if int(pid) == 0:
     out = []
@@ -105,9 +122,45 @@ initialize_distributed(
 )
 from fastapriori_tpu.cli import main
 
-rc = main([inp, outp, "--min-support", "0.05", "--distributed",
-           "--engine", "level"])
+rc = main([inp, outp, "--min-support", "0.05", "--distributed"])
 sys.exit(rc)
+"""
+
+
+_CHILD_RECOMMEND = r"""
+import json, sys
+import jax
+
+coordinator, n_proc, pid, d_path, u_path, out_path = sys.argv[1:7]
+jax.config.update("jax_platforms", "cpu")
+from fastapriori_tpu.parallel.mesh import initialize_distributed
+
+initialize_distributed(
+    coordinator_address=coordinator,
+    num_processes=int(n_proc),
+    process_id=int(pid),
+)
+
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.io.reader import read_dat
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.models.recommender import AssociationRules
+
+cfg = MinerConfig(min_support=0.05)
+miner = FastApriori(config=cfg)
+levels, data = miner.run_file_raw(d_path)
+rec = AssociationRules(
+    [], data.freq_items, data.item_to_rank, config=cfg,
+    levels=levels, item_counts=data.item_counts,
+)
+# Force the containment-matmul path: the small test data would
+# auto-select the host scan, leaving the n_proc>1 device branch
+# (local row slices, per-process lagged early exit, final
+# process_allgather) unexercised — where SPMD hangs live.
+out = rec.run(read_dat(u_path), use_device=True)
+if int(pid) == 0:
+    with open(out_path, "w") as f:
+        json.dump(sorted([int(i), s] for i, s in out), f)
 """
 
 
@@ -173,14 +226,20 @@ def test_two_process_cli_end_to_end(tmp_path):
     assert (tmp_path / "out" / "recommends").read_text() == exp_rec
 
 
-def test_two_process_sharded_ingest_matches_oracle(tmp_path):
+@pytest.mark.parametrize(
+    "engine,remote",
+    [("level", False), ("fused", False), ("level", True)],
+)
+def test_two_process_sharded_ingest_matches_oracle(tmp_path, engine, remote):
     """Sharded ingest: each process preprocesses only its byte range of
     D.dat (global tables merged via allgather_bytes, basket shards stay
     process-local), and mining over the global mesh must be bit-exact vs
     the oracle.  The dataset repeats baskets ACROSS the shard boundary so
     the no-cross-shard-dedup path (identical baskets as separate weighted
     rows) is exercised, and one basket repeats 130x so the globally
-    uniform digit count (max weight in one shard only) matters."""
+    uniform digit count (max weight in one shard only) matters.  Both
+    engines run: the fused whole-loop program assembles the global bitmap
+    from process-local rows exactly like the level engine."""
     d_raw = (
         ["1 2 3"] * 130
         + random_dataset(9, n_txns=150, n_items=25, max_len=10)
@@ -208,6 +267,8 @@ def test_two_process_sharded_ingest_matches_oracle(tmp_path):
                 str(pid),
                 str(d_path),
                 str(out_path),
+                engine,
+                "1" if remote else "0",
             ],
             env=env,
             stdout=subprocess.PIPE,
@@ -233,6 +294,61 @@ def test_two_process_sharded_ingest_matches_oracle(tmp_path):
     lines = [l.split() for l in d_raw]
     expected, _, _ = oracle.mine(lines, 0.05)
     assert got == {frozenset(s): c for s, c in expected}
+
+
+def test_two_process_device_recommender_matches_oracle(tmp_path):
+    """The multi-process DEVICE recommender (VERDICT missing #2): 2
+    processes run the containment-matmul path with use_device=True
+    forced, each scanning only its own row slice with its own lagged
+    early exit, reassembled by one process_allgather — result must be
+    byte-exact vs the oracle's recommendation semantics."""
+    d_raw = ["1 2 3"] * 40 + random_dataset(17, n_txns=160, n_items=18)
+    u_raw = random_dataset(27, n_txns=60, n_items=18)
+    d_path = tmp_path / "D.dat"
+    u_path = tmp_path / "U.dat"
+    d_path.write_text("".join(l + "\n" for l in d_raw))
+    u_path.write_text("".join(l + "\n" for l in u_raw))
+    out_path = tmp_path / "rec.json"
+
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_CPU_DEVICES")
+    }
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _CHILD_RECOMMEND,
+                f"127.0.0.1:{port}", "2", str(pid),
+                str(d_path), str(u_path), str(out_path),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("2-process jax.distributed run timed out (ports/env)")
+    for rc, out, err in outs:
+        assert rc == 0, err.decode()[-3000:]
+
+    got = json.loads(out_path.read_text())
+    d_lines = [l.split() for l in d_raw]
+    u_lines = [l.split() for l in u_raw]
+    _, exp_rec = oracle.run_pipeline(d_lines, u_lines, 0.05)
+    exp = [
+        [i, s] for i, s in enumerate(exp_rec.splitlines())
+    ]
+    assert got == exp
 
 
 @pytest.mark.parametrize("engine", ["level", "fused"])
