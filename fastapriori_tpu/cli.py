@@ -136,9 +136,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # The D.dat/U.dat hint only fits the two ingest reads; a
         # FileNotFoundError from elsewhere in the run (--profile-dir,
         # output writes — which may share the input prefix) must name
-        # its actual path, not blame the input prefix.
-        ingest = (args.input + "D.dat", args.input + "U.dat")
-        if isinstance(missing, str) and missing in ingest:
+        # its actual path, not blame the input prefix.  Matched by
+        # basename, not full path: remote (fsspec) backends report
+        # scheme-stripped paths that never equal args.input + "D.dat".
+        if missing.endswith(("D.dat", "U.dat")):
             print(
                 f"error: input file {missing!r} not found — the input "
                 "prefix must point at D.dat and U.dat (prefix + 'D.dat', "

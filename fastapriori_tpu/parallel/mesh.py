@@ -535,20 +535,21 @@ class DeviceContext:
         _, _, item = self._get_fns(tuple(scales))
         return item(bitmap, w_digits)
 
-    def first_match_chunk(
-        self, baskets, basket_len, antecedents, ant_size, consequent,
-        base: int, best,
+    def first_match_scan(
+        self, baskets, basket_len, ant_cols, ant_size, consequent,
+        chunk: int,
     ):
-        """One priority chunk of the early-exit first-match scan
-        (ops/contain.py local_first_match_chunk)."""
-        key = ("first_match_chunk",)
+        """The whole resident-rule-table priority scan as one dispatch
+        (ops/contain.py local_first_match_scan); returns
+        ``(best, chunks_run)``."""
+        key = ("first_match_scan", chunk)
         if key not in self._fns:
             from fastapriori_tpu.ops.contain import (
-                make_sharded_first_match_chunk,
+                make_sharded_first_match_scan,
             )
 
-            self._fns[key] = make_sharded_first_match_chunk(self.mesh)
+            self._fns[key] = make_sharded_first_match_scan(self.mesh, chunk)
         return self._fns[key](
-            baskets, basket_len, antecedents, ant_size, consequent,
-            jnp.int32(base), best,
+            baskets, basket_len, ant_cols, ant_size, consequent
         )
+

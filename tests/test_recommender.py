@@ -59,10 +59,11 @@ def test_recommender_dedup_fanout():
 
 
 @pytest.mark.parametrize("rule_chunk", [128, 256])
-def test_device_first_match_chunked_scan(rule_chunk):
-    # Force the priority-chunked early-exit scan across several chunks;
-    # must agree with the host scan exactly (including users whose first
-    # match lands in a late chunk and users with no match at all).
+def test_device_first_match_resident_scan(rule_chunk):
+    # Force the on-device while_loop scan across several chunks of the
+    # resident rule table; must agree with the host scan exactly
+    # (including users whose first match lands in a late chunk and users
+    # with no match at all, who pin the loop to full length).
     from fastapriori_tpu.config import MinerConfig
 
     d_lines = tokenized(
@@ -78,12 +79,17 @@ def test_device_first_match_chunked_scan(rule_chunk):
     cfg = MinerConfig(
         min_support=0.02, num_devices=8, rule_chunk=rule_chunk,
     )
-    rec_dev = AssociationRules(
+    rec = AssociationRules(
         itemsets, freq_items, item_to_rank, config=cfg,
         context=DeviceContext(num_devices=8),
-    ).run(u_lines)
+    )
+    rec_dev = rec.run(u_lines, use_device=True)
     rec_host = AssociationRules(
         itemsets, freq_items, item_to_rank, config=cfg,
         context=DeviceContext(num_devices=1),
     ).run(u_lines, use_device=False)
     assert sorted(rec_dev) == sorted(rec_host)
+    # The resident table is uploaded once per instance: a second run
+    # must reuse it and still agree.
+    assert rec._rule_dev is not None
+    assert sorted(rec.run(u_lines, use_device=True)) == sorted(rec_host)
