@@ -57,6 +57,13 @@ class MinerConfig:
     level_k_max: int = 24
     level_cand_cap: int = 1 << 16
     pair_cap: int = 1 << 17
+    # Level engine, single-process local-file ingest: split D.dat into
+    # this many line-aligned blocks, compress each natively and start its
+    # (async) device upload immediately — block i+1's host compression
+    # overlaps block i's transfer, hiding the bitmap upload behind
+    # pass 2 (on tunneled chips the 50+ MB Webdocs upload was a full
+    # pair-phase stall).  1 disables the overlap (single block).
+    ingest_pipeline_blocks: int = 8
     # Mining engine: "fused" = whole level loop as one on-device program
     # (ops/fused.py), falling back to "level" (one kernel launch per level,
     # host candidate generation) on row-budget overflow; "level" forces the

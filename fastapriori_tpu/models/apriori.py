@@ -179,6 +179,8 @@ class FastApriori:
         1-itemsets live in ``data.item_counts`` by rank."""
         from fastapriori_tpu.preprocess import preprocess_file
 
+        if self._can_pipeline_ingest(d_path):
+            return self._run_file_pipelined(d_path)
         with self.metrics.timed("preprocess", path=d_path) as m:
             data = preprocess_file(d_path, self.config.min_support)
             m.update(
@@ -188,6 +190,177 @@ class FastApriori:
                 total_count=data.total_count,
             )
         return self.mine_levels_raw(data), data
+
+    def _can_pipeline_ingest(self, d_path: str) -> bool:
+        """Pipelined ingest (per-block compress overlapped with the
+        device upload) applies to the level engine's plain single-process
+        local-file path; every other combination keeps the existing
+        flow."""
+        cfg = self.config
+        if cfg.engine != "level" or cfg.level_use_pallas:
+            return False
+        if cfg.ingest_pipeline_blocks <= 1 or "://" in d_path:
+            return False
+        import jax
+
+        if jax.process_count() != 1:
+            return False
+        ctx = self.context
+        if ctx.txn_shards != 1 or ctx.cand_shards != 1:
+            return False
+        from fastapriori_tpu.preprocess import _use_native
+
+        return _use_native(None, 1 << 62)
+
+    def _run_file_pipelined(
+        self, d_path: str
+    ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], CompressedData]:
+        """Single-host ingest with the bitmap upload hidden behind
+        pass-2 compression: pass 1 (token counts) runs over the whole
+        buffer, then the buffer is split into line-aligned blocks, each
+        compressed against the global rank table (the per-byte-range
+        machinery the multi-host sharded ingest already proves correct —
+        cross-block duplicate baskets stay separate weighted rows with
+        identical weighted counts) and its packed bitmap block uploaded
+        asynchronously while the next block compresses on the host.
+
+        The reference's analog is ingest+first-shuffle overlapping on
+        Spark executors (FastApriori.scala:52-85); here the overlap is
+        host-compress vs host->device link."""
+        import math
+        from collections import Counter
+
+        import jax.numpy as jnp
+
+        from fastapriori_tpu.native.loader import (
+            compress_with_ranks,
+            count_buffer,
+        )
+        from fastapriori_tpu.ops.bitmap import (
+            build_packed_bitmap_csr,
+            pad_axis,
+        )
+        from fastapriori_tpu.preprocess import (
+            build_rank_map,
+            split_buffer_ranges,
+        )
+
+        cfg = self.config
+        ctx = self.context
+        with self.metrics.timed("preprocess", path=d_path) as m:
+            with open(d_path, "rb") as fh:
+                buf = fh.read()
+            n_raw, tokens, counts = count_buffer(buf)
+            min_count = math.ceil(cfg.min_support * n_raw)
+            freq_items, item_to_rank, item_counts = build_rank_map(
+                Counter(dict(zip(tokens, counts.tolist()))), min_count
+            )
+            f = len(freq_items)
+            m.update(
+                n_raw=n_raw, min_count=min_count, num_items=f,
+                pipelined=True,
+            )
+
+        def empty_data():
+            return CompressedData(
+                n_raw=n_raw,
+                min_count=min_count,
+                freq_items=freq_items,
+                item_to_rank=item_to_rank,
+                item_counts=item_counts,
+                basket_indices=np.empty(0, np.int32),
+                basket_offsets=np.zeros(1, np.int64),
+                weights=np.empty(0, np.int32),
+            )
+
+        if f < 2:
+            return [], empty_data()
+
+        # Static shapes fixed BEFORE the first upload: distinct rows are
+        # bounded by n_raw, so an n_chunks derived from it can only be
+        # (slightly) finer than the exact-count split — harmless.
+        n_chunks = max(1, -(-n_raw // cfg.level_txn_chunk))
+        txn_multiple = max(cfg.txn_tile, 32) * n_chunks
+
+        with self.metrics.timed("bitmap_build") as m:
+            from concurrent.futures import ThreadPoolExecutor
+
+            blocks = []  # (indices, offsets, weights) per block
+            dev_futures = []  # in-flight packed uploads
+            f_pad = None
+            upload_bytes = 0
+            dev = ctx.mesh.devices.flat[0]
+            # device_put is SYNCHRONOUS on some backends (it blocks until
+            # the bytes cross the link), so the transfers run on a worker
+            # thread: both the transfer and the native compress release
+            # the GIL, making block i's upload truly overlap block i+1's
+            # compression even on a 1-core host.
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                for lo, hi in split_buffer_ranges(
+                    buf, cfg.ingest_pipeline_blocks
+                ):
+                    if hi <= lo:
+                        continue
+                    _, bi, bo, bw = compress_with_ranks(
+                        buf[lo:hi], freq_items
+                    )
+                    if len(bw) == 0:
+                        continue
+                    pk, f_pad = build_packed_bitmap_csr(
+                        bi, bo, f, 1, cfg.item_tile
+                    )
+                    dev_futures.append(
+                        pool.submit(jax.device_put, pk, dev)
+                    )
+                    upload_bytes += pk.nbytes
+                    blocks.append((bi, bo, bw))
+                if not blocks:
+                    return [], empty_data()
+                dev_blocks = [fu.result() for fu in dev_futures]
+
+            total = sum(len(bw) for _, _, bw in blocks)
+            t_pad = pad_axis(total, txn_multiple)
+            parts = dev_blocks
+            if t_pad > total:
+                parts = parts + [
+                    jnp.zeros((t_pad - total, f_pad // 8), dtype=jnp.uint8)
+                ]
+            bitmap = ctx._unpack_fn()(jnp.concatenate(parts, axis=0))
+
+            # Host-side assembly (weights, CSR for API parity) overlaps
+            # the tail of the transfers.
+            w_np = np.concatenate([bw for _, _, bw in blocks])
+            w_digits_np, scales = weight_digits(w_np, t_pad)
+            w_digits = ctx.shard_weight_digits(w_digits_np)
+            indices = np.concatenate([bi for bi, _, _ in blocks])
+            offs = [np.zeros(1, dtype=np.int64)]
+            base = 0
+            for _, bo, _ in blocks:
+                offs.append(bo[1:].astype(np.int64) + base)
+                base += int(bo[-1])
+            offsets = np.concatenate(offs)
+            m.update(
+                shape=[t_pad, f_pad],
+                digits=len(scales),
+                blocks=len(blocks),
+                upload_bytes=upload_bytes + w_digits_np.nbytes,
+            )
+
+        data = CompressedData(
+            n_raw=n_raw,
+            min_count=min_count,
+            freq_items=freq_items,
+            item_to_rank=item_to_rank,
+            item_counts=item_counts,
+            basket_indices=indices,
+            basket_offsets=offsets,
+            weights=w_np,
+        )
+        levels = self._mine_levels(
+            data,
+            preupload=(bitmap, w_digits, scales, n_chunks, t_pad, f_pad),
+        )
+        return levels, data
 
     def run_file_sharded(
         self, d_path: str
@@ -481,16 +654,30 @@ class FastApriori:
 
     # ------------------------------------------------------------------
     def _mine_levels(
-        self, data: CompressedData, resume: Optional[list] = None
+        self,
+        data: CompressedData,
+        resume: Optional[list] = None,
+        preupload: Optional[tuple] = None,
     ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Level matrices ``[(int32[N, k], int64[N] counts), ...]`` for
         levels >= 2, lex-sorted.  ``resume``: complete levels salvaged
         from a failed fused attempt — the loop continues from the deepest
-        one instead of recounting them."""
+        one instead of recounting them.  ``preupload``: device-resident
+        ``(bitmap, w_digits, scales, n_chunks, t_pad, f_pad)`` from the
+        pipelined ingest — the bitmap build/upload below is skipped."""
         cfg = self.config
         ctx = self.context
         f = data.num_items
         min_count = data.min_count
+
+        if preupload is not None:
+            bitmap, w_digits, scales, n_chunks, t_pad, f_pad = preupload
+            use_pallas = False  # _can_pipeline_ingest excludes the flag
+            fast_f32 = self._fast_f32(use_pallas, data.n_raw)
+            return self._level_loop(
+                data, resume, bitmap, w_digits, scales, n_chunks,
+                use_pallas, fast_f32, t_pad,
+            )
 
         with self.metrics.timed("bitmap_build") as m:
             # Pad the txn axis so per-device rows split into n_chunks equal
@@ -542,15 +729,7 @@ class FastApriori:
             else:
                 per_dev = -(-total // ctx.txn_shards)
             n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
-            # CPU backends: ONE f32 matmul per phase (BLAS) instead of D
-            # int8 matmuls — XLA-CPU integer matmuls are orders slower.
-            # Exact while every count < 2^24 (counts are bounded by the
-            # raw transaction total); TPU always keeps the int8 MXU path.
-            fast_f32 = (
-                ctx.platform == "cpu"
-                and not use_pallas
-                and data.n_raw < 2**24
-            )
+            fast_f32 = self._fast_f32(use_pallas, data.n_raw)
             if shard is None:
                 txn_multiple = (
                     max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
@@ -624,7 +803,42 @@ class FastApriori:
                 fast_f32=fast_f32,
                 upload_bytes=packed_np.nbytes + w_digits_np.nbytes,
             )
+        return self._level_loop(
+            data, resume, bitmap, w_digits, scales, n_chunks, use_pallas,
+            fast_f32, t_pad,
+        )
 
+    def _fast_f32(self, use_pallas: bool, n_raw: int) -> bool:
+        """CPU backends: ONE f32 matmul per phase (BLAS) instead of D
+        int8 matmuls — XLA-CPU integer matmuls are orders slower.  Exact
+        while every count < 2^24 (counts are bounded by the raw
+        transaction total); TPU always keeps the int8 MXU path.  One
+        definition for both ingest modes — the kernel choice must never
+        depend on how the bitmap reached the device."""
+        return (
+            self.context.platform == "cpu"
+            and not use_pallas
+            and n_raw < 2**24
+        )
+
+    def _level_loop(
+        self,
+        data: CompressedData,
+        resume: Optional[list],
+        bitmap,
+        w_digits,
+        scales,
+        n_chunks: int,
+        use_pallas: bool,
+        fast_f32: bool,
+        t_pad: int,
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """The level-synchronous loop over a device-resident bitmap
+        (levels 2..k; reference C6+C7+C8+C9)."""
+        cfg = self.config
+        ctx = self.context
+        f = data.num_items
+        min_count = data.min_count
         # Frequent k-sets live as a lex-sorted int32 [M, k] matrix between
         # levels; frozensets are materialized ONCE at the end (the per-set
         # Python objects were the dominant cost on dense data).

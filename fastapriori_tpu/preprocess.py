@@ -311,6 +311,33 @@ def shard_byte_range(size: int, idx: int, n: int) -> Tuple[int, int]:
     return (size * idx) // n, (size * (idx + 1)) // n
 
 
+def split_buffer_ranges(data: bytes, n: int) -> List[Tuple[int, int]]:
+    """Partition an in-memory buffer into ``n`` line-aligned byte ranges
+    — the same alignment rule as :func:`read_shard` (start aligned
+    forward past the straddling line, which the previous range owns), so
+    the ranges cover every line exactly once.  Used by the pipelined
+    single-host ingest to overlap per-block compression with the
+    device upload."""
+    size = len(data)
+    cuts = [0]
+    for i in range(1, n):
+        b = (size * i) // n
+        prev = cuts[-1]
+        if b <= prev:
+            cuts.append(prev)
+            continue
+        if data[b - 1 : b] == b"\n":
+            cuts.append(b)
+        else:
+            j = data.find(b"\n", b)
+            cuts.append(size if j < 0 else j + 1)
+    cuts.append(size)
+    # cuts is non-decreasing by construction (a find() past a later
+    # nominal boundary makes that later range empty — harmless, the
+    # line belongs to the earlier range).
+    return list(zip(cuts[:-1], cuts[1:]))
+
+
 def _open_ranged(path: str):
     """``(binary file handle, total size)`` — fsspec for remote URLs, so
     a multi-host run can byte-range-shard a remote ``D.dat`` (the
