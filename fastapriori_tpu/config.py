@@ -55,7 +55,13 @@ class MinerConfig:
     # pair threshold (doubles on overflow).
     level_txn_chunk: int = 1 << 14
     level_k_max: int = 24
-    level_cand_cap: int = 1 << 16
+    level_cand_cap: int = 1 << 18
+    # Max candidate-prefix rows per level dispatch.  Dispatches carry a
+    # large fixed cost on remote/tunneled chips (~100+ ms each: argument
+    # transfer + launch round trip that the runtime does NOT pipeline),
+    # so big levels want few big dispatches; the [txn_chunk, P] device
+    # intermediate bounds how big.
+    level_prefix_cap: int = 1 << 14
     pair_cap: int = 1 << 17
     # Level engine, single-process local-file ingest: split D.dat into
     # this many line-aligned blocks, compress each natively and start its

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fastapriori_tpu.config import MinerConfig
-from fastapriori_tpu.models.candidates import gen_candidates_blocks
+from fastapriori_tpu.models.candidates import gen_candidates_stream
 from fastapriori_tpu.ops.bitmap import (
     build_packed_bitmap_csr,
     weight_digits,
@@ -894,7 +894,7 @@ class FastApriori:
                     w_digits,
                     scales,
                     cur,
-                    gen_candidates_blocks(cur),
+                    gen_candidates_stream(cur),
                     min_count,
                     n_chunks,
                     use_pallas,
@@ -925,15 +925,15 @@ class FastApriori:
         candidate's own count comes back.
 
         ``cand_blocks`` is an ITERATOR of ``(x_idx, ys)`` blocks in
-        global ``(x_idx, y)`` order (candidates.gen_candidates_blocks):
-        each block's chunks are dispatched (async) before the next block
-        is pulled, so the host's join+prune for block i+1 overlaps the
-        device counting of block i — at Webdocs scale candidate
-        generation is ~4.5 s of host work that previously idled the
-        chip.  Results are fetched only after every block is dispatched.
-        Returns the next level's lex-sorted matrix, its counts, and a
-        stats dict (candidate count, kernel dispatches, MAC count, psum
-        bytes) for the per-level metrics."""
+        global ``(x_idx, y)`` order (candidates.gen_candidates_stream).
+        The native generator emits ONE block (its early-exit prune is
+        fast enough to run ahead of the first dispatch); the numpy
+        fallback streams blocks, and each block's chunks are dispatched
+        (async) before the next block is pulled so its join+prune
+        overlaps device counting.  Results are fetched only after every
+        block is dispatched.  Returns the next level's lex-sorted
+        matrix, its counts, and a stats dict (candidate count, kernel
+        dispatches, MAC count, psum bytes) for the per-level metrics."""
         cfg = self.config
         s = level.shape[1]
         f_pad = bitmap.shape[1]
@@ -946,11 +946,17 @@ class FastApriori:
         # per-prefix runs — each shard's budget must fit at least one run.
         # With cand_shards == 1 this is exactly the old single-block path.
         n_cs = ctx.cand_shards
-        c_sh = max(cfg.level_cand_cap // n_cs, f_pad)
-        c_cap = c_sh * n_cs
-        k_pad = cfg.level_k_max
+        c_cap_max = max(cfg.level_cand_cap // n_cs, f_pad)
+        # Prefix width in buckets of 8 (at most ceil(level_k_max/8)
+        # compiled shapes): the host->device prefix table is the per-
+        # dispatch upload that dominates fixed dispatch cost on tunneled
+        # chips, so a shallow level must not pay a level_k_max-wide row.
+        k_pad = min(((s + 7) // 8) * 8, max(cfg.level_k_max, 8))
         if s > k_pad:  # deeper than the padded width: widen (recompiles)
             k_pad = ((s + 7) // 8) * 8
+        # Compact dtype for the same reason (half the bytes) — int32 only
+        # when the padded item axis outgrows int16.
+        cols_dt = np.int16 if f_pad <= (1 << 15) else np.int32
         d_eff = 1 if fast_f32 else len(scales)
         stats = {
             "candidates": 0, "dispatches": 0, "macs": 0, "psum_bytes": 0,
@@ -968,18 +974,20 @@ class FastApriori:
             uniq_x, run_start = np.unique(x_idx, return_index=True)
             run_end = np.concatenate([run_start[1:], [x_idx.size]])
             # Right-size the prefix budget to THIS block's actual prefix
-            # count, in power-of-two buckets (compiles stay bounded: at
-            # most log2(4096/128) sizes) up to the 4096-row
-            # transfer-amortization cap.  A fixed 4096 made every small
-            # level pay the full padded [T, 4096] membership matmul —
-            # ~145 GMAC for a 1-candidate level at T10I4D100K scale, the
-            # whole CPU-fallback regression.
+            # count, in power-of-two buckets (compiles stay bounded) up
+            # to the level_prefix_cap transfer-amortization cap.  A fixed
+            # cap-wide budget made every small level pay the full padded
+            # [T, P] membership matmul — ~145 GMAC for a 1-candidate
+            # level at T10I4D100K scale, the whole CPU-fallback
+            # regression.  The cap itself is large (2^14) because each
+            # extra dispatch costs ~100+ ms of fixed launch latency on
+            # tunneled chips — big levels want FEW dispatches.
             p_sh = min(
                 max(
                     _next_pow2(-(-uniq_x.size // n_cs)),
                     max(cfg.min_prefix_bucket // n_cs, 1),
                 ),
-                max(4096 // n_cs, 1),
+                max(cfg.level_prefix_cap // n_cs, 1),
             )
             if use_pallas:
                 from fastapriori_tpu.ops.pallas_level import M_TILE
@@ -987,9 +995,21 @@ class FastApriori:
                 # Per-shard prefix rows must be whole M tiles.
                 p_sh = -(-max(p_sh, M_TILE) // M_TILE) * M_TILE
             p_cap = p_sh * n_cs
+            # Candidate budget right-sized the same way: the [C_cap]
+            # cand_idx upload and result fetch are per-dispatch fixed
+            # bytes on the host link — a near-empty level must not ship
+            # the full cap.
+            c_sh = min(
+                max(
+                    _next_pow2(-(-x_idx.size // n_cs)),
+                    f_pad,
+                ),
+                c_cap_max,
+            )
+            c_cap = c_sh * n_cs
             start = 0  # index into uniq_x
             while start < uniq_x.size:
-                prefix_cols = np.full((p_cap, k_pad), zcol, dtype=np.int32)
+                prefix_cols = np.full((p_cap, k_pad), zcol, dtype=cols_dt)
                 cand_idx = np.zeros(c_cap, dtype=np.int32)
                 placed = []  # (counts slice, offset in cand_idx, length)
                 for sh in range(n_cs):

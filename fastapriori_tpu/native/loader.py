@@ -97,6 +97,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int32,
         ]
+    cand = getattr(lib, "fa_gen_candidates", None)
+    if cand is not None:
+        cand.restype = ctypes.POINTER(_FaCandidates)
+        cand.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.fa_free_candidates.argtypes = [ctypes.POINTER(_FaCandidates)]
+        lib.fa_free_candidates.restype = None
     _lib = lib
     return _lib
 
@@ -110,6 +120,14 @@ NativeResult = Tuple[
     np.ndarray,  # basket_offsets int64[T'+1]
     np.ndarray,  # weights int32[T']
 ]
+
+
+class _FaCandidates(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        ("x_idx", ctypes.POINTER(ctypes.c_int64)),
+        ("y", ctypes.POINTER(ctypes.c_int32)),
+    ]
 
 
 class _FaCounts(ctypes.Structure):
@@ -296,6 +314,37 @@ def fill_packed_bitmap(
 def preprocess_file(path: str, min_support: float) -> NativeResult:
     with open(path, "rb") as fh:
         return preprocess_buffer(fh.read(), min_support)
+
+
+def gen_candidates_native(level: np.ndarray):
+    """Prefix join + Apriori subset prune over a lex-sorted int32 [M, s]
+    level matrix (reference C7).  Returns ``(x_idx int64[C], y int32[C])``
+    in global (x_idx, y) order — identical to
+    models/candidates.gen_candidates_arrays.  Raises if the native
+    library (or a stale build) lacks the entry point."""
+    lib = get_lib()
+    if lib is None or getattr(lib, "fa_gen_candidates", None) is None:
+        raise RuntimeError(
+            "native candidate-gen entry point unavailable; rebuild with "
+            "`make -C fastapriori_tpu/native`"
+        )
+    level = np.ascontiguousarray(level, dtype=np.int32)
+    m, s = level.shape
+    res_ptr = lib.fa_gen_candidates(
+        level.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), m, s
+    )
+    if not res_ptr:
+        raise MemoryError("fa_gen_candidates failed")
+    try:
+        res = res_ptr.contents
+        n = int(res.n)
+        x_idx = np.ctypeslib.as_array(res.x_idx, shape=(max(n, 1),))[
+            :n
+        ].copy()
+        y = np.ctypeslib.as_array(res.y, shape=(max(n, 1),))[:n].copy()
+        return x_idx, y
+    finally:
+        lib.fa_free_candidates(res_ptr)
 
 
 def join_transactions(transactions: Sequence[Sequence[str]]) -> bytes:

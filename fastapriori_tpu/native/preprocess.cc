@@ -791,3 +791,177 @@ void fa_free_result(FaResult* res) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Apriori candidate generation (reference C7, FastApriori.scala:167-193):
+// prefix join + subset prune over a lex-sorted [M, s] level matrix.  The
+// numpy implementation (models/candidates.py) spends ~99% of its time in
+// the prune's per-subset searchsorted passes (it cannot early-exit per
+// candidate); this native version prunes each candidate with early exit
+// and a per-(group, drop-position) narrowed binary-search range, making
+// host candidate generation a non-factor next to device counting.
+
+namespace {
+
+// Rows [lo, hi) of `level` whose first `plen` ints equal `key` (binary
+// search twice over the lex-sorted matrix).
+struct RowRange {
+  int64_t lo, hi;
+};
+
+inline int cmp_prefix(const int32_t* a, const int32_t* key, int32_t plen) {
+  for (int32_t d = 0; d < plen; ++d) {
+    if (a[d] != key[d]) return a[d] < key[d] ? -1 : 1;
+  }
+  return 0;
+}
+
+RowRange prefix_range(const int32_t* level, int64_t m, int32_t s,
+                      const int32_t* key, int32_t plen) {
+  int64_t lo = 0, hi = m;
+  while (lo < hi) {  // first row with prefix >= key
+    int64_t mid = (lo + hi) >> 1;
+    if (cmp_prefix(level + mid * s, key, plen) < 0) lo = mid + 1;
+    else hi = mid;
+  }
+  int64_t lo2 = lo, hi2 = m;
+  while (lo2 < hi2) {  // first row with prefix > key
+    int64_t mid = (lo2 + hi2) >> 1;
+    if (cmp_prefix(level + mid * s, key, plen) <= 0) lo2 = mid + 1;
+    else hi2 = mid;
+  }
+  return {lo, lo2};
+}
+
+// Is (a_last, y) present as the last two elements of a row inside
+// [r.lo, r.hi) (rows there share the first s-2 ints already)?
+inline bool tail_exists(const int32_t* level, int32_t s, RowRange r,
+                        int32_t a_last, int32_t y) {
+  int64_t lo = r.lo, hi = r.hi;
+  while (lo < hi) {
+    int64_t mid = (lo + hi) >> 1;
+    const int32_t* row = level + mid * s + (s - 2);
+    bool lt = row[0] != a_last ? row[0] < a_last : row[1] < y;
+    if (lt) lo = mid + 1;
+    else hi = mid;
+  }
+  if (lo >= r.hi) return false;
+  const int32_t* row = level + lo * s + (s - 2);
+  return row[0] == a_last && row[1] == y;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct FaCandidates {
+  int64_t n;
+  int64_t* x_idx;  // [n] prefix row index into the level matrix
+  int32_t* y;      // [n] extension rank
+};
+
+void fa_free_candidates(FaCandidates* c);
+
+// level: lex-sorted int32 [m, s] row-major.  Returns survivors of the
+// prefix join + Apriori subset prune in global (x_idx, y) order, or
+// nullptr on allocation failure.  Free with fa_free_candidates.
+FaCandidates* fa_gen_candidates(const int32_t* level, int64_t m, int32_t s) {
+  auto* res = static_cast<FaCandidates*>(std::malloc(sizeof(FaCandidates)));
+  if (!res) return nullptr;
+  res->n = 0;
+  res->x_idx = nullptr;
+  res->y = nullptr;
+  if (m < 2 || s < 1) {
+    res->x_idx = static_cast<int64_t*>(std::malloc(sizeof(int64_t)));
+    res->y = static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
+    if (!res->x_idx || !res->y) {
+      fa_free_candidates(res);
+      return nullptr;
+    }
+    return res;
+  }
+  std::vector<int64_t> xs;
+  std::vector<int32_t> ys;
+  std::vector<int32_t> sub(s);
+  // Per-(group, drop-position) narrowed range: rows matching the
+  // candidate subset's first s-2 ints (= group prefix minus one element,
+  // plus x's last for the deepest position).  Reused across the group's
+  // pairs, so each pair's membership test is a short tail search.
+  std::vector<RowRange> ranges(s > 1 ? s - 1 : 1);
+
+  auto row = [&](int64_t i) { return level + i * s; };
+  int64_t g0 = 0;
+  for (int64_t i = 1; i <= m; ++i) {
+    bool boundary =
+        (i == m) ||
+        (s > 1 &&
+         std::memcmp(row(i), row(i - 1), sizeof(int32_t) * (s - 1)) != 0);
+    if (s == 1) boundary = (i == m);  // single group when s == 1
+    if (!boundary) continue;
+    const int64_t gn = i - g0;
+    if (gn >= 2) {
+      const int32_t* shared = row(g0);  // first s-1 ints shared
+      if (s == 1) {
+        // Level 1 never reaches here in the mining engine (level 2 is
+        // the pair matmul) but keep the join semantics total: no prune
+        // (candidates have no (s-1)-subsets beyond the joined rows).
+        for (int64_t a = g0; a < i; ++a)
+          for (int64_t b = a + 1; b < i; ++b) {
+            xs.push_back(a);
+            ys.push_back(row(b)[0]);
+          }
+      } else {
+        // Precompute, per drop position d in the shared prefix, the row
+        // range matching (shared minus position d) as a first-(s-2)
+        // prefix.  The candidate subset for (a, b, d) is that prefix +
+        // (x_last, y): membership is a tail search in the range.
+        for (int32_t d = 0; d + 1 < s; ++d) {
+          int32_t w = 0;
+          for (int32_t e = 0; e + 1 < s; ++e)
+            if (e != d) sub[w++] = shared[e];
+          ranges[d] = prefix_range(level, m, s, sub.data(), s - 2);
+        }
+        for (int64_t a = g0; a < i; ++a) {
+          const int32_t a_last = row(a)[s - 1];
+          for (int64_t b = a + 1; b < i; ++b) {
+            const int32_t yv = row(b)[s - 1];
+            bool ok = true;
+            for (int32_t d = 0; d + 1 < s; ++d) {
+              if (!tail_exists(level, s, ranges[d], a_last, yv)) {
+                ok = false;
+                break;
+              }
+            }
+            if (ok) {
+              xs.push_back(a);
+              ys.push_back(yv);
+            }
+          }
+        }
+      }
+    }
+    g0 = i;
+  }
+  const int64_t n = static_cast<int64_t>(xs.size());
+  res->n = n;
+  res->x_idx = static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (n ? n : 1)));
+  res->y = static_cast<int32_t*>(std::malloc(sizeof(int32_t) * (n ? n : 1)));
+  if (!res->x_idx || !res->y) {
+    fa_free_candidates(res);
+    return nullptr;
+  }
+  if (n) {
+    std::memcpy(res->x_idx, xs.data(), sizeof(int64_t) * n);
+    std::memcpy(res->y, ys.data(), sizeof(int32_t) * n);
+  }
+  return res;
+}
+
+void fa_free_candidates(FaCandidates* c) {
+  if (!c) return;
+  std::free(c->x_idx);
+  std::free(c->y);
+  std::free(c);
+}
+
+}  // extern "C"

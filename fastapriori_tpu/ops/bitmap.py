@@ -129,6 +129,9 @@ def scatter_one_hot(cols, f_pad: int):
     import jax.numpy as jnp
 
     n = cols.shape[0]
+    # Compact tables may travel in int16 (half the host link bytes);
+    # widen on device for the scatter.
+    cols = cols.astype(jnp.int32)
     return (
         jnp.zeros((n, f_pad), jnp.int8)
         .at[jnp.arange(n)[:, None], cols]

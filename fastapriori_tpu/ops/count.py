@@ -193,9 +193,11 @@ def local_level_gather(
     p = prefix_cols.shape[0]
     d = w_digits.shape[0]
     onehot_dt = jnp.float32 if fast_f32 else jnp.int8
+    # prefix_cols may arrive int16 (compact host-link form); widen on
+    # device for the scatter.
     onehot = (
         jnp.zeros((p, f_pad), onehot_dt)
-        .at[jnp.arange(p)[:, None], prefix_cols]
+        .at[jnp.arange(p)[:, None], prefix_cols.astype(jnp.int32)]
         .set(1)
     )
     tc = t_loc // n_chunks

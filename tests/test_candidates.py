@@ -92,3 +92,49 @@ def test_arrays_empty_and_tiny():
     assert xi.size == 0 and ys.size == 0
     xi, ys = gen_candidates_arrays(np.array([[0, 1]], dtype=np.int32))
     assert xi.size == 0
+
+
+def test_native_candidates_match_numpy():
+    """fa_gen_candidates must emit exactly gen_candidates_arrays'
+    (x_idx, y) stream — same survivors, same global order — across
+    random levels of several widths, including join-heavy shapes."""
+    import numpy as np
+    import pytest
+
+    from fastapriori_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native extension not built")
+    from fastapriori_tpu.models.candidates import (
+        gen_candidates_arrays,
+        gen_candidates_stream,
+    )
+    from fastapriori_tpu.native.loader import gen_candidates_native
+
+    rng = np.random.default_rng(5)
+    for s in (1, 2, 3, 5, 8):
+        for m in (2, 7, 300):
+            rows = np.unique(
+                np.sort(
+                    rng.integers(0, 10 + s, size=(m, s)), axis=1
+                ),
+                axis=0,
+            )
+            # strictly increasing rows only (valid itemsets)
+            keep = np.all(np.diff(rows, axis=1) > 0, axis=1) if s > 1 else (
+                np.ones(rows.shape[0], dtype=bool)
+            )
+            lvl = rows[keep].astype(np.int32)
+            if lvl.shape[0] < 2:
+                continue
+            x0, y0 = gen_candidates_arrays(lvl)
+            x1, y1 = gen_candidates_native(lvl)
+            assert (x0 == x1).all() and (y0 == y1).all(), (s, lvl.shape)
+            # the engine-facing stream picks the native path and agrees
+            blocks = list(gen_candidates_stream(lvl))
+            if x0.size:
+                xs = np.concatenate([b[0] for b in blocks])
+                ys = np.concatenate([b[1] for b in blocks])
+                assert (xs == x0).all() and (ys == y0).all()
+            else:
+                assert blocks == []
