@@ -290,7 +290,9 @@ def _orchestrate(args) -> int:
                 (l for l in out.splitlines() if l.startswith("{")), None
             )
             if proc.returncode == 0 and line:
-                print(line)
+                merged = json.loads(line)
+                merged.update(_north_star_attach(args, platform))
+                print(json.dumps(merged))
                 return 0
             print(
                 f"engine={engine} platform={platform} failed "
@@ -303,6 +305,84 @@ def _orchestrate(args) -> int:
     finally:
         if own_file:
             os.unlink(d_path)
+
+
+def _north_star_attach(args, platform) -> dict:
+    """North-star fields folded into the single driver-parsed JSON line
+    (VERDICT weak #5): when the driver invokes the default config, ALSO
+    measure webdocs (1.7M txns @ minSupport=0.1 — the BASELINE.json
+    north-star run) with the level engine and report its txns/s, warm
+    wall and MFU as webdocs_* fields.  Best-effort: any failure or
+    timeout leaves the main metric intact."""
+    import os
+    import subprocess
+
+    if (
+        args.config != "t10i4d100k"
+        or args.n_txns != CONFIGS["t10i4d100k"][0]  # not a smoke run
+        or args.workload != "mine"
+        or platform == "cpu"
+    ):
+        return {}
+    try:
+        n_txns, n_items, avg_len, min_support, style = CONFIGS["webdocs"]
+        # Cache keyed by the generating parameters — a differently-seeded
+        # or resized run must not silently mine a stale file.
+        cache = f"/tmp/webdocs_bench_s{args.seed}_n{n_txns}.dat"
+        if not os.path.exists(cache):
+            t0 = time.perf_counter()
+            import argparse as _ap
+
+            wd_args = _ap.Namespace(
+                n_txns=n_txns, n_items=n_items, avg_len=avg_len,
+                seed=args.seed, style=style,
+            )
+            raw = gen_lines(wd_args)
+            with open(cache + ".tmp", "w") as fh:
+                fh.write("\n".join(raw) + "\n")
+            os.replace(cache + ".tmp", cache)
+            del raw
+            print(
+                f"north-star datagen [webdocs]: {n_txns} txns in "
+                f"{time.perf_counter()-t0:.1f}s",
+                file=sys.stderr,
+            )
+        proc = subprocess.run(
+            [
+                sys.executable, __file__,
+                "--config", "webdocs",
+                "--n-txns", str(n_txns),
+                "--min-support", str(min_support),
+                "--seed", str(args.seed),
+                "--data-file", cache,
+                "--engine", "level",
+                "--skip-baseline",
+            ],
+            stdout=subprocess.PIPE,
+            timeout=900,
+        )
+        line = next(
+            (
+                l
+                for l in proc.stdout.decode().splitlines()
+                if l.startswith("{")
+            ),
+            None,
+        )
+        if proc.returncode != 0 or not line:
+            print("north-star webdocs run failed", file=sys.stderr)
+            return {}
+        wd = json.loads(line)
+        out = {
+            "webdocs_txns_per_sec": wd.get("value"),
+            "webdocs_warm_wall_s": wd.get("warm_wall_s"),
+        }
+        if "mfu_pct" in wd:
+            out["webdocs_mfu_pct"] = wd["mfu_pct"]
+        return out
+    except Exception as e:  # noqa: BLE001 - attach must never kill the run
+        print(f"north-star attach skipped: {e}", file=sys.stderr)
+        return {}
 
 
 def _recommend_workload(args, raw, d_path) -> int:
@@ -587,7 +667,13 @@ def main(argv=None) -> int:
         "value": round(tps, 1),
         "unit": "txns/sec",
         "vs_baseline": round(vs_baseline, 3),
+        # Walls reported separately (VERDICT weak #6): the ratio's
+        # run-to-run noise comes almost entirely from the single-core
+        # baseline denominator; chip-side medians are stable.
+        "warm_wall_s": round(warm, 3),
     }
+    if not args.skip_baseline and vs_baseline > 0:
+        line["baseline_wall_s"] = round(base, 3)
     line.update(mfu)
     print(json.dumps(line))
     return 0

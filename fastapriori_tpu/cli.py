@@ -101,13 +101,6 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a jax.profiler trace for the mining phase here",
     )
     p.add_argument(
-        "--level-pallas",
-        action="store_true",
-        help="count levels with the Pallas fused containment kernel "
-        "(ops/pallas_level.py) instead of the XLA formulation "
-        "(level engine only; interpreted on CPU backends)",
-    )
-    p.add_argument(
         "--platform",
         choices=["default", "cpu"],
         default="default",
@@ -158,7 +151,6 @@ def _run(args) -> int:
         cand_devices=args.cand_devices,
         log_metrics=args.metrics,
         engine=args.engine,
-        level_use_pallas=args.level_pallas,
     )
     if args.platform == "cpu":
         import jax

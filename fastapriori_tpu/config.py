@@ -40,13 +40,6 @@ class MinerConfig:
     # scan stops as soon as every basket has matched, so most runs touch
     # only the first chunk).
     rule_chunk: int = 1 << 13
-    # Level engine: count levels with the Pallas fused
-    # containment+counting kernel (ops/pallas_level.py — keeps the [T, P]
-    # common intermediate in VMEM) instead of the XLA formulation.
-    # Interpreted on CPU backends; compiled on TPU.  Falls back to the
-    # XLA path when the weight-digit count exceeds the kernel's static
-    # bound.
-    level_use_pallas: bool = False
     # Level engine (transfer-minimal kernels, ops/count.py
     # local_level_gather / local_pair_gather): transaction-axis scan chunk
     # (bounds the [tc, P] membership intermediate in HBM), padded prefix
@@ -70,6 +63,14 @@ class MinerConfig:
     # pass 2 (on tunneled chips the 50+ MB Webdocs upload was a full
     # pair-phase stall).  1 disables the overlap (single block).
     ingest_pipeline_blocks: int = 8
+    # Host threads for the pipelined ingest's pass-1 counting and pass-2
+    # compression (the native scanner releases the GIL, so byte-range
+    # blocks really run in parallel — the single-host analog of the
+    # multi-host sharded ingest, same count-merge correctness).  None =
+    # one thread per core.  A 1-core host (like some tunneled-TPU dev
+    # hosts) degenerates to the serial path with no overhead worth
+    # noting.
+    ingest_threads: Optional[int] = None
     # Mining engine: "fused" = whole level loop as one on-device program
     # (ops/fused.py), falling back to "level" (one kernel launch per level,
     # host candidate generation) on row-budget overflow; "level" forces the

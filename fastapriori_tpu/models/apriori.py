@@ -197,7 +197,7 @@ class FastApriori:
         local-file path; every other combination keeps the existing
         flow."""
         cfg = self.config
-        if cfg.engine != "level" or cfg.level_use_pallas:
+        if cfg.engine != "level":
             return False
         if cfg.ingest_pipeline_blocks <= 1 or "://" in d_path:
             return False
@@ -247,18 +247,48 @@ class FastApriori:
 
         cfg = self.config
         ctx = self.context
+        import os
+        from concurrent.futures import ThreadPoolExecutor
+
+        n_threads = cfg.ingest_threads or os.cpu_count() or 1
         with self.metrics.timed("preprocess", path=d_path) as m:
             with open(d_path, "rb") as fh:
                 buf = fh.read()
-            n_raw, tokens, counts = count_buffer(buf)
+            # Pass 1 across threads: each thread counts its own
+            # line-aligned byte range (the native call releases the GIL)
+            # and the tiny per-range token tables merge on the main
+            # thread — the single-host analog of the multi-host sharded
+            # ingest's count merge, with the same correctness argument.
+            p1_ranges = [
+                r
+                for r in split_buffer_ranges(buf, max(n_threads, 1))
+                if r[1] > r[0]
+            ]
+            if len(p1_ranges) > 1:
+                with ThreadPoolExecutor(n_threads) as pool:
+                    # Slice INSIDE the worker: block copies in flight are
+                    # bounded by the thread count, not the range count.
+                    parts = list(
+                        pool.map(
+                            lambda r: count_buffer(buf[r[0] : r[1]]),
+                            p1_ranges,
+                        )
+                    )
+            else:
+                parts = [count_buffer(buf)]
+            n_raw = sum(p[0] for p in parts)
+            merged: Counter = Counter()
+            for _, toks, cnts in parts:
+                for tok, c in zip(toks, cnts.tolist()):
+                    merged[tok] += c
             min_count = math.ceil(cfg.min_support * n_raw)
             freq_items, item_to_rank, item_counts = build_rank_map(
-                Counter(dict(zip(tokens, counts.tolist()))), min_count
+                merged, min_count
             )
             f = len(freq_items)
             m.update(
                 n_raw=n_raw, min_count=min_count, num_items=f,
-                pipelined=True,
+                pipelined=True, threads=n_threads,
             )
 
         def empty_data():
@@ -283,34 +313,47 @@ class FastApriori:
         txn_multiple = max(cfg.txn_tile, 32) * n_chunks
 
         with self.metrics.timed("bitmap_build") as m:
-            from concurrent.futures import ThreadPoolExecutor
-
             blocks = []  # (indices, offsets, weights) per block
             dev_futures = []  # in-flight packed uploads
             f_pad = None
             upload_bytes = 0
             dev = ctx.mesh.devices.flat[0]
-            # device_put is SYNCHRONOUS on some backends (it blocks until
-            # the bytes cross the link), so the transfers run on a worker
-            # thread: both the transfer and the native compress release
-            # the GIL, making block i's upload truly overlap block i+1's
-            # compression even on a 1-core host.
-            with ThreadPoolExecutor(max_workers=1) as pool:
-                for lo, hi in split_buffer_ranges(
-                    buf, cfg.ingest_pipeline_blocks
-                ):
-                    if hi <= lo:
-                        continue
-                    _, bi, bo, bw = compress_with_ranks(
-                        buf[lo:hi], freq_items
+            # Pass 2 across threads (compression is GIL-free native
+            # code), results consumed in block order for deterministic
+            # row layout.  device_put is SYNCHRONOUS on some backends
+            # (it blocks until the bytes cross the link), so transfers
+            # run on their own worker: block i's upload overlaps block
+            # i+1's compression even on a 1-core host.
+            with ThreadPoolExecutor(
+                max_workers=n_threads
+            ) as cpool, ThreadPoolExecutor(max_workers=1) as upool:
+                ranges = [
+                    r
+                    for r in split_buffer_ranges(
+                        buf, max(cfg.ingest_pipeline_blocks, n_threads)
                     )
+                    if r[1] > r[0]
+                ]
+                # Slice inside the worker: at most n_threads block
+                # copies exist at once (eager slicing at submit time
+                # would duplicate the whole file next to `buf`).
+                comp = [
+                    cpool.submit(
+                        lambda lo=lo, hi=hi: compress_with_ranks(
+                            buf[lo:hi], freq_items
+                        )
+                    )
+                    for lo, hi in ranges
+                ]
+                for fu in comp:
+                    _, bi, bo, bw = fu.result()
                     if len(bw) == 0:
                         continue
                     pk, f_pad = build_packed_bitmap_csr(
                         bi, bo, f, 1, cfg.item_tile
                     )
                     dev_futures.append(
-                        pool.submit(jax.device_put, pk, dev)
+                        upool.submit(jax.device_put, pk, dev)
                     )
                     upload_bytes += pk.nbytes
                     blocks.append((bi, bo, bw))
@@ -672,50 +715,17 @@ class FastApriori:
 
         if preupload is not None:
             bitmap, w_digits, scales, n_chunks, t_pad, f_pad = preupload
-            use_pallas = False  # _can_pipeline_ingest excludes the flag
-            fast_f32 = self._fast_f32(use_pallas, data.n_raw)
+            fast_f32 = self._fast_f32(data.n_raw)
             return self._level_loop(
                 data, resume, bitmap, w_digits, scales, n_chunks,
-                use_pallas, fast_f32, t_pad,
+                fast_f32, t_pad,
             )
 
         with self.metrics.timed("bitmap_build") as m:
             # Pad the txn axis so per-device rows split into n_chunks equal
-            # scan chunks (ops/count.py local_level_gather); the Pallas
-            # path instead needs per-device rows to be a tile multiple
-            # (its grid does the chunking, keeping `common` in VMEM).
-            # Pallas eligibility is decided BEFORE padding so a fallback
-            # keeps the chunked layout's HBM bound: the kernel statically
-            # unrolls at most MAX_DIGITS weight digits, and its blocks
-            # span the full item width — beyond ~2048 padded items the
-            # resident [tile, F] blocks exceed VMEM.
+            # scan chunks (ops/count.py local_level_gather).
             shard = data.shard
             total = shard.global_count if shard else data.total_count
-            use_pallas = cfg.level_use_pallas
-            if use_pallas:
-                from fastapriori_tpu.ops.pallas_level import (
-                    MAX_DIGITS,
-                    T_TILE,
-                )
-                from fastapriori_tpu.ops.bitmap import pad_axis
-
-                # GLOBAL max weight when sharded: every process must make
-                # the same eligibility decision (SPMD), and the uniform
-                # digit count must fit the kernel's static bound even on
-                # processes whose own shard has only light baskets.
-                if shard is not None:
-                    max_w = shard.max_weight
-                else:
-                    max_w = (
-                        int(data.weights.max()) if data.total_count else 1
-                    )
-                n_digits = 1
-                while 128**n_digits <= max_w:
-                    n_digits += 1
-                if n_digits > MAX_DIGITS:
-                    use_pallas = False
-                if pad_axis(f + 1, cfg.item_tile) > 2048:
-                    use_pallas = False
             # Per-device rows are padded to the LARGEST shard in sharded
             # mode, so size the scan chunking from that (an n_chunks
             # derived from the even global split would under-chunk and
@@ -729,14 +739,11 @@ class FastApriori:
             else:
                 per_dev = -(-total // ctx.txn_shards)
             n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
-            fast_f32 = self._fast_f32(use_pallas, data.n_raw)
+            fast_f32 = self._fast_f32(data.n_raw)
             if shard is None:
                 txn_multiple = (
                     max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
                 )
-                if use_pallas:
-                    n_chunks = 1
-                    txn_multiple = T_TILE * ctx.txn_shards
                 packed_np, f_pad = build_packed_bitmap_csr(
                     data.basket_indices,
                     data.basket_offsets,
@@ -771,9 +778,6 @@ class FastApriori:
                 local_multiple = (
                     max(cfg.txn_tile, 32) * local_devices * n_chunks
                 )
-                if use_pallas:
-                    n_chunks = 1
-                    local_multiple = T_TILE * local_devices
                 local_pad = max(
                     pad_axis(c, local_multiple) for c in shard.local_counts
                 )
@@ -799,27 +803,22 @@ class FastApriori:
             m.update(
                 shape=[t_pad, f_pad],
                 digits=len(scales),
-                pallas=use_pallas,
                 fast_f32=fast_f32,
                 upload_bytes=packed_np.nbytes + w_digits_np.nbytes,
             )
         return self._level_loop(
-            data, resume, bitmap, w_digits, scales, n_chunks, use_pallas,
+            data, resume, bitmap, w_digits, scales, n_chunks,
             fast_f32, t_pad,
         )
 
-    def _fast_f32(self, use_pallas: bool, n_raw: int) -> bool:
+    def _fast_f32(self, n_raw: int) -> bool:
         """CPU backends: ONE f32 matmul per phase (BLAS) instead of D
         int8 matmuls — XLA-CPU integer matmuls are orders slower.  Exact
         while every count < 2^24 (counts are bounded by the raw
         transaction total); TPU always keeps the int8 MXU path.  One
         definition for both ingest modes — the kernel choice must never
         depend on how the bitmap reached the device."""
-        return (
-            self.context.platform == "cpu"
-            and not use_pallas
-            and n_raw < 2**24
-        )
+        return self.context.platform == "cpu" and n_raw < 2**24
 
     def _level_loop(
         self,
@@ -829,7 +828,6 @@ class FastApriori:
         w_digits,
         scales,
         n_chunks: int,
-        use_pallas: bool,
         fast_f32: bool,
         t_pad: int,
     ) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -897,7 +895,6 @@ class FastApriori:
                     gen_candidates_stream(cur),
                     min_count,
                     n_chunks,
-                    use_pallas,
                     fast_f32,
                 )
                 m.update(frequent=nxt.shape[0], **lvl_stats)
@@ -916,7 +913,6 @@ class FastApriori:
         cand_blocks,
         min_count: int,
         n_chunks: int,
-        use_pallas: bool = False,
         fast_f32: bool = False,
     ) -> Tuple[np.ndarray, np.ndarray, dict]:
         """C8 for one level, transfer-minimal: greedy chunks of at most
@@ -989,11 +985,6 @@ class FastApriori:
                 ),
                 max(cfg.level_prefix_cap // n_cs, 1),
             )
-            if use_pallas:
-                from fastapriori_tpu.ops.pallas_level import M_TILE
-
-                # Per-shard prefix rows must be whole M tiles.
-                p_sh = -(-max(p_sh, M_TILE) // M_TILE) * M_TILE
             p_cap = p_sh * n_cs
             # Candidate budget right-sized the same way: the [C_cap]
             # cand_idx upload and result fetch are per-dispatch fixed
@@ -1041,21 +1032,16 @@ class FastApriori:
                     )
                     placed.append((ci, sh * c_sh, n_c))
                     start = end
-                if use_pallas:
-                    out = ctx.level_gather_pallas(
-                        bitmap, w_digits, prefix_cols, s, cand_idx
-                    )
-                else:
-                    out = ctx.level_gather(
-                        bitmap,
-                        w_digits,
-                        scales,
-                        prefix_cols,
-                        s,
-                        cand_idx,
-                        n_chunks,
-                        fast_f32,
-                    )
+                out = ctx.level_gather(
+                    bitmap,
+                    w_digits,
+                    scales,
+                    prefix_cols,
+                    s,
+                    cand_idx,
+                    n_chunks,
+                    fast_f32,
+                )
                 try:
                     out.copy_to_host_async()
                 except (AttributeError, NotImplementedError):

@@ -1,6 +1,15 @@
 """Pallas TPU kernel for the hot op: fused prefix-containment + weighted
 extension counting (reference C8's hot loops, FastApriori.scala:143-152).
 
+STATUS: reference kernel, not wired into the mining engine.  Proven
+Mosaic-compiled and bit-exact on real v5e (tests_tpu/test_pallas_hw.py),
+but at production webdocs shapes it measured device-time parity with the
+XLA formulation (both ~35 ms at [T=1.66M, P=4096, F=256, D=2] — round 3,
+dependency-chained timing), so the engine keeps the single XLA path
+(ops/count.py local_level_gather) and this stays as the VMEM-resident
+formulation for future wider-item workloads where XLA's [tc, P]
+intermediates would dominate.
+
 The XLA version (ops/fused.py) materializes ``common = (B Sᵀ == k-1)`` —
 a [T, M] int8 intermediate — in HBM and reads it back for the counting
 matmul.  This kernel keeps each ``common`` tile in VMEM: one grid step

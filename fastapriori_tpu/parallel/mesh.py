@@ -466,63 +466,6 @@ class DeviceContext:
             cand_idx,
         )
 
-    def level_gather_pallas(
-        self,
-        bitmap,
-        w_digits,
-        prefix_cols,
-        k1: int,
-        cand_idx,
-    ) -> jax.Array:
-        """Pallas variant of :meth:`level_gather` (ops/pallas_level.py):
-        prefixes arrive as the same compact [P, K] column-index matrix the
-        XLA path uploads (a dense one-hot would be megabytes per chunk on
-        the tunnel) and are scattered to the kernel's one-hot [P, F] form
-        on device; the fused containment+counting kernel keeps the [T, P]
-        ``common`` intermediate in VMEM.  Interpreted on CPU backends
-        (tests), compiled on TPU.  Padding positions/rows point at the
-        all-zero bitmap column, so a real row's zcol bit contributes 0 to
-        any overlap and a padded row (zcol-only) matches nothing."""
-        key = ("level_gather_pallas",)
-        if key not in self._fns:
-            from fastapriori_tpu.ops.pallas_level import level_counts_pallas
-
-            mesh = self.mesh
-            interpret = self.platform == "cpu"
-
-            def _local(bitmap, w_digits, prefix_cols, k1, cand_idx):
-                from fastapriori_tpu.ops.bitmap import scatter_one_hot
-
-                s_mat = scatter_one_hot(prefix_cols, bitmap.shape[1])
-                counts = level_counts_pallas(
-                    bitmap, w_digits, s_mat, k1, interpret=interpret
-                )
-                local = jnp.take(counts.reshape(-1), cand_idx)
-                return jax.lax.psum(local, AXIS)
-
-            # check_vma=False: varying-axis metadata cannot propagate
-            # through the pallas_call's mixed-axis dots (bitmap varies
-            # over txn, s_mat over cand); the psum over txn establishes
-            # the out_specs invariance manually.
-            self._fns[key] = jax.jit(
-                jax.shard_map(
-                    _local,
-                    mesh=mesh,
-                    in_specs=(
-                        P(AXIS, None),
-                        P(None, AXIS),
-                        P(CAND, None),
-                        P(),
-                        P(CAND),
-                    ),
-                    out_specs=P(CAND),
-                    check_vma=False,
-                )
-            )
-        return self._fns[key](
-            bitmap, w_digits, prefix_cols, jnp.int32(k1), cand_idx
-        )
-
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
         pair, _, _ = self._get_fns(tuple(scales))
         return pair(bitmap, w_digits)

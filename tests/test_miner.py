@@ -118,8 +118,11 @@ from fastapriori_tpu.native import native_available
 @pytest.mark.skipif(
     not native_available(), reason="native extension not built"
 )
-@pytest.mark.parametrize("seed,blocks", [(3, 2), (5, 4), (9, 8), (11, 3)])
-def test_pipelined_ingest_matches_plain(tmp_path, seed, blocks):
+@pytest.mark.parametrize(
+    "seed,blocks,threads",
+    [(3, 2, 1), (5, 4, 3), (9, 8, 2), (11, 3, None)],
+)
+def test_pipelined_ingest_matches_plain(tmp_path, seed, blocks, threads):
     """The pipelined single-host ingest (per-block compress + async
     upload, models/apriori.py _run_file_pipelined) must produce level
     matrices and global tables BIT-EXACT vs the plain path — cross-block
@@ -141,7 +144,8 @@ def test_pipelined_ingest_matches_plain(tmp_path, seed, blocks):
 
     ctx = DeviceContext(num_devices=1)
     cfg_pipe = MinerConfig(
-        min_support=0.05, engine="level", ingest_pipeline_blocks=blocks
+        min_support=0.05, engine="level", ingest_pipeline_blocks=blocks,
+        ingest_threads=threads,
     )
     cfg_plain = MinerConfig(
         min_support=0.05, engine="level", ingest_pipeline_blocks=1

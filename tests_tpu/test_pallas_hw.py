@@ -75,28 +75,6 @@ def test_pallas_level_counts_compiled_on_tpu(k, max_w, n_digits):
     assert (got == expected).all()
 
 
-def test_level_engine_pallas_wired_path_on_tpu():
-    """End-to-end mining with MinerConfig.level_use_pallas on the chip
-    (mesh.py level_gather_pallas picks interpret=False off-CPU)."""
-    _require_accelerator()
-    from fastapriori_tpu import oracle
-    from fastapriori_tpu.config import MinerConfig
-    from fastapriori_tpu.models.apriori import FastApriori
-
-    rng = np.random.default_rng(17)
-    lines = [
-        [str(x) for x in rng.choice(60, size=rng.integers(2, 13), replace=False)]
-        for _ in range(5000)
-    ]
-    expected, _, _ = oracle.mine(lines, 0.02)
-    got, _, _ = FastApriori(
-        config=MinerConfig(
-            min_support=0.02, engine="level", level_use_pallas=True
-        )
-    ).run(lines)
-    assert dict(got) == dict(expected)
-
-
 @pytest.mark.parametrize("engine", ["fused", "level"])
 def test_engines_on_chip_match_oracle(engine):
     """Both mining engines end-to-end on the real accelerator vs the
