@@ -326,21 +326,28 @@ def _north_star_attach(args, platform) -> dict:
         return {}
     try:
         n_txns, n_items, avg_len, min_support, style = CONFIGS["webdocs"]
-        # Cache keyed by the generating parameters — a differently-seeded
-        # or resized run must not silently mine a stale file.
-        cache = f"/tmp/webdocs_bench_s{args.seed}_n{n_txns}.dat"
+        # Cache keyed by ALL generating parameters — a differently-seeded
+        # or reshaped config must not silently mine a stale file.
+        cache = (
+            f"/tmp/webdocs_bench_s{args.seed}_n{n_txns}_i{n_items}"
+            f"_l{avg_len}_{style}.dat"
+        )
         if not os.path.exists(cache):
             t0 = time.perf_counter()
             import argparse as _ap
+            import tempfile
 
             wd_args = _ap.Namespace(
                 n_txns=n_txns, n_items=n_items, avg_len=avg_len,
                 seed=args.seed, style=style,
             )
             raw = gen_lines(wd_args)
-            with open(cache + ".tmp", "w") as fh:
+            # Unique temp file + atomic publish: concurrent bench runs
+            # must not interleave writes into one .tmp path.
+            fd, tmp = tempfile.mkstemp(dir="/tmp", suffix=".dat")
+            with os.fdopen(fd, "w") as fh:
                 fh.write("\n".join(raw) + "\n")
-            os.replace(cache + ".tmp", cache)
+            os.replace(tmp, cache)
             del raw
             print(
                 f"north-star datagen [webdocs]: {n_txns} txns in "

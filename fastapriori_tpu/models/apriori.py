@@ -259,9 +259,14 @@ class FastApriori:
             # and the tiny per-range token tables merge on the main
             # thread — the single-host analog of the multi-host sharded
             # ingest's count merge, with the same correctness argument.
+            # More ranges than threads, so in-flight block copies are
+            # bounded by the POOL size, not the range count (equal counts
+            # would put slices covering the whole file in memory at once).
             p1_ranges = [
                 r
-                for r in split_buffer_ranges(buf, max(n_threads, 1))
+                for r in split_buffer_ranges(
+                    buf, n_threads * 4 if n_threads > 1 else 1
+                )
                 if r[1] > r[0]
             ]
             if len(p1_ranges) > 1:
@@ -359,29 +364,29 @@ class FastApriori:
                     blocks.append((bi, bo, bw))
                 if not blocks:
                     return [], empty_data()
+                # Host-side assembly (weights, CSR for API parity) runs
+                # BEFORE the upload-tail wait so it hides under the last
+                # blocks' transfers.
+                total = sum(len(bw) for _, _, bw in blocks)
+                t_pad = pad_axis(total, txn_multiple)
+                w_np = np.concatenate([bw for _, _, bw in blocks])
+                w_digits_np, scales = weight_digits(w_np, t_pad)
+                indices = np.concatenate([bi for bi, _, _ in blocks])
+                offs = [np.zeros(1, dtype=np.int64)]
+                base = 0
+                for _, bo, _ in blocks:
+                    offs.append(bo[1:].astype(np.int64) + base)
+                    base += int(bo[-1])
+                offsets = np.concatenate(offs)
                 dev_blocks = [fu.result() for fu in dev_futures]
 
-            total = sum(len(bw) for _, _, bw in blocks)
-            t_pad = pad_axis(total, txn_multiple)
             parts = dev_blocks
             if t_pad > total:
                 parts = parts + [
                     jnp.zeros((t_pad - total, f_pad // 8), dtype=jnp.uint8)
                 ]
             bitmap = ctx._unpack_fn()(jnp.concatenate(parts, axis=0))
-
-            # Host-side assembly (weights, CSR for API parity) overlaps
-            # the tail of the transfers.
-            w_np = np.concatenate([bw for _, _, bw in blocks])
-            w_digits_np, scales = weight_digits(w_np, t_pad)
             w_digits = ctx.shard_weight_digits(w_digits_np)
-            indices = np.concatenate([bi for bi, _, _ in blocks])
-            offs = [np.zeros(1, dtype=np.int64)]
-            base = 0
-            for _, bo, _ in blocks:
-                offs.append(bo[1:].astype(np.int64) + base)
-                base += int(bo[-1])
-            offsets = np.concatenate(offs)
             m.update(
                 shape=[t_pad, f_pad],
                 digits=len(scales),
@@ -998,6 +1003,9 @@ class FastApriori:
                 c_cap_max,
             )
             c_cap = c_sh * n_cs
+            pcs = []  # per-block-chunk compact prefix tables
+            cis = []  # per-block-chunk flat candidate indexes
+            placed_all = []  # per-block-chunk placement lists
             start = 0  # index into uniq_x
             while start < uniq_x.size:
                 prefix_cols = np.full((p_cap, k_pad), zcol, dtype=cols_dt)
@@ -1032,27 +1040,43 @@ class FastApriori:
                     )
                     placed.append((ci, sh * c_sh, n_c))
                     start = end
-                out = ctx.level_gather(
-                    bitmap,
-                    w_digits,
-                    scales,
-                    prefix_cols,
-                    s,
-                    cand_idx,
-                    n_chunks,
-                    fast_f32,
-                )
-                try:
-                    out.copy_to_host_async()
-                except (AttributeError, NotImplementedError):
-                    pass
-                inflight.append((placed, out, counts_blk))
-                # Per-dispatch cost model (metrics/MFU): membership matmul
-                # [T, P_cap] + counting matmuls [P_cap, F] over padded
-                # global shapes; psum reduces the [C_cap] gather.
-                stats["dispatches"] += 1
-                stats["macs"] += (1 + d_eff) * t_pad * p_cap * f_pad
-                stats["psum_bytes"] += 4 * c_cap
+                pcs.append(prefix_cols)
+                cis.append(cand_idx)
+                placed_all.append(placed)
+            # ONE launch for the whole generator block: launches carry
+            # ~100+ ms of fixed round-trip cost on tunneled backends (the
+            # runtime does not pipeline them), so the chunks ride a
+            # device-side scan instead of separate dispatches.  The block
+            # axis pads to a power of two (same bucketing rationale as
+            # p_cap/c_cap: one compile per bucket, not per distinct NB);
+            # dummy chunks are all-zcol prefixes whose counts nothing
+            # reads (`placed` covers only real chunks).
+            nb = len(pcs)
+            for _ in range(_next_pow2(nb) - nb):
+                pcs.append(np.full((p_cap, k_pad), zcol, dtype=cols_dt))
+                cis.append(np.zeros(c_cap, dtype=np.int32))
+            out = ctx.level_gather_batch(
+                bitmap,
+                w_digits,
+                scales,
+                np.stack(pcs),
+                s,
+                np.stack(cis),
+                n_chunks,
+                fast_f32,
+            )
+            try:
+                out.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass
+            inflight.append((placed_all, out, counts_blk))
+            # Per-launch cost model (metrics/MFU): membership matmul
+            # [T, P_cap] + counting matmuls [P_cap, F] over padded
+            # global shapes per scanned chunk; psum reduces each
+            # [C_cap] gather.
+            stats["dispatches"] += 1
+            stats["macs"] += nb * (1 + d_eff) * t_pad * p_cap * f_pad
+            stats["psum_bytes"] += nb * 4 * c_cap
         empty = (
             np.empty((0, s + 1), dtype=np.int32),
             np.empty(0, dtype=np.int64),
@@ -1060,12 +1084,13 @@ class FastApriori:
         )
         if not blocks:
             return empty
-        # Collect: every dispatch is already in flight, so these waits
+        # Collect: every launch is already in flight, so these waits
         # overlap each other and any remaining device work.
-        for placed, out, counts_blk in inflight:
-            arr = np.asarray(out)
-            for ci, off, n_c in placed:
-                counts_blk[ci] = arr[off : off + n_c]
+        for placed_all, out, counts_blk in inflight:
+            arr = np.asarray(out)  # [NB, C]
+            for bi, placed in enumerate(placed_all):
+                for ci, off, n_c in placed:
+                    counts_blk[ci] = arr[bi, off : off + n_c]
         x_idx = np.concatenate([b[0] for b in blocks])
         ys = np.concatenate([b[1] for b in blocks])
         counts_all = np.concatenate([b[2] for b in blocks])

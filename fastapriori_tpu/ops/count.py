@@ -257,6 +257,45 @@ def local_level_gather(
     return _psum_if(local, axis_name)
 
 
+def local_level_gather_batch(
+    bitmap: jnp.ndarray,  # [T_local, F] int8
+    w_digits: jnp.ndarray,  # [D, T_local] int8
+    scales: Sequence[int],
+    prefix_stack: jnp.ndarray,  # [NB, P, K] compact prefix blocks
+    k1: jnp.ndarray,  # () int32 (traced)
+    cand_stack: jnp.ndarray,  # [NB, C] flat candidate indexes per block
+    n_chunks: int,
+    axis_name: Optional[str] = None,
+    cand_axis_name: Optional[str] = None,
+    fast_f32: bool = False,
+) -> jnp.ndarray:
+    """A whole level's prefix blocks in ONE launch: ``lax.scan`` over the
+    stacked blocks, each step = :func:`local_level_gather`.  Kernel
+    launches carry a large fixed cost on remote/tunneled backends (the
+    runtime round-trips per launch instead of pipelining), so a level
+    with NB blocks pays it once instead of NB times.  Returns
+    ``[NB, C]`` gathered candidate counts."""
+
+    def step(carry, xs):
+        pc, ci = xs
+        out = local_level_gather(
+            bitmap,
+            w_digits,
+            scales,
+            pc,
+            k1,
+            ci,
+            n_chunks,
+            axis_name=axis_name,
+            cand_axis_name=cand_axis_name,
+            fast_f32=fast_f32,
+        )
+        return carry, out
+
+    _, outs = lax.scan(step, jnp.int32(0), (prefix_stack, cand_stack))
+    return outs
+
+
 def local_item_supports(
     bitmap: jnp.ndarray,  # [T_local, F] int8
     w_digits: jnp.ndarray,  # [D, T_local] int8

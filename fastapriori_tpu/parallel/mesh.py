@@ -406,33 +406,34 @@ class DeviceContext:
             bitmap, w_digits, jnp.int32(min_count), jnp.int32(num_items)
         )
 
-    def level_gather(
+    def level_gather_batch(
         self,
         bitmap,
         w_digits,
         scales,
-        prefix_cols,
+        prefix_stack,
         k1: int,
-        cand_idx,
+        cand_stack,
         n_chunks: int,
         fast_f32: bool = False,
     ) -> jax.Array:
-        """Transfer-minimal level kernel (ops/count.py
-        local_level_gather): one compilation serves every level — k1 is
-        traced and prefix_cols has a fixed padded width."""
-        key = ("level_gather", tuple(scales), n_chunks, fast_f32)
+        """A whole level's blocks in one launch (ops/count.py
+        local_level_gather_batch) — launches carry ~100 ms of fixed
+        round-trip cost on tunneled backends, so NB blocks pay it once.
+        Returns ``[NB, C]`` gathered counts."""
+        key = ("level_gather_batch", tuple(scales), n_chunks, fast_f32)
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
 
-            def _local(bitmap, w_digits, prefix_cols, k1, cand_idx):
-                return count_ops.local_level_gather(
+            def _local(bitmap, w_digits, prefix_stack, k1, cand_stack):
+                return count_ops.local_level_gather_batch(
                     bitmap,
                     w_digits,
                     scl,
-                    prefix_cols,
+                    prefix_stack,
                     k1,
-                    cand_idx,
+                    cand_stack,
                     n_chunks,
                     axis_name=AXIS,
                     cand_axis_name=CAND,
@@ -443,27 +444,25 @@ class DeviceContext:
                 jax.shard_map(
                     _local,
                     mesh=mesh,
-                    # Prefix rows and the candidate gather are sharded
-                    # over the cand axis (each cand shard counts its own
-                    # slice of the level's candidates over its txn rows);
-                    # with cand_shards == 1 this degenerates to the plain
-                    # transaction mesh.
+                    # Same layout as level_gather with a leading block
+                    # axis: prefix rows and the candidate gather sharded
+                    # over cand, blocks unsharded (scanned on device).
                     in_specs=(
                         P(AXIS, None),
                         P(None, AXIS),
-                        P(CAND, None),
+                        P(None, CAND, None),
                         P(),
-                        P(CAND),
+                        P(None, CAND),
                     ),
-                    out_specs=P(CAND),
+                    out_specs=P(None, CAND),
                 )
             )
         return self._fns[key](
             bitmap,
             w_digits,
-            prefix_cols,
+            prefix_stack,
             jnp.int32(k1),
-            cand_idx,
+            cand_stack,
         )
 
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:

@@ -199,3 +199,27 @@ def test_split_buffer_ranges_matches_read_shard(tmp_path):
             parts = [data[lo:hi] for lo, hi in ranges]
             shards = [read_shard(str(path), i, n) for i in range(n)]
             assert parts == shards
+
+
+@pytest.mark.parametrize("n_devices,cand", [(1, 1), (8, 2)])
+def test_multi_chunk_batched_level_launch(n_devices, cand):
+    """NB>1 in the batched level launch (several prefix chunks scanned
+    inside one dispatch, models/apriori.py _count_level): tiny caps force
+    many chunks per level — stacking, the device-side scan, the pow-2
+    block padding, and the per-block collect indexing must all stay
+    bit-exact vs the oracle on 1-D and 2-D meshes."""
+    from conftest import random_dataset, tokenized
+    from fastapriori_tpu import oracle
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.models.apriori import FastApriori
+
+    lines = tokenized(random_dataset(29, n_txns=180, n_items=16, max_len=8))
+    expected, _, _ = oracle.mine(lines, 0.05)
+    got, _, _ = FastApriori(
+        config=MinerConfig(
+            min_support=0.05, engine="level", level_prefix_cap=4,
+            min_prefix_bucket=1, level_cand_cap=8,
+            num_devices=n_devices, cand_devices=cand,
+        )
+    ).run(lines)
+    assert dict(got) == dict(expected)
