@@ -251,6 +251,20 @@ class FastApriori:
         from concurrent.futures import ThreadPoolExecutor
 
         n_threads = cfg.ingest_threads or os.cpu_count() or 1
+        if n_threads == 1:
+            from fastapriori_tpu.native.loader import (
+                has_preprocess_buffer_blocks,
+            )
+
+            if has_preprocess_buffer_blocks():
+                # Single-threaded hosts take the capture-replay form: ONE
+                # native call does pass 1 (recording parsed token ids),
+                # rank assignment, and per-block pass-2 id replay — the
+                # raw bytes are tokenized exactly once (the threaded path
+                # below re-tokenizes each block in exchange for real
+                # multi-core parallelism, a good trade only when cores
+                # exist).
+                return self._run_file_pipelined_capture(d_path)
         with self.metrics.timed("preprocess", path=d_path) as m:
             with open(d_path, "rb") as fh:
                 buf = fh.read()
@@ -297,15 +311,8 @@ class FastApriori:
             )
 
         def empty_data():
-            return CompressedData(
-                n_raw=n_raw,
-                min_count=min_count,
-                freq_items=freq_items,
-                item_to_rank=item_to_rank,
-                item_counts=item_counts,
-                basket_indices=np.empty(0, np.int32),
-                basket_offsets=np.zeros(1, np.int64),
-                weights=np.empty(0, np.int32),
+            return self._empty_compressed(
+                n_raw, min_count, freq_items, item_to_rank, item_counts
             )
 
         if f < 2:
@@ -367,25 +374,13 @@ class FastApriori:
                 # Host-side assembly (weights, CSR for API parity) runs
                 # BEFORE the upload-tail wait so it hides under the last
                 # blocks' transfers.
-                total = sum(len(bw) for _, _, bw in blocks)
-                t_pad = pad_axis(total, txn_multiple)
-                w_np = np.concatenate([bw for _, _, bw in blocks])
-                w_digits_np, scales = weight_digits(w_np, t_pad)
-                indices = np.concatenate([bi for bi, _, _ in blocks])
-                offs = [np.zeros(1, dtype=np.int64)]
-                base = 0
-                for _, bo, _ in blocks:
-                    offs.append(bo[1:].astype(np.int64) + base)
-                    base += int(bo[-1])
-                offsets = np.concatenate(offs)
+                asm = self._assemble_blocks(blocks, txn_multiple)
                 dev_blocks = [fu.result() for fu in dev_futures]
 
-            parts = dev_blocks
-            if t_pad > total:
-                parts = parts + [
-                    jnp.zeros((t_pad - total, f_pad // 8), dtype=jnp.uint8)
-                ]
-            bitmap = ctx._unpack_fn()(jnp.concatenate(parts, axis=0))
+            total, t_pad, w_np, w_digits_np, scales, indices, offsets = asm
+            bitmap = self._device_concat_unpack(
+                dev_blocks, total, t_pad, f_pad
+            )
             w_digits = ctx.shard_weight_digits(w_digits_np)
             m.update(
                 shape=[t_pad, f_pad],
@@ -393,6 +388,153 @@ class FastApriori:
                 blocks=len(blocks),
                 upload_bytes=upload_bytes + w_digits_np.nbytes,
             )
+
+        data = CompressedData(
+            n_raw=n_raw,
+            min_count=min_count,
+            freq_items=freq_items,
+            item_to_rank=item_to_rank,
+            item_counts=item_counts,
+            basket_indices=indices,
+            basket_offsets=offsets,
+            weights=w_np,
+        )
+        levels = self._mine_levels(
+            data,
+            preupload=(bitmap, w_digits, scales, n_chunks, t_pad, f_pad),
+        )
+        return levels, data
+
+    @staticmethod
+    def _empty_compressed(
+        n_raw, min_count, freq_items, item_to_rank, item_counts
+    ) -> CompressedData:
+        """Global tables with zero baskets (degenerate ingest outcomes —
+        no frequent items, or every basket of size <= 1)."""
+        return CompressedData(
+            n_raw=n_raw,
+            min_count=min_count,
+            freq_items=freq_items,
+            item_to_rank=item_to_rank,
+            item_counts=item_counts,
+            basket_indices=np.empty(0, np.int32),
+            basket_offsets=np.zeros(1, np.int64),
+            weights=np.empty(0, np.int32),
+        )
+
+    @staticmethod
+    def _assemble_blocks(blocks, txn_multiple: int):
+        """Host-side assembly of per-block CSRs: concatenated weights +
+        weight digits + the global CSR (API parity).  Shared by both
+        pipelined ingest flavors; runs while the upload tail drains."""
+        from fastapriori_tpu.ops.bitmap import pad_axis
+
+        total = sum(len(bw) for _, _, bw in blocks)
+        t_pad = pad_axis(total, txn_multiple)
+        w_np = np.concatenate([bw for _, _, bw in blocks])
+        w_digits_np, scales = weight_digits(w_np, t_pad)
+        indices = np.concatenate([bi for bi, _, _ in blocks])
+        offs = [np.zeros(1, dtype=np.int64)]
+        base = 0
+        for _, bo, _ in blocks:
+            offs.append(bo[1:].astype(np.int64) + base)
+            base += int(bo[-1])
+        offsets = np.concatenate(offs)
+        return total, t_pad, w_np, w_digits_np, scales, indices, offsets
+
+    def _device_concat_unpack(self, dev_blocks, total, t_pad, f_pad):
+        """Concat uploaded packed blocks on device, pad the tail rows,
+        unpack to the resident int8 bitmap."""
+        import jax.numpy as jnp
+
+        parts = dev_blocks
+        if t_pad > total:
+            parts = parts + [
+                jnp.zeros((t_pad - total, f_pad // 8), dtype=jnp.uint8)
+            ]
+        return self.context._unpack_fn()(jnp.concatenate(parts, axis=0))
+
+    def _run_file_pipelined_capture(
+        self, d_path: str
+    ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], CompressedData]:
+        """Capture-replay pipelined ingest: one native call runs pass 1
+        (capturing parsed token ids), rank assignment, and per-block
+        pass-2 replay (native/preprocess.cc fa_preprocess_buffer_blocks
+        — the raw bytes are tokenized exactly ONCE); each block's CSR
+        arrives through a callback mid-call and its packed bitmap is
+        submitted to the upload worker immediately, so transfers ride
+        the link while the native side compresses the next block."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from fastapriori_tpu.native.loader import preprocess_buffer_blocks
+
+        cfg = self.config
+        ctx = self.context
+        dev = ctx.mesh.devices.flat[0]
+        blocks = []
+        dev_futures = []
+        state = {"f_pad": None, "upload_bytes": 0}
+        upool = ThreadPoolExecutor(max_workers=1)
+        try:
+            with self.metrics.timed("preprocess", path=d_path) as m:
+                with open(d_path, "rb") as fh:
+                    buf = fh.read()
+
+                def on_block(f_, offsets, items, weights):
+                    pk, f_pad = build_packed_bitmap_csr(
+                        items, offsets, f_, 1, cfg.item_tile
+                    )
+                    state["f_pad"] = f_pad
+                    state["upload_bytes"] += pk.nbytes
+                    dev_futures.append(
+                        upool.submit(jax.device_put, pk, dev)
+                    )
+                    blocks.append((items, offsets, weights))
+
+                n_raw, min_count, freq_items, item_counts = (
+                    preprocess_buffer_blocks(
+                        buf,
+                        cfg.min_support,
+                        max(cfg.ingest_pipeline_blocks, 1),
+                        on_block,
+                    )
+                )
+                item_to_rank = {t: r for r, t in enumerate(freq_items)}
+                f = len(freq_items)
+                m.update(
+                    n_raw=n_raw, min_count=min_count, num_items=f,
+                    pipelined=True, capture=True,
+                )
+            if f < 2 or not blocks:
+                return [], self._empty_compressed(
+                    n_raw, min_count, freq_items, item_to_rank, item_counts
+                )
+            # Same phase accounting as the threaded path: assembly, the
+            # upload-tail wait, and the device concat/unpack book under
+            # bitmap_build (the native call above is preprocess).
+            n_chunks = max(1, -(-n_raw // cfg.level_txn_chunk))
+            with self.metrics.timed("bitmap_build") as m:
+                asm = self._assemble_blocks(
+                    blocks, max(cfg.txn_tile, 32) * n_chunks
+                )
+                dev_blocks = [fu.result() for fu in dev_futures]
+                total, t_pad, w_np, w_digits_np, scales, indices, offsets = (
+                    asm
+                )
+                f_pad = state["f_pad"]
+                bitmap = self._device_concat_unpack(
+                    dev_blocks, total, t_pad, f_pad
+                )
+                w_digits = ctx.shard_weight_digits(w_digits_np)
+                m.update(
+                    shape=[t_pad, f_pad],
+                    digits=len(scales),
+                    blocks=len(blocks),
+                    upload_bytes=state["upload_bytes"]
+                    + w_digits_np.nbytes,
+                )
+        finally:
+            upool.shutdown()
 
         data = CompressedData(
             n_raw=n_raw,

@@ -97,6 +97,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int32,
         ]
+    blocks_fn = getattr(lib, "fa_preprocess_buffer_blocks", None)
+    if blocks_fn is not None:
+        blocks_fn.restype = ctypes.POINTER(_FaResult)
+        blocks_fn.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.c_int32,
+            _FA_BLOCK_CB,
+            ctypes.c_void_p,
+        ]
     cand = getattr(lib, "fa_gen_candidates", None)
     if cand is not None:
         cand.restype = ctypes.POINTER(_FaCandidates)
@@ -120,6 +131,18 @@ NativeResult = Tuple[
     np.ndarray,  # basket_offsets int64[T'+1]
     np.ndarray,  # weights int32[T']
 ]
+
+
+# void cb(ctx, f, n_baskets, offsets*, items*, weights*)
+_FA_BLOCK_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p,
+    ctypes.c_int32,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32),
+)
 
 
 class _FaCandidates(ctypes.Structure):
@@ -314,6 +337,75 @@ def fill_packed_bitmap(
 def preprocess_file(path: str, min_support: float) -> NativeResult:
     with open(path, "rb") as fh:
         return preprocess_buffer(fh.read(), min_support)
+
+
+def has_preprocess_buffer_blocks() -> bool:
+    lib = get_lib()
+    return (
+        lib is not None
+        and getattr(lib, "fa_preprocess_buffer_blocks", None) is not None
+    )
+
+
+def preprocess_buffer_blocks(
+    data: bytes, min_support: float, n_blocks: int, on_block
+):
+    """Capture-replay pipelined preprocessing: pass 1 + rank assignment +
+    per-block pass-2 id replay in ONE native call (the raw bytes are
+    tokenized exactly once).  ``on_block(f, offsets int64[t+1],
+    items int32[nnz], weights int32[t])`` fires per block mid-call with
+    COPIES the callee owns.  Returns the global tables
+    ``(n_raw, min_count, freq_items, item_counts)``."""
+    lib = get_lib()
+    if lib is None or getattr(lib, "fa_preprocess_buffer_blocks", None) is None:
+        raise RuntimeError(
+            "native block-preprocess entry point unavailable; rebuild "
+            "with `make -C fastapriori_tpu/native`"
+        )
+    errs: list = []
+
+    @_FA_BLOCK_CB
+    def cb(_ctx, f, t, offs_p, items_p, w_p):
+        try:
+            t = int(t)
+            offsets = np.ctypeslib.as_array(offs_p, shape=(t + 1,)).copy()
+            nnz = int(offsets[-1])
+            items = np.ctypeslib.as_array(items_p, shape=(max(nnz, 1),))[
+                :nnz
+            ].copy()
+            weights = np.ctypeslib.as_array(w_p, shape=(max(t, 1),))[
+                :t
+            ].copy()
+            on_block(int(f), offsets, items, weights)
+        except BaseException as e:  # never unwind through the C frame
+            errs.append(e)
+
+    res_ptr = lib.fa_preprocess_buffer_blocks(
+        data, len(data), ctypes.c_double(min_support), n_blocks, cb, None
+    )
+    if not res_ptr:
+        if errs:
+            raise errs[0]
+        raise MemoryError("fa_preprocess_buffer_blocks failed")
+    try:
+        # A callback error still frees the native result (finally below).
+        if errs:
+            raise errs[0]
+        res = res_ptr.contents
+        f = int(res.n_items)
+        items_raw = ctypes.string_at(res.items_buf, res.items_buf_len)
+        freq_items = (
+            items_raw.decode("utf-8").split("\n") if res.items_buf_len else []
+        )
+        if f == 0:
+            freq_items = []
+        assert len(freq_items) == f, (len(freq_items), f)
+        item_counts = np.ctypeslib.as_array(
+            res.item_counts, shape=(max(f, 1),)
+        )[:f].copy()
+        return int(res.n_raw), int(res.min_count), freq_items, item_counts
+    finally:
+        lib.fa_free_result(res_ptr)
 
 
 def gen_candidates_native(level: np.ndarray):

@@ -383,6 +383,168 @@ bool marshal_baskets(BasketDeduper& dd, FaResult* res) {
 
 }  // namespace
 
+
+namespace {
+
+// ---- shared pass-1 capture + rank assignment ------------------------
+// ONE copy for both whole-buffer entry points (fa_preprocess_buffer and
+// fa_preprocess_buffer_blocks); the sharded fa_count_buffer /
+// fa_compress_with_ranks pair keeps its own split-phase contract.
+//
+// Pass 1: dense array for canonical small-integer tokens (the
+// overwhelmingly common case), string hash map for everything else
+// (calloc pages lazily, so untouched id ranges cost no physical
+// memory).  Every token is also recorded once in parsed form
+// (``tok_ids``, line-major with ``tok_offsets`` line boundaries): a
+// dense id >= 0, or ``-(side_index+1)`` for non-dense tokens.  Pass 2
+// then never touches the raw bytes again — on a 1 GB file a second
+// tokenize+parse scan was half the preprocessing cost; the parsed form
+// replays at memory bandwidth.
+
+struct FreqItem {
+  std::string_view tok;
+  int64_t count;
+  bool numeric;
+  BigInt value;
+};
+
+struct Pass1Capture {
+  int64_t n_raw = 0;
+  int64_t min_count = 0;
+  int32_t f = 0;
+  std::vector<int32_t> tok_ids;      // dense id >= 0, or -(side_index+1)
+  std::vector<int64_t> tok_offsets;  // [n_raw+1] line boundaries
+  std::vector<FreqItem> freq;        // rank order
+  int32_t* dense_rank = nullptr;     // rank+1 by dense id (may be null)
+  std::vector<int32_t> side_rank;    // rank+1 by side index
+  // Backing storage freq's string_views may point into:
+  std::unordered_map<std::string_view, std::pair<int64_t, int32_t>> counts;
+  std::deque<std::string> dense_tok_arena;
+
+  ~Pass1Capture() { std::free(dense_rank); }
+
+  inline int32_t rank_plus_1(int32_t id) const {
+    return id >= 0 ? dense_rank[id] : side_rank[-id - 1];
+  }
+
+  // False on allocation failure.
+  bool run(std::string_view buf, double min_support, PhaseTimer& timer) {
+    int64_t* dense_counts =
+        static_cast<int64_t*>(std::calloc(kDenseCap, sizeof(int64_t)));
+    counts.reserve(1 << 16);
+    std::vector<std::string_view> side_toks;
+    tok_ids.reserve(buf.size() / 4 + 16);
+    tok_offsets.reserve(buf.size() / 64 + 16);
+    auto side_token = [&](std::string_view tok) {
+      auto [it, inserted] = counts.try_emplace(
+          tok, 0, static_cast<int32_t>(side_toks.size()));
+      if (inserted) side_toks.push_back(tok);
+      ++it->second.first;
+      tok_ids.push_back(-(it->second.second + 1));
+    };
+    int64_t max_dense_id = -1;
+    for_each_trimmed_line(buf, [&](std::string_view line) {
+      ++n_raw;
+      tok_offsets.push_back(static_cast<int64_t>(tok_ids.size()));
+      if (line.empty()) {
+        side_token(std::string_view(""));  // Java split("") -> [""]
+        return;
+      }
+      for_each_token(line, [&](std::string_view tok, int64_t dense_id) {
+        if (dense_id >= 0 && dense_counts) {
+          ++dense_counts[dense_id];
+          if (dense_id > max_dense_id) max_dense_id = dense_id;
+          tok_ids.push_back(static_cast<int32_t>(dense_id));
+        } else {
+          side_token(tok);
+        }
+      });
+    });
+    tok_offsets.push_back(static_cast<int64_t>(tok_ids.size()));
+    timer.mark("pass1_tokenize_count");
+    min_count = static_cast<int64_t>(
+        std::ceil(min_support * static_cast<double>(n_raw)));
+
+    for (int64_t id = 0; id <= max_dense_id; ++id) {
+      int64_t c = dense_counts ? dense_counts[id] : 0;
+      if (c > 0 && c >= min_count) {  // c > 0: only tokens actually seen
+        dense_tok_arena.push_back(std::to_string(id));
+        std::string_view tok = dense_tok_arena.back();
+        BigInt v;
+        parse_int(tok, &v);
+        freq.push_back({tok, c, true, v});
+      }
+    }
+    for (const auto& [tok, cs] : counts) {
+      if (cs.first >= min_count) {
+        BigInt v;
+        bool num = parse_int(tok, &v);
+        freq.push_back({tok, cs.first, num, v});
+      }
+    }
+    std::sort(freq.begin(), freq.end(),
+              [](const FreqItem& a, const FreqItem& b) {
+                if (a.count != b.count) return a.count > b.count;
+                if (a.numeric != b.numeric) return a.numeric;
+                if (a.numeric) {
+                  if (bigint_less(a.value, b.value)) return true;
+                  if (bigint_less(b.value, a.value)) return false;
+                }
+                return a.tok < b.tok;
+              });
+    f = static_cast<int32_t>(freq.size());
+    // Rank tables (rank+1; 0 = not frequent) keyed the same way pass 1
+    // recorded the tokens: dense id -> dense_rank, side index ->
+    // side_rank.  Pass 2's per-token lookup is one array read either way.
+    if (dense_counts && max_dense_id >= 0) {
+      dense_rank = static_cast<int32_t*>(
+          std::calloc(max_dense_id + 1, sizeof(int32_t)));
+      if (!dense_rank) {  // dense tok_ids would be unresolvable
+        std::free(dense_counts);
+        return false;
+      }
+    }
+    side_rank.assign(side_toks.size(), 0);
+    for (int32_t r = 0; r < f; ++r) {
+      int64_t id = freq[r].numeric ? fast_id(freq[r].tok) : -1;
+      if (dense_rank && id >= 0 && id <= max_dense_id) {
+        dense_rank[id] = r + 1;
+      } else {
+        side_rank[counts.find(freq[r].tok)->second.second] = r + 1;
+      }
+    }
+    std::free(dense_counts);
+    timer.mark("rank_assign");
+    return true;
+  }
+};
+
+// Marshal the global tables (items in rank order + counts) into res.
+// False on allocation failure.
+bool marshal_tables(const Pass1Capture& p1, FaResult* res) {
+  res->n_raw = p1.n_raw;
+  res->min_count = p1.min_count;
+  res->n_items = p1.f;
+  int64_t items_len = 0;
+  for (const auto& item : p1.freq) items_len += item.tok.size() + 1;
+  res->items_buf =
+      static_cast<char*>(std::malloc(items_len ? items_len : 1));
+  res->items_buf_len = items_len ? items_len - 1 : 0;  // drop trailing \n
+  res->item_counts =
+      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (p1.f ? p1.f : 1)));
+  if (!res->items_buf || !res->item_counts) return false;
+  char* p = res->items_buf;
+  for (const auto& item : p1.freq) {
+    std::memcpy(p, item.tok.data(), item.tok.size());
+    p += item.tok.size();
+    *p++ = '\n';
+  }
+  for (int32_t r = 0; r < p1.f; ++r) res->item_counts[r] = p1.freq[r].count;
+  return true;
+}
+
+}  // namespace
+
 extern "C" {
 
 // data/len: raw file bytes.  Not nul-terminated.  Returns a heap-allocated
@@ -392,119 +554,8 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   PhaseTimer timer;
   std::string_view buf(data, static_cast<size_t>(len));
 
-  // ---- pass 1: occurrence counts + parsed-token capture ----------------
-  // Dense array for canonical small-integer tokens (the overwhelmingly
-  // common case), string hash map for everything else.  calloc pages
-  // lazily, so untouched id ranges cost no physical memory.
-  //
-  // Every token is also recorded once in parsed form (``tok_ids``,
-  // line-major with ``tok_offsets`` line boundaries): a dense id >= 0, or
-  // ``-(side_index+1)`` pointing into ``side_toks`` for non-dense tokens
-  // (deduped via the counts map).  Pass 2 then never touches the raw
-  // bytes again — on a 1 GB file a second tokenize+parse scan was half
-  // the preprocessing cost; the parsed form replays at memory bandwidth.
-  int64_t* dense_counts =
-      static_cast<int64_t*>(std::calloc(kDenseCap, sizeof(int64_t)));
-  // token -> (occurrence count, index into side_toks)
-  std::unordered_map<std::string_view, std::pair<int64_t, int32_t>> counts;
-  counts.reserve(1 << 16);
-  std::vector<std::string_view> side_toks;
-  std::vector<int32_t> tok_ids;
-  std::vector<int64_t> tok_offsets;
-  tok_ids.reserve(static_cast<size_t>(len / 4 + 16));
-  tok_offsets.reserve(static_cast<size_t>(len / 64 + 16));
-  auto side_token = [&](std::string_view tok) {
-    auto [it, inserted] = counts.try_emplace(
-        tok, 0, static_cast<int32_t>(side_toks.size()));
-    if (inserted) side_toks.push_back(tok);
-    ++it->second.first;
-    tok_ids.push_back(-(it->second.second + 1));
-  };
-  int64_t max_dense_id = -1;
-  int64_t n_raw = 0;
-  for_each_trimmed_line(buf, [&](std::string_view line) {
-    ++n_raw;
-    tok_offsets.push_back(static_cast<int64_t>(tok_ids.size()));
-    if (line.empty()) {
-      side_token(std::string_view(""));  // Java split("") -> [""]
-      return;
-    }
-    for_each_token(line, [&](std::string_view tok, int64_t dense_id) {
-      if (dense_id >= 0 && dense_counts) {
-        ++dense_counts[dense_id];
-        if (dense_id > max_dense_id) max_dense_id = dense_id;
-        tok_ids.push_back(static_cast<int32_t>(dense_id));
-      } else {
-        side_token(tok);
-      }
-    });
-  });
-  tok_offsets.push_back(static_cast<int64_t>(tok_ids.size()));
-  timer.mark("pass1_tokenize_count");
-  const int64_t min_count =
-      static_cast<int64_t>(std::ceil(min_support * static_cast<double>(n_raw)));
-
-  // ---- rank assignment -------------------------------------------------
-  struct Item {
-    std::string_view tok;
-    int64_t count;
-    bool numeric;
-    BigInt value;
-  };
-  // Owned storage for tokens materialized from dense ids (deque: stable
-  // addresses so string_views into it survive growth).
-  std::deque<std::string> dense_tok_arena;
-  std::vector<Item> freq;
-  for (int64_t id = 0; id <= max_dense_id; ++id) {
-    int64_t c = dense_counts ? dense_counts[id] : 0;
-    if (c > 0 && c >= min_count) {  // c > 0: only tokens actually seen
-      dense_tok_arena.push_back(std::to_string(id));
-      std::string_view tok = dense_tok_arena.back();
-      BigInt v;
-      parse_int(tok, &v);
-      freq.push_back({tok, c, true, v});
-    }
-  }
-  for (const auto& [tok, cs] : counts) {
-    if (cs.first >= min_count) {
-      BigInt v;
-      bool num = parse_int(tok, &v);
-      freq.push_back({tok, cs.first, num, v});
-    }
-  }
-  std::sort(freq.begin(), freq.end(), [](const Item& a, const Item& b) {
-    if (a.count != b.count) return a.count > b.count;
-    if (a.numeric != b.numeric) return a.numeric;  // numeric first
-    if (a.numeric) {
-      if (bigint_less(a.value, b.value)) return true;
-      if (bigint_less(b.value, a.value)) return false;
-    }
-    return a.tok < b.tok;
-  });
-  const int32_t f = static_cast<int32_t>(freq.size());
-  // Rank tables (rank+1; 0 = not frequent) keyed the same way pass 1
-  // recorded the tokens: dense id -> dense_rank, side index -> side_rank.
-  // Pass 2's per-token lookup is then one array read either way.
-  int32_t* dense_rank = nullptr;
-  if (dense_counts && max_dense_id >= 0) {
-    dense_rank = static_cast<int32_t*>(
-        std::calloc(max_dense_id + 1, sizeof(int32_t)));
-    if (!dense_rank) {  // dense tok_ids would be unresolvable
-      std::free(dense_counts);
-      return nullptr;
-    }
-  }
-  std::vector<int32_t> side_rank(side_toks.size(), 0);
-  for (int32_t r = 0; r < f; ++r) {
-    int64_t id = freq[r].numeric ? fast_id(freq[r].tok) : -1;
-    if (dense_rank && id >= 0 && id <= max_dense_id) {
-      dense_rank[id] = r + 1;
-    } else {
-      side_rank[counts.find(freq[r].tok)->second.second] = r + 1;
-    }
-  }
-  std::free(dense_counts);
-  timer.mark("rank_assign");
+  Pass1Capture p1;
+  if (!p1.run(buf, min_support, timer)) return nullptr;
 
   // ---- pass 2: basket dedup with multiplicity --------------------------
   // Replays the parsed tokens captured in pass 1 (tok_ids) — no second
@@ -514,22 +565,18 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   // realloc from copying the growing arena (~1.2 GB of cumulative copy
   // at Webdocs scale); pages are committed lazily, so over-reservation
   // costs virtual space only.
-  if (!dd.arena.reserve(tok_ids.size() + 1)) {
-    std::free(dense_rank);
-    return nullptr;
-  }
-  RankCollector rc(f);
-  for (int64_t li = 0; li < n_raw; ++li) {
+  if (!dd.arena.reserve(p1.tok_ids.size() + 1)) return nullptr;
+  RankCollector rc(p1.f);
+  for (int64_t li = 0; li < p1.n_raw; ++li) {
     rc.reset_list();
-    for (int64_t ti = tok_offsets[li]; ti < tok_offsets[li + 1]; ++ti) {
-      int32_t id = tok_ids[ti];
-      rc.add(id >= 0 ? dense_rank[id] : side_rank[-id - 1]);
+    for (int64_t ti = p1.tok_offsets[li]; ti < p1.tok_offsets[li + 1];
+         ++ti) {
+      rc.add(p1.rank_plus_1(p1.tok_ids[ti]));
     }
     const auto& ranks = rc.finish();
     if (ranks.size() <= 1) continue;
     if (!dd.insert(ranks.data(), ranks.size())) {
       dd.arena.free_buf();
-      std::free(dense_rank);
       return nullptr;
     }
   }
@@ -539,38 +586,16 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   auto* res = static_cast<FaResult*>(std::calloc(1, sizeof(FaResult)));
   if (!res) {
     dd.arena.free_buf();
-    std::free(dense_rank);
     return nullptr;
   }
-  res->n_raw = n_raw;
-  res->min_count = min_count;
-  res->n_items = f;
-
-  int64_t items_len = 0;
-  for (const auto& item : freq) items_len += item.tok.size() + 1;
-  res->items_buf = static_cast<char*>(std::malloc(items_len ? items_len : 1));
-  res->items_buf_len = items_len ? items_len - 1 : 0;  // drop trailing '\n'
-  res->item_counts =
-      static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (f ? f : 1)));
-  bool ok = res->items_buf && res->item_counts && marshal_baskets(dd, res);
+  bool ok = marshal_tables(p1, res) && marshal_baskets(dd, res);
   if (!ok) {
     // fa_free_result tolerates the partially-filled struct
     // (free(nullptr) is a no-op); the arena is still the deduper's.
     dd.arena.free_buf();
-    std::free(dense_rank);
     fa_free_result(res);
     return nullptr;
   }
-  {
-    char* p = res->items_buf;
-    for (const auto& item : freq) {
-      std::memcpy(p, item.tok.data(), item.tok.size());
-      p += item.tok.size();
-      *p++ = '\n';
-    }
-  }
-  for (int32_t r = 0; r < f; ++r) res->item_counts[r] = freq[r].count;
-  std::free(dense_rank);
   timer.mark("marshal");
   return res;
 }
@@ -962,6 +987,111 @@ void fa_free_candidates(FaCandidates* c) {
   std::free(c->x_idx);
   std::free(c->y);
   std::free(c);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Pipelined single-host ingest, capture-replay form: the whole
+// fa_preprocess_buffer pipeline (pass-1 capture, rank assignment, pass-2
+// id replay — never re-tokenizing the raw bytes) but with pass 2 split
+// into ``n_blocks`` contiguous line ranges, each handed to the caller
+// through ``cb`` AS SOON as it is deduplicated — the Python side starts
+// that block's device upload while this function compresses the next
+// block.  Per-block dedup only (cross-block duplicate baskets stay
+// separate weighted rows; weighted counts are identical — the multi-host
+// sharded-ingest correctness argument).  The returned FaResult carries
+// the global tables (n_raw, min_count, items, counts) with ZERO baskets;
+// the caller assembles the basket CSR from the callback copies.
+
+extern "C" {
+
+typedef void (*FaBlockCb)(void* ctx, int32_t f, int64_t n_baskets,
+                          const int64_t* offsets, const int32_t* items,
+                          const int32_t* weights);
+
+FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
+                                      double min_support, int32_t n_blocks,
+                                      FaBlockCb cb, void* cb_ctx) {
+  PhaseTimer timer;
+  std::string_view buf(data, static_cast<size_t>(len));
+
+  Pass1Capture p1;
+  if (!p1.run(buf, min_support, timer)) return nullptr;
+
+  // ---- pass 2: per-block replay + dedup + callback --------------------
+  // Blocks split by TOKEN count (not line count) so work per block is
+  // even regardless of line-length skew.
+  if (n_blocks < 1) n_blocks = 1;
+  bool oom = false;
+  const int64_t n_tok = static_cast<int64_t>(p1.tok_ids.size());
+  int64_t line_lo = 0;
+  std::vector<int64_t> offs;
+  for (int32_t b = 0; b < n_blocks && line_lo < p1.n_raw; ++b) {
+    // First line whose token start reaches the nominal boundary.
+    const int64_t tok_target = (n_tok * (b + 1)) / n_blocks;
+    int64_t line_hi = (b == n_blocks - 1) ? p1.n_raw : line_lo;
+    if (b != n_blocks - 1) {
+      line_hi = std::upper_bound(p1.tok_offsets.begin() + line_lo,
+                                 p1.tok_offsets.begin() + p1.n_raw,
+                                 tok_target - 1)
+                - p1.tok_offsets.begin();
+      if (line_hi <= line_lo) line_hi = line_lo + 1;
+      if (line_hi > p1.n_raw) line_hi = p1.n_raw;
+    }
+    BasketDeduper dd;
+    if (!dd.arena.reserve(static_cast<size_t>(p1.tok_offsets[line_hi] -
+                                              p1.tok_offsets[line_lo]) +
+                          1)) {
+      oom = true;
+      break;
+    }
+    RankCollector rc(p1.f);
+    for (int64_t li = line_lo; li < line_hi; ++li) {
+      rc.reset_list();
+      for (int64_t ti = p1.tok_offsets[li]; ti < p1.tok_offsets[li + 1];
+           ++ti) {
+        rc.add(p1.rank_plus_1(p1.tok_ids[ti]));
+      }
+      const auto& ranks = rc.finish();
+      if (ranks.size() <= 1) continue;
+      if (!dd.insert(ranks.data(), ranks.size())) {
+        oom = true;
+        break;
+      }
+    }
+    if (oom) {
+      dd.arena.free_buf();
+      break;
+    }
+    const int64_t t = static_cast<int64_t>(dd.b_off.size());
+    if (t > 0) {
+      offs.resize(t + 1);
+      for (int64_t i = 0; i < t; ++i) offs[i] = dd.b_off[i];
+      offs[t] = static_cast<int64_t>(dd.arena.n);
+      cb(cb_ctx, p1.f, t, offs.data(), dd.arena.p, dd.b_weight.data());
+    }
+    dd.arena.free_buf();
+    line_lo = line_hi;
+  }
+  timer.mark("pass2_dedup_blocks");
+  if (oom) return nullptr;
+
+  // ---- marshal (tables only; baskets live in the callback copies) -----
+  auto* res = static_cast<FaResult*>(std::calloc(1, sizeof(FaResult)));
+  if (!res) return nullptr;
+  res->n_baskets = 0;
+  res->basket_offsets =
+      static_cast<int64_t*>(std::calloc(1, sizeof(int64_t)));
+  res->basket_items = static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
+  res->weights = static_cast<int32_t*>(std::malloc(sizeof(int32_t)));
+  if (!marshal_tables(p1, res) || !res->basket_offsets ||
+      !res->basket_items || !res->weights) {
+    fa_free_result(res);
+    return nullptr;
+  }
+  timer.mark("marshal");
+  return res;
 }
 
 }  // extern "C"
