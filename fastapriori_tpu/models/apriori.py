@@ -374,19 +374,28 @@ class FastApriori:
                 # Host-side assembly (weights, CSR for API parity) runs
                 # BEFORE the upload-tail wait so it hides under the last
                 # blocks' transfers.
-                asm = self._assemble_blocks(blocks, txn_multiple)
+                asm = self._assemble_blocks(blocks, txn_multiple, f)
                 dev_blocks = [fu.result() for fu in dev_futures]
 
-            total, t_pad, w_np, w_digits_np, scales, indices, offsets = asm
+            (
+                total, t_pad, w_np, w_digits_np, scales, indices, offsets,
+                heavy_b, heavy_w,
+            ) = asm
             bitmap = self._device_concat_unpack(
                 dev_blocks, total, t_pad, f_pad
             )
             w_digits = ctx.shard_weight_digits(w_digits_np)
+            heavy = self._upload_heavy(heavy_b, heavy_w)
             m.update(
                 shape=[t_pad, f_pad],
                 digits=len(scales),
                 blocks=len(blocks),
-                upload_bytes=upload_bytes + w_digits_np.nbytes,
+                heavy_rows=0
+                if heavy_b is None
+                else int(np.count_nonzero(heavy_w)),
+                upload_bytes=upload_bytes
+                + w_digits_np.nbytes
+                + (0 if heavy_b is None else heavy_b.nbytes + heavy_w.nbytes),
             )
 
         data = CompressedData(
@@ -401,9 +410,59 @@ class FastApriori:
         )
         levels = self._mine_levels(
             data,
-            preupload=(bitmap, w_digits, scales, n_chunks, t_pad, f_pad),
+            preupload=(
+                bitmap, w_digits, scales, n_chunks, t_pad, f_pad, heavy,
+            ),
         )
         return levels, data
+
+    def _upload_heavy(self, heavy_b, heavy_w):
+        """Replicated device placement of the heavy-row remainder arrays
+        (None -> None: legacy multi-digit)."""
+        if heavy_b is None:
+            return None
+        ctx = self.context
+        return ctx.replicate(heavy_b), ctx.replicate(heavy_w)
+
+    # Heavy-row remainder bounds: above either, fall back to the legacy
+    # multi-digit weight path (the remainder arrays would no longer be
+    # "tiny" — heavy_b is DENSE int8 [Th, f_pad] replicated per device,
+    # so the byte bound matters at large item counts).
+    HEAVY_SPLIT_CAP = 4096
+    HEAVY_SPLIT_BYTES = 16 << 20
+
+    def _split_weights(self, w_np, t_pad, indices, offsets, f):
+        """Single-low-digit weight split: the main kernels run ONE int8
+        digit (``w % 128``) for every row — halving the counting matmuls
+        when any row's multiplicity reaches 128 — and the exact remainder
+        ``w - w%128`` rides a tiny separate heavy-row array added as an
+        int32 correction (ops/count.py heavy_*_correction).  Returns
+        ``(w_digits, scales, heavy_b | None, heavy_w | None)``; heavy
+        None = legacy multi-digit (no heavy rows, or too many)."""
+        from fastapriori_tpu.ops.bitmap import build_bitmap, pad_axis
+
+        heavy_idx = np.flatnonzero(w_np >= 128)
+        f_pad = pad_axis(f + 1, self.config.item_tile)
+        if (
+            heavy_idx.size == 0
+            or heavy_idx.size > self.HEAVY_SPLIT_CAP
+            or heavy_idx.size * f_pad > self.HEAVY_SPLIT_BYTES
+        ):
+            w_digits_np, scales = weight_digits(w_np, t_pad)
+            return w_digits_np, scales, None, None
+        w_digits_np, scales = weight_digits(
+            (w_np % 128).astype(np.int32), t_pad
+        )
+        assert scales == [1], scales  # low digit only, by construction
+        baskets = [
+            indices[offsets[i] : offsets[i + 1]] for i in heavy_idx
+        ]
+        heavy_b = build_bitmap(baskets, f, 8, self.config.item_tile)
+        heavy_w = np.zeros(heavy_b.shape[0], dtype=np.int32)
+        heavy_w[: heavy_idx.size] = w_np[heavy_idx] - (
+            w_np[heavy_idx] % 128
+        )
+        return w_digits_np, scales, heavy_b, heavy_w
 
     @staticmethod
     def _empty_compressed(
@@ -422,17 +481,16 @@ class FastApriori:
             weights=np.empty(0, np.int32),
         )
 
-    @staticmethod
-    def _assemble_blocks(blocks, txn_multiple: int):
+    def _assemble_blocks(self, blocks, txn_multiple: int, f: int):
         """Host-side assembly of per-block CSRs: concatenated weights +
-        weight digits + the global CSR (API parity).  Shared by both
-        pipelined ingest flavors; runs while the upload tail drains."""
+        weight digits (single-low-digit split when heavy rows are few) +
+        the global CSR (API parity).  Shared by both pipelined ingest
+        flavors; runs while the upload tail drains."""
         from fastapriori_tpu.ops.bitmap import pad_axis
 
         total = sum(len(bw) for _, _, bw in blocks)
         t_pad = pad_axis(total, txn_multiple)
         w_np = np.concatenate([bw for _, _, bw in blocks])
-        w_digits_np, scales = weight_digits(w_np, t_pad)
         indices = np.concatenate([bi for bi, _, _ in blocks])
         offs = [np.zeros(1, dtype=np.int64)]
         base = 0
@@ -440,7 +498,13 @@ class FastApriori:
             offs.append(bo[1:].astype(np.int64) + base)
             base += int(bo[-1])
         offsets = np.concatenate(offs)
-        return total, t_pad, w_np, w_digits_np, scales, indices, offsets
+        w_digits_np, scales, heavy_b, heavy_w = self._split_weights(
+            w_np, t_pad, indices, offsets, f
+        )
+        return (
+            total, t_pad, w_np, w_digits_np, scales, indices, offsets,
+            heavy_b, heavy_w,
+        )
 
     def _device_concat_unpack(self, dev_blocks, total, t_pad, f_pad):
         """Concat uploaded packed blocks on device, pad the tail rows,
@@ -515,23 +579,33 @@ class FastApriori:
             n_chunks = max(1, -(-n_raw // cfg.level_txn_chunk))
             with self.metrics.timed("bitmap_build") as m:
                 asm = self._assemble_blocks(
-                    blocks, max(cfg.txn_tile, 32) * n_chunks
+                    blocks, max(cfg.txn_tile, 32) * n_chunks, f
                 )
                 dev_blocks = [fu.result() for fu in dev_futures]
-                total, t_pad, w_np, w_digits_np, scales, indices, offsets = (
-                    asm
-                )
+                (
+                    total, t_pad, w_np, w_digits_np, scales, indices,
+                    offsets, heavy_b, heavy_w,
+                ) = asm
                 f_pad = state["f_pad"]
                 bitmap = self._device_concat_unpack(
                     dev_blocks, total, t_pad, f_pad
                 )
                 w_digits = ctx.shard_weight_digits(w_digits_np)
+                heavy = self._upload_heavy(heavy_b, heavy_w)
                 m.update(
                     shape=[t_pad, f_pad],
                     digits=len(scales),
                     blocks=len(blocks),
+                    heavy_rows=0
+                    if heavy_b is None
+                    else int(np.count_nonzero(heavy_w)),
                     upload_bytes=state["upload_bytes"]
-                    + w_digits_np.nbytes,
+                    + w_digits_np.nbytes
+                    + (
+                        0
+                        if heavy_b is None
+                        else heavy_b.nbytes + heavy_w.nbytes
+                    ),
                 )
         finally:
             upool.shutdown()
@@ -548,7 +622,9 @@ class FastApriori:
         )
         levels = self._mine_levels(
             data,
-            preupload=(bitmap, w_digits, scales, n_chunks, t_pad, f_pad),
+            preupload=(
+                bitmap, w_digits, scales, n_chunks, t_pad, f_pad, heavy,
+            ),
         )
         return levels, data
 
@@ -853,19 +929,22 @@ class FastApriori:
         levels >= 2, lex-sorted.  ``resume``: complete levels salvaged
         from a failed fused attempt — the loop continues from the deepest
         one instead of recounting them.  ``preupload``: device-resident
-        ``(bitmap, w_digits, scales, n_chunks, t_pad, f_pad)`` from the
-        pipelined ingest — the bitmap build/upload below is skipped."""
+        ``(bitmap, w_digits, scales, n_chunks, t_pad, f_pad, heavy)``
+        from the pipelined ingest — the bitmap build/upload below is
+        skipped."""
         cfg = self.config
         ctx = self.context
         f = data.num_items
         min_count = data.min_count
 
         if preupload is not None:
-            bitmap, w_digits, scales, n_chunks, t_pad, f_pad = preupload
+            bitmap, w_digits, scales, n_chunks, t_pad, f_pad, heavy = (
+                preupload
+            )
             fast_f32 = self._fast_f32(data.n_raw)
             return self._level_loop(
                 data, resume, bitmap, w_digits, scales, n_chunks,
-                fast_f32, t_pad,
+                fast_f32, t_pad, heavy,
             )
 
         with self.metrics.timed("bitmap_build") as m:
@@ -899,12 +978,18 @@ class FastApriori:
                     cfg.item_tile,
                 )
                 t_pad = packed_np.shape[0]
-                w_digits_np, scales = weight_digits(data.weights, t_pad)
+                w_digits_np, scales, heavy_b, heavy_w = (
+                    self._split_weights(
+                        data.weights, t_pad, data.basket_indices,
+                        data.basket_offsets, f,
+                    )
+                )
                 # Bit-packed transfer + on-device unpack: 8x less
                 # host->device traffic (the dominant cost of this phase
                 # on tunneled chips).
                 bitmap = ctx.upload_packed(packed_np)
                 w_digits = ctx.shard_weight_digits(w_digits_np)
+                heavy = self._upload_heavy(heavy_b, heavy_w)
             else:
                 # Multi-host sharded ingest: this process holds only its
                 # shard's baskets; each process pads its rows to the SAME
@@ -947,6 +1032,10 @@ class FastApriori:
                 )
                 bitmap = ctx.upload_packed_local(packed_np)
                 w_digits = ctx.shard_weight_digits_local(w_digits_np)
+                # Multi-host keeps the legacy multi-digit path (the
+                # remainder arrays would need globally uniform shapes
+                # and replicated cross-host assembly for little gain).
+                heavy = None
             m.update(
                 shape=[t_pad, f_pad],
                 digits=len(scales),
@@ -955,7 +1044,7 @@ class FastApriori:
             )
         return self._level_loop(
             data, resume, bitmap, w_digits, scales, n_chunks,
-            fast_f32, t_pad,
+            fast_f32, t_pad, heavy,
         )
 
     def _fast_f32(self, n_raw: int) -> bool:
@@ -977,6 +1066,7 @@ class FastApriori:
         n_chunks: int,
         fast_f32: bool,
         t_pad: int,
+        heavy: Optional[tuple] = None,
     ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """The level-synchronous loop over a device-resident bitmap
         (levels 2..k; reference C6+C7+C8+C9)."""
@@ -1001,13 +1091,14 @@ class FastApriori:
             with self.metrics.timed("level", k=2) as m:
                 cap = cfg.pair_cap
                 attempts = 0
+                hb, hw = heavy if heavy is not None else (None, None)
                 while True:
                     attempts += 1
                     idx, cnt, n2 = (
                         np.asarray(a)
                         for a in ctx.pair_gather(
                             bitmap, w_digits, scales, min_count, f, cap,
-                            fast_f32,
+                            heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
                         )
                     )
                     n2 = int(n2)
@@ -1043,6 +1134,7 @@ class FastApriori:
                     min_count,
                     n_chunks,
                     fast_f32,
+                    heavy,
                 )
                 m.update(frequent=nxt.shape[0], **lvl_stats)
             levels.append((nxt, nxt_counts))
@@ -1061,6 +1153,7 @@ class FastApriori:
         min_count: int,
         n_chunks: int,
         fast_f32: bool = False,
+        heavy: Optional[tuple] = None,
     ) -> Tuple[np.ndarray, np.ndarray, dict]:
         """C8 for one level, transfer-minimal: greedy chunks of at most
         P_CAP prefixes / C_CAP candidates go through the compiled-once
@@ -1197,6 +1290,7 @@ class FastApriori:
             for _ in range(_next_pow2(nb) - nb):
                 pcs.append(np.full((p_cap, k_pad), zcol, dtype=cols_dt))
                 cis.append(np.zeros(c_cap, dtype=np.int32))
+            hb, hw = heavy if heavy is not None else (None, None)
             out = ctx.level_gather_batch(
                 bitmap,
                 w_digits,
@@ -1205,7 +1299,9 @@ class FastApriori:
                 s,
                 np.stack(cis),
                 n_chunks,
-                fast_f32,
+                heavy_b=hb,
+                heavy_w=hw,
+                fast_f32=fast_f32,
             )
             try:
                 out.copy_to_host_async()

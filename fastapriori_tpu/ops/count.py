@@ -104,6 +104,63 @@ def _weights_f32(w_digits: jnp.ndarray, scales: Sequence[int]) -> jnp.ndarray:
     return w
 
 
+def _heavy_gate(corr: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
+    """Heavy-row corrections are computed from REPLICATED arrays; under a
+    txn mesh only shard 0 may add them or the psum would multiply the
+    contribution by the shard count."""
+    if axis_name is None:
+        return corr
+    return jnp.where(lax.axis_index(axis_name) == 0, corr, 0)
+
+
+def heavy_pair_correction(
+    heavy_b: jnp.ndarray,  # [Th, F] int8 (zero rows when unused)
+    heavy_w: jnp.ndarray,  # [Th] int32 = w - (w % 128) (0 on padding)
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """The heavy rows' contribution to the pair Gram matrix.
+
+    The engine runs the MAIN kernels with the single low digit
+    ``w % 128`` for EVERY row (one int8 matmul per phase instead of D)
+    and adds this exact remainder term — ``w = w%128 + (w - w%128)`` —
+    over the few rows with multiplicity >= 128 (int32 arithmetic, no
+    digit bound).  Tiny: Th is capped by the engine."""
+    scaled = heavy_b.astype(jnp.int32) * heavy_w[:, None]
+    corr = lax.dot_general(
+        scaled,
+        heavy_b.astype(jnp.int32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _heavy_gate(corr, axis_name)
+
+
+def heavy_level_correction(
+    onehot,  # [P, F] prefix one-hot (int8 or f32)
+    k1: jnp.ndarray,  # () int32
+    heavy_b: jnp.ndarray,  # [Th, F] int8
+    heavy_w: jnp.ndarray,  # [Th] int32
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Heavy rows' contribution to one level's [P, F] count matrix (see
+    :func:`heavy_pair_correction`): membership + weighted counting over
+    just the heavy rows, int32 throughout."""
+    member = lax.dot_general(
+        heavy_b.astype(jnp.int32),
+        onehot.astype(jnp.int32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [Th, P]
+    common = (member == k1).astype(jnp.int32) * heavy_w[:, None]
+    corr = lax.dot_general(
+        common,
+        heavy_b.astype(jnp.int32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [P, F]
+    return _heavy_gate(corr, axis_name)
+
+
 def local_pair_gather(
     bitmap: jnp.ndarray,  # [T_local, F] int8
     w_digits: jnp.ndarray,  # [D, T_local] int8
@@ -111,6 +168,8 @@ def local_pair_gather(
     min_count: jnp.ndarray,  # () int32 (traced)
     num_items: jnp.ndarray,  # () int32 (traced) — real F before padding
     cap: int,
+    heavy_b: Optional[jnp.ndarray] = None,  # [Th, F] int8
+    heavy_w: Optional[jnp.ndarray] = None,  # [Th] int32
     axis_name: Optional[str] = None,
     fast_f32: bool = False,
 ) -> tuple:
@@ -138,6 +197,8 @@ def local_pair_gather(
         ).astype(jnp.int32)
     else:
         counts = _weighted_matmul(bitmap, bitmap, w_digits, scales)
+    if heavy_b is not None:
+        counts = counts + heavy_pair_correction(heavy_b, heavy_w, axis_name)
     counts = _psum_if(counts, axis_name)
     iu = jnp.arange(f)
     upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
@@ -156,6 +217,8 @@ def local_level_gather(
     k1: jnp.ndarray,  # () int32 — real prefix width (traced, not static)
     cand_idx: jnp.ndarray,  # [C] int32 flat indexes row*F + y
     n_chunks: int,
+    heavy_b: Optional[jnp.ndarray] = None,  # [Th, F] int8
+    heavy_w: Optional[jnp.ndarray] = None,  # [Th] int32
     axis_name: Optional[str] = None,
     cand_axis_name: Optional[str] = None,
     fast_f32: bool = False,
@@ -253,6 +316,10 @@ def local_level_gather(
     if varying:
         init = lax.pcast(init, varying, to="varying")
     counts, _ = lax.scan(body, init, (bm, wd))
+    if heavy_b is not None:
+        counts = counts + heavy_level_correction(
+            onehot, k1, heavy_b, heavy_w, axis_name
+        )
     local = jnp.take(counts.reshape(-1), cand_idx)
     return _psum_if(local, axis_name)
 
@@ -265,6 +332,8 @@ def local_level_gather_batch(
     k1: jnp.ndarray,  # () int32 (traced)
     cand_stack: jnp.ndarray,  # [NB, C] flat candidate indexes per block
     n_chunks: int,
+    heavy_b: Optional[jnp.ndarray] = None,  # [Th, F] int8
+    heavy_w: Optional[jnp.ndarray] = None,  # [Th] int32
     axis_name: Optional[str] = None,
     cand_axis_name: Optional[str] = None,
     fast_f32: bool = False,
@@ -286,6 +355,8 @@ def local_level_gather_batch(
             k1,
             ci,
             n_chunks,
+            heavy_b=heavy_b,
+            heavy_w=heavy_w,
             axis_name=axis_name,
             cand_axis_name=cand_axis_name,
             fast_f32=fast_f32,

@@ -379,32 +379,42 @@ class DeviceContext:
 
     def pair_gather(
         self, bitmap, w_digits, scales, min_count: int, num_items: int,
-        cap: int, fast_f32: bool = False,
+        cap: int, heavy_b=None, heavy_w=None, fast_f32: bool = False,
     ):
         """On-device pair threshold (ops/count.py local_pair_gather);
-        returns (flat_idx, counts, n2) numpy-convertible arrays."""
-        key = ("pair_gather", tuple(scales), cap, fast_f32)
+        returns (flat_idx, counts, n2) numpy-convertible arrays.
+        ``heavy_b``/``heavy_w``: replicated heavy-row remainder arrays
+        (single-low-digit weight split) — None runs the legacy
+        multi-digit form."""
+        has_heavy = heavy_b is not None
+        key = ("pair_gather", tuple(scales), cap, fast_f32, has_heavy)
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
 
-            def _local(bitmap, w_digits, min_count, num_items):
+            def _local(bitmap, w_digits, min_count, num_items, *hv):
+                hb, hw = hv if hv else (None, None)
                 return count_ops.local_pair_gather(
                     bitmap, w_digits, scl, min_count, num_items, cap,
+                    heavy_b=hb, heavy_w=hw,
                     axis_name=AXIS, fast_f32=fast_f32,
                 )
 
+            in_specs = (P(AXIS, None), P(None, AXIS), P(), P()) + (
+                (P(None, None), P(None)) if has_heavy else ()
+            )
             self._fns[key] = jax.jit(
                 jax.shard_map(
                     _local,
                     mesh=mesh,
-                    in_specs=(P(AXIS, None), P(None, AXIS), P(), P()),
+                    in_specs=in_specs,
                     out_specs=(P(None), P(None), P()),
                 )
             )
-        return self._fns[key](
-            bitmap, w_digits, jnp.int32(min_count), jnp.int32(num_items)
-        )
+        args = [bitmap, w_digits, jnp.int32(min_count), jnp.int32(num_items)]
+        if has_heavy:
+            args += [heavy_b, heavy_w]
+        return self._fns[key](*args)
 
     def level_gather_batch(
         self,
@@ -415,55 +425,56 @@ class DeviceContext:
         k1: int,
         cand_stack,
         n_chunks: int,
+        heavy_b=None,
+        heavy_w=None,
         fast_f32: bool = False,
     ) -> jax.Array:
         """A whole level's blocks in one launch (ops/count.py
         local_level_gather_batch) — launches carry ~100 ms of fixed
         round-trip cost on tunneled backends, so NB blocks pay it once.
+        ``heavy_b``/``heavy_w``: replicated heavy-row remainder arrays
+        (single-low-digit weight split); None = legacy multi-digit.
         Returns ``[NB, C]`` gathered counts."""
-        key = ("level_gather_batch", tuple(scales), n_chunks, fast_f32)
+        has_heavy = heavy_b is not None
+        key = (
+            "level_gather_batch", tuple(scales), n_chunks, fast_f32,
+            has_heavy,
+        )
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
 
-            def _local(bitmap, w_digits, prefix_stack, k1, cand_stack):
+            def _local(bitmap, w_digits, ps, k1, cs, *hv):
+                hb, hw = hv if hv else (None, None)
                 return count_ops.local_level_gather_batch(
-                    bitmap,
-                    w_digits,
-                    scl,
-                    prefix_stack,
-                    k1,
-                    cand_stack,
-                    n_chunks,
-                    axis_name=AXIS,
-                    cand_axis_name=CAND,
+                    bitmap, w_digits, scl, ps, k1, cs, n_chunks,
+                    heavy_b=hb, heavy_w=hw,
+                    axis_name=AXIS, cand_axis_name=CAND,
                     fast_f32=fast_f32,
                 )
 
+            # Blocks unsharded (scanned on device); prefix rows and the
+            # candidate gather sharded over cand; heavy remainder arrays
+            # replicated.
+            in_specs = (
+                P(AXIS, None),
+                P(None, AXIS),
+                P(None, CAND, None),
+                P(),
+                P(None, CAND),
+            ) + ((P(None, None), P(None)) if has_heavy else ())
             self._fns[key] = jax.jit(
                 jax.shard_map(
                     _local,
                     mesh=mesh,
-                    # Same layout as level_gather with a leading block
-                    # axis: prefix rows and the candidate gather sharded
-                    # over cand, blocks unsharded (scanned on device).
-                    in_specs=(
-                        P(AXIS, None),
-                        P(None, AXIS),
-                        P(None, CAND, None),
-                        P(),
-                        P(None, CAND),
-                    ),
+                    in_specs=in_specs,
                     out_specs=P(None, CAND),
                 )
             )
-        return self._fns[key](
-            bitmap,
-            w_digits,
-            prefix_stack,
-            jnp.int32(k1),
-            cand_stack,
-        )
+        args = [bitmap, w_digits, prefix_stack, jnp.int32(k1), cand_stack]
+        if has_heavy:
+            args += [heavy_b, heavy_w]
+        return self._fns[key](*args)
 
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
         pair, _, _ = self._get_fns(tuple(scales))

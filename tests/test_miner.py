@@ -223,3 +223,38 @@ def test_multi_chunk_batched_level_launch(n_devices, cand):
         )
     ).run(lines)
     assert dict(got) == dict(expected)
+
+
+@pytest.mark.parametrize("dups", [128, 300, 16500])
+def test_level_engine_heavy_weight_split(dups):
+    """Multiplicities >= 128 route through the single-low-digit weight
+    split (main kernels count w % 128; the remainder rides the tiny
+    heavy-row int32 correction — ops/count.py heavy_*_correction).
+    16500 crosses the old 2-digit bound, proving the remainder path has
+    no digit limit.  Must match the oracle exactly."""
+    lines = tokenized(
+        ["1 2 3"] * dups + ["1 2 4"] * 60 + ["2 3 4 5"] * 9 + ["5 6"] * 3
+    )
+    expected, _, _ = oracle.mine(lines, 2.0 / len(lines))
+    got, _, _ = FastApriori(
+        config=MinerConfig(
+            min_support=2.0 / len(lines), engine="level", num_devices=8
+        )
+    ).run(lines)
+    assert dict(got) == dict(expected)
+
+
+def test_level_engine_heavy_split_cap_fallback():
+    """More heavy rows than HEAVY_SPLIT_CAP falls back to the legacy
+    multi-digit path — same results either way."""
+    lines = tokenized(
+        [f"{i} {i + 1}" for i in range(40) for _ in range(130)]
+    )
+    ms = 2.0 / len(lines)
+    expected, _, _ = oracle.mine(lines, ms)
+    miner = FastApriori(
+        config=MinerConfig(min_support=ms, engine="level", num_devices=1)
+    )
+    miner.HEAVY_SPLIT_CAP = 8  # force the fallback (40 heavy rows)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
