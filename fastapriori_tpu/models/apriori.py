@@ -390,12 +390,10 @@ class FastApriori:
                 shape=[t_pad, f_pad],
                 digits=len(scales),
                 blocks=len(blocks),
-                heavy_rows=0
-                if heavy_b is None
-                else int(np.count_nonzero(heavy_w)),
+                heavy_rows=self._heavy_stats(heavy_b, heavy_w)[0],
                 upload_bytes=upload_bytes
                 + w_digits_np.nbytes
-                + (0 if heavy_b is None else heavy_b.nbytes + heavy_w.nbytes),
+                + self._heavy_stats(heavy_b, heavy_w)[1],
             )
 
         data = CompressedData(
@@ -423,6 +421,17 @@ class FastApriori:
             return None
         ctx = self.context
         return ctx.replicate(heavy_b), ctx.replicate(heavy_w)
+
+    def _heavy_stats(self, heavy_b, heavy_w):
+        """(true heavy-row count, host->device bytes) for the metrics
+        stream — the arrays are REPLICATED, so the byte figure scales
+        with the device count."""
+        if heavy_b is None:
+            return 0, 0
+        return (
+            int(np.count_nonzero(heavy_w)),
+            (heavy_b.nbytes + heavy_w.nbytes) * self.context.n_devices,
+        )
 
     # Heavy-row remainder bounds: above either, fall back to the legacy
     # multi-digit weight path (the remainder arrays would no longer be
@@ -596,16 +605,10 @@ class FastApriori:
                     shape=[t_pad, f_pad],
                     digits=len(scales),
                     blocks=len(blocks),
-                    heavy_rows=0
-                    if heavy_b is None
-                    else int(np.count_nonzero(heavy_w)),
+                    heavy_rows=self._heavy_stats(heavy_b, heavy_w)[0],
                     upload_bytes=state["upload_bytes"]
                     + w_digits_np.nbytes
-                    + (
-                        0
-                        if heavy_b is None
-                        else heavy_b.nbytes + heavy_w.nbytes
-                    ),
+                    + self._heavy_stats(heavy_b, heavy_w)[1],
                 )
         finally:
             upool.shutdown()

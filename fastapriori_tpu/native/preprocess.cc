@@ -64,10 +64,20 @@ struct PhaseTimer {
 
 namespace {
 
-inline bool is_ws(unsigned char c) {
-  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
-         c == '\r';
-}
+// Byte classes for the tokenizer hot loop: one table load replaces the
+// six-way whitespace comparison chain per byte.  bit0 = Java \s
+// (ASCII ws the tokenizer splits on), bit1 = decimal digit.
+constexpr uint8_t kWs = 1, kDigit = 2;
+struct ByteClass {
+  uint8_t t[256] = {};
+  constexpr ByteClass() {
+    t[' '] = t['\t'] = t['\n'] = t['\v'] = t['\f'] = t['\r'] = kWs;
+    for (int c = '0'; c <= '9'; ++c) t[c] = kDigit;
+  }
+};
+constexpr ByteClass kByteClass;
+
+inline bool is_ws(unsigned char c) { return kByteClass.t[c] & kWs; }
 
 // Dense fast path: most datasets use small decimal item ids.  A token in
 // CANONICAL decimal form (single "0", or leading digit 1-9, all digits, at
@@ -168,12 +178,13 @@ inline void for_each_token(std::string_view line, Fn&& fn) {
     const char* start = p;
     int64_t v = 0;
     bool digits_only = true;
-    while (p < end && !is_ws(static_cast<unsigned char>(*p))) {
-      unsigned char c = static_cast<unsigned char>(*p) - '0';
-      if (c > 9) {
+    while (p < end) {
+      const uint8_t cls = kByteClass.t[static_cast<unsigned char>(*p)];
+      if (cls & kWs) break;
+      if (!(cls & kDigit)) {
         digits_only = false;
       } else if (p - start < 7) {  // beyond 7 digits: non-dense anyway
-        v = v * 10 + c;
+        v = v * 10 + (static_cast<unsigned char>(*p) - '0');
       }
       ++p;
     }
