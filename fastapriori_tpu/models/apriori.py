@@ -386,14 +386,15 @@ class FastApriori:
             )
             w_digits = ctx.shard_weight_digits(w_digits_np)
             heavy = self._upload_heavy(heavy_b, heavy_w)
+            heavy_rows, heavy_bytes = self._heavy_stats(heavy_b, heavy_w)
             m.update(
                 shape=[t_pad, f_pad],
                 digits=len(scales),
                 blocks=len(blocks),
-                heavy_rows=self._heavy_stats(heavy_b, heavy_w)[0],
+                heavy_rows=heavy_rows,
                 upload_bytes=upload_bytes
                 + w_digits_np.nbytes
-                + self._heavy_stats(heavy_b, heavy_w)[1],
+                + heavy_bytes,
             )
 
         data = CompressedData(
@@ -601,14 +602,15 @@ class FastApriori:
                 )
                 w_digits = ctx.shard_weight_digits(w_digits_np)
                 heavy = self._upload_heavy(heavy_b, heavy_w)
+                heavy_rows, heavy_bytes = self._heavy_stats(heavy_b, heavy_w)
                 m.update(
                     shape=[t_pad, f_pad],
                     digits=len(scales),
                     blocks=len(blocks),
-                    heavy_rows=self._heavy_stats(heavy_b, heavy_w)[0],
+                    heavy_rows=heavy_rows,
                     upload_bytes=state["upload_bytes"]
                     + w_digits_np.nbytes
-                    + self._heavy_stats(heavy_b, heavy_w)[1],
+                    + heavy_bytes,
                 )
         finally:
             upool.shutdown()

@@ -1038,6 +1038,7 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
   const int64_t n_tok = static_cast<int64_t>(p1.tok_ids.size());
   int64_t line_lo = 0;
   std::vector<int64_t> offs;
+  double replay_s = 0.0, cb_s = 0.0;  // FA_NATIVE_TIMING sub-phases
   for (int32_t b = 0; b < n_blocks && line_lo < p1.n_raw; ++b) {
     // First line whose token start reaches the nominal boundary.
     const int64_t tok_target = (n_tok * (b + 1)) / n_blocks;
@@ -1058,6 +1059,7 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
       break;
     }
     RankCollector rc(p1.f);
+    auto t_replay0 = std::chrono::steady_clock::now();
     for (int64_t li = line_lo; li < line_hi; ++li) {
       rc.reset_list();
       for (int64_t ti = p1.tok_offsets[li]; ti < p1.tok_offsets[li + 1];
@@ -1071,6 +1073,9 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
         break;
       }
     }
+    replay_s += std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t_replay0)
+                    .count();
     if (oom) {
       dd.arena.free_buf();
       break;
@@ -1080,10 +1085,19 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
       offs.resize(t + 1);
       for (int64_t i = 0; i < t; ++i) offs[i] = dd.b_off[i];
       offs[t] = static_cast<int64_t>(dd.arena.n);
+      auto t_cb0 = std::chrono::steady_clock::now();
       cb(cb_ctx, p1.f, t, offs.data(), dd.arena.p, dd.b_weight.data());
+      cb_s += std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t_cb0)
+                  .count();
     }
     dd.arena.free_buf();
     line_lo = line_hi;
+  }
+  if (timer.on) {
+    std::fprintf(stderr, "fa_native[pass2.replay_dedup]: %.3f s\n",
+                 replay_s);
+    std::fprintf(stderr, "fa_native[pass2.callback]: %.3f s\n", cb_s);
   }
   timer.mark("pass2_dedup_blocks");
   if (oom) return nullptr;
