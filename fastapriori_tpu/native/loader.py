@@ -105,6 +105,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_double,
             ctypes.c_int32,
+            ctypes.c_int32,
             _FA_BLOCK_CB,
             ctypes.c_void_p,
         ]
@@ -348,13 +349,16 @@ def has_preprocess_buffer_blocks() -> bool:
 
 
 def preprocess_buffer_blocks(
-    data: bytes, min_support: float, n_blocks: int, on_block
+    data: bytes, min_support: float, n_blocks: int, on_block,
+    n_threads: int = 1,
 ):
     """Capture-replay pipelined preprocessing: pass 1 + rank assignment +
     per-block pass-2 id replay in ONE native call (the raw bytes are
-    tokenized exactly once).  ``on_block(f, offsets int64[t+1],
-    items int32[nnz], weights int32[t])`` fires per block mid-call with
-    COPIES the callee owns.  Returns the global tables
+    tokenized exactly once).  ``n_threads > 1`` replays blocks on
+    std::threads; ``on_block(f, offsets int64[t+1], items int32[nnz],
+    weights int32[t])`` fires per block mid-call — always from the
+    calling thread, always in block order — with COPIES the callee
+    owns.  Returns the global tables
     ``(n_raw, min_count, freq_items, item_counts)``."""
     lib = get_lib()
     if lib is None or getattr(lib, "fa_preprocess_buffer_blocks", None) is None:
@@ -381,7 +385,8 @@ def preprocess_buffer_blocks(
             errs.append(e)
 
     res_ptr = lib.fa_preprocess_buffer_blocks(
-        data, len(data), ctypes.c_double(min_support), n_blocks, cb, None
+        data, len(data), ctypes.c_double(min_support), n_blocks,
+        max(n_threads, 1), cb, None
     )
     if not res_ptr:
         if errs:

@@ -29,15 +29,19 @@
 // fa_compress_with_ranks / fa_fill_packed_bitmap / fa_free_*.
 
 #include <algorithm>
+#include <atomic>
 #include <cctype>
 #include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <string_view>
 #include <unordered_map>
 #include <vector>
@@ -1023,7 +1027,8 @@ typedef void (*FaBlockCb)(void* ctx, int32_t f, int64_t n_baskets,
 
 FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
                                       double min_support, int32_t n_blocks,
-                                      FaBlockCb cb, void* cb_ctx) {
+                                      int32_t n_threads, FaBlockCb cb,
+                                      void* cb_ctx) {
   PhaseTimer timer;
   std::string_view buf(data, static_cast<size_t>(len));
 
@@ -1032,35 +1037,45 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
 
   // ---- pass 2: per-block replay + dedup + callback --------------------
   // Blocks split by TOKEN count (not line count) so work per block is
-  // even regardless of line-length skew.
+  // even regardless of line-length skew.  With n_threads > 1 the blocks
+  // replay on std::threads (each block has its own deduper; cross-block
+  // duplicates stay separate weighted rows) while the MAIN thread
+  // invokes cb strictly in block order — the caller sees the same
+  // deterministic stream either way.
   if (n_blocks < 1) n_blocks = 1;
-  bool oom = false;
-  const int64_t n_tok = static_cast<int64_t>(p1.tok_ids.size());
-  int64_t line_lo = 0;
-  std::vector<int64_t> offs;
-  double replay_s = 0.0, cb_s = 0.0;  // FA_NATIVE_TIMING sub-phases
-  for (int32_t b = 0; b < n_blocks && line_lo < p1.n_raw; ++b) {
-    // First line whose token start reaches the nominal boundary.
-    const int64_t tok_target = (n_tok * (b + 1)) / n_blocks;
-    int64_t line_hi = (b == n_blocks - 1) ? p1.n_raw : line_lo;
-    if (b != n_blocks - 1) {
-      line_hi = std::upper_bound(p1.tok_offsets.begin() + line_lo,
-                                 p1.tok_offsets.begin() + p1.n_raw,
-                                 tok_target - 1)
-                - p1.tok_offsets.begin();
-      if (line_hi <= line_lo) line_hi = line_lo + 1;
-      if (line_hi > p1.n_raw) line_hi = p1.n_raw;
+  if (n_threads < 1) n_threads = 1;
+  struct Range {
+    int64_t lo, hi;
+  };
+  std::vector<Range> ranges;
+  {
+    const int64_t n_tok = static_cast<int64_t>(p1.tok_ids.size());
+    int64_t line_lo = 0;
+    for (int32_t b = 0; b < n_blocks && line_lo < p1.n_raw; ++b) {
+      const int64_t tok_target = (n_tok * (b + 1)) / n_blocks;
+      int64_t line_hi = p1.n_raw;
+      if (b != n_blocks - 1) {
+        line_hi = std::upper_bound(p1.tok_offsets.begin() + line_lo,
+                                   p1.tok_offsets.begin() + p1.n_raw,
+                                   tok_target - 1)
+                  - p1.tok_offsets.begin();
+        if (line_hi <= line_lo) line_hi = line_lo + 1;
+        if (line_hi > p1.n_raw) line_hi = p1.n_raw;
+      }
+      ranges.push_back({line_lo, line_hi});
+      line_lo = line_hi;
     }
-    BasketDeduper dd;
-    if (!dd.arena.reserve(static_cast<size_t>(p1.tok_offsets[line_hi] -
-                                              p1.tok_offsets[line_lo]) +
-                          1)) {
-      oom = true;
-      break;
+  }
+
+  // Replay lines [lo, hi) into a fresh deduper.  False on OOM.
+  auto replay_block = [&p1](int64_t lo, int64_t hi, BasketDeduper& dd) {
+    if (!dd.arena.reserve(
+            static_cast<size_t>(p1.tok_offsets[hi] - p1.tok_offsets[lo]) +
+            1)) {
+      return false;
     }
     RankCollector rc(p1.f);
-    auto t_replay0 = std::chrono::steady_clock::now();
-    for (int64_t li = line_lo; li < line_hi; ++li) {
+    for (int64_t li = lo; li < hi; ++li) {
       rc.reset_list();
       for (int64_t ti = p1.tok_offsets[li]; ti < p1.tok_offsets[li + 1];
            ++ti) {
@@ -1068,36 +1083,90 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
       }
       const auto& ranks = rc.finish();
       if (ranks.size() <= 1) continue;
-      if (!dd.insert(ranks.data(), ranks.size())) {
-        oom = true;
-        break;
-      }
+      if (!dd.insert(ranks.data(), ranks.size())) return false;
     }
-    replay_s += std::chrono::duration<double>(
-                    std::chrono::steady_clock::now() - t_replay0)
-                    .count();
-    if (oom) {
-      dd.arena.free_buf();
-      break;
-    }
+    return true;
+  };
+
+  bool oom = false;
+  std::vector<int64_t> offs;
+  auto emit = [&](BasketDeduper& dd) {  // main thread only
     const int64_t t = static_cast<int64_t>(dd.b_off.size());
     if (t > 0) {
       offs.resize(t + 1);
       for (int64_t i = 0; i < t; ++i) offs[i] = dd.b_off[i];
       offs[t] = static_cast<int64_t>(dd.arena.n);
-      auto t_cb0 = std::chrono::steady_clock::now();
       cb(cb_ctx, p1.f, t, offs.data(), dd.arena.p, dd.b_weight.data());
+    }
+    dd.arena.free_buf();
+  };
+
+  if (n_threads == 1 || ranges.size() <= 1) {
+    double replay_s = 0.0, cb_s = 0.0;  // FA_NATIVE_TIMING sub-phases
+    for (const Range& r : ranges) {
+      BasketDeduper dd;
+      auto t_replay0 = std::chrono::steady_clock::now();
+      bool ok = replay_block(r.lo, r.hi, dd);
+      replay_s += std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t_replay0)
+                      .count();
+      if (!ok) {
+        dd.arena.free_buf();
+        oom = true;
+        break;
+      }
+      auto t_cb0 = std::chrono::steady_clock::now();
+      emit(dd);
       cb_s += std::chrono::duration<double>(
                   std::chrono::steady_clock::now() - t_cb0)
                   .count();
     }
-    dd.arena.free_buf();
-    line_lo = line_hi;
-  }
-  if (timer.on) {
-    std::fprintf(stderr, "fa_native[pass2.replay_dedup]: %.3f s\n",
-                 replay_s);
-    std::fprintf(stderr, "fa_native[pass2.callback]: %.3f s\n", cb_s);
+    if (timer.on) {
+      std::fprintf(stderr, "fa_native[pass2.replay_dedup]: %.3f s\n",
+                   replay_s);
+      std::fprintf(stderr, "fa_native[pass2.callback]: %.3f s\n", cb_s);
+    }
+  } else {
+    struct BlockOut {
+      BasketDeduper dd;
+      bool ok = false;
+      bool ready = false;
+    };
+    std::vector<BlockOut> outs(ranges.size());
+    std::mutex mu;
+    std::condition_variable cv;
+    std::atomic<size_t> next{0};
+    auto worker = [&]() {
+      while (true) {
+        const size_t b = next.fetch_add(1);
+        if (b >= ranges.size()) break;
+        BlockOut& o = outs[b];
+        o.ok = replay_block(ranges[b].lo, ranges[b].hi, o.dd);
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          o.ready = true;
+        }
+        cv.notify_all();
+      }
+    };
+    const size_t nt = std::min<size_t>(n_threads, ranges.size());
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    for (size_t i = 0; i < nt; ++i) threads.emplace_back(worker);
+    for (size_t b = 0; b < outs.size(); ++b) {
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return outs[b].ready; });
+      }
+      if (!outs[b].ok) {
+        outs[b].dd.arena.free_buf();
+        oom = true;
+        continue;  // drain remaining blocks' buffers below
+      }
+      if (!oom) emit(outs[b].dd);
+      else outs[b].dd.arena.free_buf();
+    }
+    for (auto& th : threads) th.join();
   }
   timer.mark("pass2_dedup_blocks");
   if (oom) return nullptr;
