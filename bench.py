@@ -159,14 +159,17 @@ def _parser():
         "--engine",
         choices=["auto", "fused", "level"],
         default="auto",
-        help="auto = try the fused engine in a time-boxed subprocess, "
-        "fall back to the per-level engine if it fails",
+        help="auto = the engine's own per-dataset choice (config.py); "
+        "without --data-file the run is additionally orchestrated in "
+        "time-boxed subprocesses so a hung backend still yields a result",
     )
     ap.add_argument(
         "--fused-budget-s",
         type=float,
-        default=420.0,
-        help="auto mode: wall-clock budget for the fused attempt",
+        default=3600.0,
+        help="orchestrated mode: wall-clock budget for the first "
+        "(engine-auto) attempt — bounds a hung backend, not the engine "
+        "choice (auto may legitimately run the level engine for a while)",
     )
     ap.add_argument(
         "--data-file",
@@ -179,10 +182,13 @@ def _parser():
 
 
 def _orchestrate(args) -> int:
-    """auto mode: run the fused engine in a subprocess with a wall-clock
-    budget (first compile of the whole-loop program can be slow on some
-    backends); if it produces no result line, rerun with the per-level
-    engine.  Guarantees exactly one JSON line on stdout."""
+    """Robustness wrapper for unattended runs (the driver invokes bench.py
+    with no flags): the engine-auto child runs in a subprocess with a
+    wall-clock budget (first compile of the whole-loop program can be slow
+    on some backends); if it produces no result line, rerun with the
+    per-level engine, then on cpu.  Engine CHOICE itself lives in the
+    miner (config.py engine="auto") — this wrapper only bounds hangs.
+    Guarantees exactly one JSON line on stdout."""
     import os
     import subprocess
     import tempfile
@@ -251,12 +257,12 @@ def _orchestrate(args) -> int:
         "--data-file", d_path,
     ] + (["--skip-baseline"] if args.skip_baseline else [])
     try:
-        # Attempt order: fused (budgeted), level, then — only when the
-        # default platform failed both (e.g. the tunnel died AFTER the
-        # probe) — the level engine on cpu.  The finite level timeout
-        # exists to bound a hung accelerator, so it applies only to the
-        # default platform; an explicit/fallback cpu run may legitimately
-        # take as long as it takes.
+        # Attempt order: engine-auto (budgeted), forced level, then —
+        # only when the default platform failed both (e.g. the tunnel
+        # died AFTER the probe) — the level engine on cpu.  The finite
+        # timeouts exist to bound a hung accelerator, so they apply only
+        # to the default platform; an explicit/fallback cpu run may
+        # legitimately take as long as it takes.
         #
         # On cpu (explicit or probe fallback) the fused whole-loop engine
         # is the WORST choice — it repeats padded-m_cap work every level
@@ -267,7 +273,7 @@ def _orchestrate(args) -> int:
             attempts = [("level", "cpu", None)]
         else:
             attempts = [
-                ("fused", args.platform, args.fused_budget_s),
+                ("auto", args.platform, args.fused_budget_s),
                 ("level", args.platform, 3600.0),
                 ("level", "cpu", None),
             ]
@@ -311,7 +317,8 @@ def _north_star_attach(args, platform) -> dict:
     """North-star fields folded into the single driver-parsed JSON line
     (VERDICT weak #5): when the driver invokes the default config, ALSO
     measure webdocs (1.7M txns @ minSupport=0.1 — the BASELINE.json
-    north-star run) with the level engine and report its txns/s, warm
+    north-star run) with ZERO engine flags — the engine's own auto
+    choice, the same path a user gets — and report its txns/s, warm
     wall and MFU as webdocs_* fields.  Best-effort: any failure or
     timeout leaves the main metric intact."""
     import os
@@ -362,7 +369,6 @@ def _north_star_attach(args, platform) -> dict:
                 "--min-support", str(min_support),
                 "--seed", str(args.seed),
                 "--data-file", cache,
-                "--engine", "level",
                 "--skip-baseline",
             ],
             stdout=subprocess.PIPE,
@@ -410,7 +416,7 @@ def _recommend_workload(args, raw, d_path) -> int:
     ]
     cfg = MinerConfig(
         min_support=args.min_support,
-        engine=args.engine if args.engine != "auto" else "fused",
+        engine=args.engine,
     )
     miner = FastApriori(config=cfg)
     itemsets, item_to_rank, freq_items = miner.run_file(d_path)
@@ -543,7 +549,10 @@ def main(argv=None) -> int:
     args.n_items, args.avg_len, args.style = n_items, avg_len, style
     if args.scaling:
         _scaling_report(args)
-    if args.engine == "auto":
+    if args.engine == "auto" and args.data_file is None:
+        # Unattended entry (the driver): wrap in time-boxed subprocesses.
+        # With --data-file the caller is iterating interactively — run the
+        # engine-auto path in-process (no child indirection to bound).
         return _orchestrate(args)
 
     import tempfile

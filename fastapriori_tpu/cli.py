@@ -65,10 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--engine",
-        choices=["fused", "level"],
-        default="fused",
-        help="mining engine: fused = whole level loop as one device "
-        "program; level = one kernel launch per level",
+        choices=["auto", "fused", "level"],
+        default="auto",
+        help="mining engine: auto = pick per dataset from the pair "
+        "pre-pass (fused when the lattice fits the row budget, level "
+        "otherwise); fused = whole level loop as one device program; "
+        "level = one kernel launch per level",
     )
     p.add_argument(
         "--distributed",

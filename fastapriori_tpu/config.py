@@ -71,11 +71,17 @@ class MinerConfig:
     # hosts) degenerates to the serial path with no overhead worth
     # noting.
     ingest_threads: Optional[int] = None
-    # Mining engine: "fused" = whole level loop as one on-device program
-    # (ops/fused.py), falling back to "level" (one kernel launch per level,
-    # host candidate generation) on row-budget overflow; "level" forces the
-    # per-level engine.
-    engine: str = "fused"
+    # Mining engine: "auto" (default) picks per dataset — the fused
+    # whole-loop program when the level-2 survivor budget AND the level-3
+    # candidate census (one extra matmul inside the pair pre-pass,
+    # ops/count.py _pair_triangles) both fit the memory-derived row-budget
+    # ceiling, else the per-level engine — so the zero-flag CLI path is
+    # always the fast path (the reference's driver has exactly one path,
+    # Main.scala:16-38).  "fused" forces the whole-loop attempt (falling
+    # back to "level" on row-budget overflow, with complete levels
+    # salvaged); "level" forces one kernel launch per level with host
+    # candidate generation.
+    engine: str = "auto"
     # Fused engine: floor for the starting per-level frequent-set row
     # budget (the budget itself is sized from the level-2 survivor count
     # pre-pass).  On overflow the engine re-compiles with a budget sized

@@ -33,6 +33,42 @@ def _psum_if(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
     return lax.psum(x, axis_name) if axis_name is not None else x
 
 
+# Item-axis bound for the in-kernel level-3 candidate census: the extra
+# [F, F] matmul is ~2·F³ flops (sub-ms on the MXU at 4096, but F³ grows
+# fast and sparse-item datasets — the ones with F in the tens of
+# thousands — never need the signal; their pair graphs are sparse and the
+# fused engine fits them anyway).
+TRI_F_CAP = 4096
+
+
+def _pair_triangles(mask: jnp.ndarray) -> jnp.ndarray:
+    """Level-3 candidate census from the frequent-pair mask: the number
+    of ordered triples ``x < y < z`` whose three pairs are all frequent —
+    exactly the k=3 Apriori candidate count after the full subset prune
+    (models/candidates.py), i.e. the triangles of the pair graph.
+
+    With ``U`` the upper-triangle adjacency, ``(U Uᵀ)[x, y]`` counts the
+    common larger neighbors ``z`` of x and y (``U[y, z]`` forces
+    ``z > y > x``), so the census is ``Σ_{(x,y) frequent} (U Uᵀ)[x, y]``
+    — one [F, F] matmul on the already-resident mask.  The engine's
+    auto-choice (models/apriori.py) uses it to predict the mid-lattice
+    blowup that the level-2 survivor count alone cannot see (a dense
+    217-item corpus and a sparse 1000-item basket set can have similar
+    pair counts but 20x different level-3 fan-outs).  f32 is exact for
+    the per-entry counts (bounded by F < 2^24); the total saturates at
+    2^30 — callers only compare it against row budgets ≤ 2^15.
+
+    Returns int32; callers with F above :data:`TRI_F_CAP` skip the
+    matmul and pass -1 ("not computed") instead."""
+    u = mask.astype(jnp.float32)
+    paths = lax.dot_general(
+        u, u, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    total = jnp.sum(jnp.where(mask, paths, 0.0))
+    return jnp.minimum(total, jnp.float32(2**30)).astype(jnp.int32)
+
+
 def _weighted_matmul(
     lhs_int8: jnp.ndarray,  # [T, P] int8 (0/1)
     bitmap: jnp.ndarray,  # [T, F] int8 (0/1)
@@ -175,11 +211,14 @@ def local_pair_gather(
 ) -> tuple:
     """C6, transfer-minimal form: the pair Gram matmul PLUS the threshold,
     on device.  Only surviving pairs leave the chip: returns
-    ``(flat_idx int32[cap], counts int32[cap], n2 int32)`` where the first
-    ``n2`` entries are the upper-triangle survivors in row-major order
-    (``i = idx // F``, ``j = idx % F``).  ``n2 > cap`` signals overflow —
-    the caller retries with a doubled cap.  Replaces transferring the full
-    [F, F] table (16 MB at F=2048) with ~2·cap·4 bytes.
+    ``(flat_idx int32[cap], counts int32[cap], n2 int32, tri int32)``
+    where the first ``n2`` entries are the upper-triangle survivors in
+    row-major order (``i = idx // F``, ``j = idx % F``) and ``tri`` is
+    the level-3 candidate census (:func:`_pair_triangles`; -1 when
+    F > TRI_F_CAP) that the engine's auto-choice reads.  ``n2 > cap``
+    signals overflow — the caller retries with a doubled cap.  Replaces
+    transferring the full [F, F] table (16 MB at F=2048) with
+    ~2·cap·4 bytes.
 
     ``fast_f32``: run the Gram matmul as ONE float32 matmul (BLAS path on
     CPU backends, where XLA int8 matmuls are orders slower).  Exact only
@@ -204,9 +243,10 @@ def local_pair_gather(
     upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
     mask = upper & (counts >= min_count)
     n2 = jnp.sum(mask, dtype=jnp.int32)
+    tri = _pair_triangles(mask) if f <= TRI_F_CAP else jnp.int32(-1)
     (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
     flat_idx = flat_idx.astype(jnp.int32)
-    return flat_idx, jnp.take(counts.reshape(-1), flat_idx), n2
+    return flat_idx, jnp.take(counts.reshape(-1), flat_idx), n2, tri
 
 
 def local_level_gather(

@@ -90,7 +90,7 @@ def _weighted_counts(common, bitmap, w, n_digits: int, fast_f32: bool):
 
 
 def _fused_mine_local(
-    packed,  # [T_local, F//8] uint8
+    packed,  # [T_local, F//8] uint8 — or [T_local, F] int8 (packed_input=False)
     w,  # [T_local] int32
     min_count,  # scalar int32
     *,
@@ -100,16 +100,20 @@ def _fused_mine_local(
     n_chunks: int,
     fast_f32: bool,
     axis_name: Optional[str],
+    packed_input: bool = True,
 ):
-    f = packed.shape[1] * 8
+    f = packed.shape[1] * 8 if packed_input else packed.shape[1]
     t_local = packed.shape[0]
     assert t_local % n_chunks == 0, (t_local, n_chunks)
     t_c = t_local // n_chunks
     # Transaction chunking bounds the [T_c, M] `common` intermediate so
     # HBM never holds a full [T, M] matrix at Webdocs scale; the scan
-    # accumulates the int32 count matrix across chunks.  The bitmap itself
-    # stays bit-packed in HBM — each chunk is unpacked transiently on the
-    # VPU, an 8x resident-memory saving.
+    # accumulates the int32 count matrix across chunks.  With
+    # ``packed_input`` the bitmap stays bit-packed in HBM — each chunk is
+    # unpacked transiently on the VPU, an 8x resident-memory saving;
+    # without it the engine hands over the ALREADY-resident unpacked int8
+    # bitmap (the pipelined-ingest path shares one device bitmap between
+    # both engines instead of paying a second upload).
     packed_c = packed.reshape(n_chunks, t_c, packed.shape[1])
     w_c = w.reshape(n_chunks, t_c)
     col_ids = jnp.arange(f, dtype=jnp.int32)
@@ -122,7 +126,7 @@ def _fused_mine_local(
 
         def step(acc, xs):
             pk, wk = xs
-            b = _unpack(pk)
+            b = _unpack(pk) if packed_input else pk
             return (
                 acc + _weighted_counts(project(b), b, wk, n_digits, fast_f32),
                 None,
@@ -264,9 +268,13 @@ def make_pair_counter(
     n_chunks: int = 1,
     fast_f32: bool = False,
 ):
-    """Cheap pre-pass over the same device-resident packed bitmap: the
-    number of frequent pairs (level-2 survivors).  The engine sizes the
-    fused program's row budget from this instead of guessing."""
+    """Cheap pre-pass over the same device-resident packed bitmap:
+    ``(n2, tri)`` — the number of frequent pairs (level-2 survivors) and
+    the level-3 candidate census (ops/count.py ``_pair_triangles``; -1
+    when F exceeds its matmul bound).  The engine sizes the fused
+    program's row budget from n2 and reads tri for the auto engine
+    choice."""
+    from fastapriori_tpu.ops.count import TRI_F_CAP, _pair_triangles
 
     def local(packed, w, min_count):
         f = packed.shape[1] * 8
@@ -287,8 +295,12 @@ def make_pair_counter(
         if mesh is not None:
             pair = lax.psum(pair, AXIS)
         col = jnp.arange(f, dtype=jnp.int32)
+        # Padded item columns have zero counts, so min_count >= 1 keeps
+        # them out of the mask (and out of the triangle census).
         mask = (pair >= min_count) & (col[None, :] > col[:, None])
-        return jnp.sum(mask, dtype=jnp.int32)
+        n2 = jnp.sum(mask, dtype=jnp.int32)
+        tri = _pair_triangles(mask) if f <= TRI_F_CAP else jnp.int32(-1)
+        return n2, tri
 
     if mesh is None:
         return jax.jit(local)
@@ -297,7 +309,7 @@ def make_pair_counter(
             local,
             mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS), P()),
-            out_specs=P(),
+            out_specs=(P(), P()),
         )
     )
 
@@ -309,11 +321,15 @@ def make_fused_miner(
     n_digits: int,
     n_chunks: int = 1,
     fast_f32: bool = False,
+    packed_input: bool = True,
 ):
     """Build the jitted fused mining program.  With a mesh, the bitmap and
     weights are sharded over the txn axis inside shard_map (psum
     reductions); without one, a plain single-device jit.  Returns the
-    packed [3*l_max+1, m_cap] int32 result (see _fused_mine_local)."""
+    packed [3*l_max+1, m_cap] int32 result (see _fused_mine_local).
+    ``packed_input=False`` takes the level engine's resident unpacked
+    int8 bitmap instead of the uint8 bit-packed form (pipelined-ingest
+    sharing)."""
     assert m_cap > l_max + 1, (m_cap, l_max)  # meta row layout requirement
     kernel = functools.partial(
         _fused_mine_local,
@@ -323,6 +339,7 @@ def make_fused_miner(
         n_chunks=n_chunks,
         fast_f32=fast_f32,
         axis_name=AXIS if mesh is not None else None,
+        packed_input=packed_input,
     )
     if mesh is None:
         return jax.jit(kernel)

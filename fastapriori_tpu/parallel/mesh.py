@@ -102,6 +102,7 @@ class DeviceContext:
         self._fns: Dict[Tuple[int, ...], Tuple] = {}
         self._fused_hints: Dict[Tuple, int] = {}
         self._fused_fails: set = set()
+        self._auto_level: set = set()
 
     # -- data placement ----------------------------------------------------
     def shard_bitmap(self, bitmap: np.ndarray) -> jax.Array:
@@ -293,15 +294,21 @@ class DeviceContext:
         n_digits: int,
         n_chunks: int = 1,
         fast_f32: bool = False,
+        packed_input: bool = True,
     ):
         """Jitted whole-loop mining program (ops/fused.py), cached per
-        static configuration."""
-        key = ("fused", m_cap, l_max, n_digits, n_chunks, fast_f32)
+        static configuration.  ``packed_input=False`` = the variant fed
+        by the level engine's resident unpacked bitmap."""
+        key = (
+            "fused", m_cap, l_max, n_digits, n_chunks, fast_f32,
+            packed_input,
+        )
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import make_fused_miner
 
             self._fns[key] = make_fused_miner(
-                self.mesh, m_cap, l_max, n_digits, n_chunks, fast_f32
+                self.mesh, m_cap, l_max, n_digits, n_chunks, fast_f32,
+                packed_input=packed_input,
             )
         return self._fns[key]
 
@@ -321,6 +328,17 @@ class DeviceContext:
 
     def record_fused_fail(self, profile: Tuple) -> None:
         self._fused_fails.add(profile)
+
+    def auto_level(self, profile: Tuple) -> bool:
+        """True when the auto engine choice (models/apriori.py) already
+        picked the level engine for this static profile — repeat runs
+        skip the decision pre-pass.  Separate from the fused-FAILURE memo
+        so a later explicitly-forced fused run is not blocked by a mere
+        auto decision."""
+        return profile in self._auto_level
+
+    def record_auto_level(self, profile: Tuple) -> None:
+        self._auto_level.add(profile)
 
     def replicate(self, x: np.ndarray) -> jax.Array:
         spec = P(*([None] * x.ndim))
@@ -382,7 +400,8 @@ class DeviceContext:
         cap: int, heavy_b=None, heavy_w=None, fast_f32: bool = False,
     ):
         """On-device pair threshold (ops/count.py local_pair_gather);
-        returns (flat_idx, counts, n2) numpy-convertible arrays.
+        returns (flat_idx, counts, n2, tri) numpy-convertible arrays
+        (tri = level-3 candidate census for the engine auto-choice).
         ``heavy_b``/``heavy_w``: replicated heavy-row remainder arrays
         (single-low-digit weight split) — None runs the legacy
         multi-digit form."""
@@ -408,7 +427,7 @@ class DeviceContext:
                     _local,
                     mesh=mesh,
                     in_specs=in_specs,
-                    out_specs=(P(None), P(None), P()),
+                    out_specs=(P(None), P(None), P(), P()),
                 )
             )
         args = [bitmap, w_digits, jnp.int32(min_count), jnp.int32(num_items)]

@@ -1,0 +1,226 @@
+"""Engine auto-selection (config.engine="auto", the default): the
+zero-flag path must BE the fast path (VERDICT r3 task 1).  The choice is
+made from the level-2 pair pre-pass — survivor count with 2x headroom AND
+the level-3 candidate census (ops/count.py _pair_triangles) against the
+memory-derived fused row-budget ceiling — so webdocs-class mid-lattice
+blowup goes straight to the level engine while small lattices get the
+one-dispatch fused program.  The reference has exactly one driver path
+(Main.scala:16-38); auto keeps ours one-path from the user's view."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu import oracle
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.models.apriori import FastApriori
+
+
+def _write_dat(tmp_path, lines):
+    p = tmp_path / "D.dat"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _events(miner, name):
+    return [r for r in miner.metrics.records if r["event"] == name]
+
+
+def _decoded(levels, data):
+    out = {}
+    for mat, cnts in levels:
+        for row, c in zip(mat.tolist(), cnts.tolist()):
+            out[frozenset(row)] = int(c)
+    return out
+
+
+def _native_pipelined_available():
+    from fastapriori_tpu.preprocess import _use_native
+
+    return _use_native(None, 1 << 62)
+
+
+needs_native = pytest.mark.skipif(
+    not _native_pipelined_available(),
+    reason="pipelined ingest needs the native preprocessor",
+)
+
+
+@needs_native
+def test_auto_picks_fused_on_small_lattice(tmp_path):
+    """Quest-style sparse data: auto must choose fused (engine_auto
+    choice event) and match the forced level engine bit-exactly."""
+    lines = random_dataset(11, n_items=60, n_txns=400, max_len=8)
+    d_path = _write_dat(tmp_path, lines)
+    auto = FastApriori(
+        config=MinerConfig(
+            min_support=0.02, engine="auto", num_devices=1, log_metrics=True
+        )
+    )
+    lv_a, data_a = auto.run_file_raw(d_path)
+    choices = _events(auto, "engine_auto")
+    assert choices and choices[0]["choice"] == "fused", choices
+    assert _events(auto, "fused_mine"), "fused engine did not run"
+
+    level = FastApriori(
+        config=MinerConfig(min_support=0.02, engine="level", num_devices=1)
+    )
+    lv_l, data_l = level.run_file_raw(d_path)
+    assert _decoded(lv_a, data_a) == _decoded(lv_l, data_l)
+
+
+@needs_native
+def test_auto_picks_level_on_lattice_blowup(tmp_path):
+    """A webdocs-shaped profile (level-3 census over the row-budget
+    ceiling — here the ceiling is pinned tiny) must go straight to the
+    level engine with NO fused attempt, and stay exact."""
+    lines = random_dataset(5, n_items=40, n_txns=300, max_len=10)
+    d_path = _write_dat(tmp_path, lines)
+    auto = FastApriori(
+        config=MinerConfig(
+            min_support=0.02,
+            engine="auto",
+            num_devices=1,
+            log_metrics=True,
+            # Pin the ceiling below this dataset's pair survivors so the
+            # auto rule must reject fused (webdocs-in-miniature).
+            fused_m_cap_max=32,
+        )
+    )
+    lv, data = auto.run_file_raw(d_path)
+    choices = _events(auto, "engine_auto")
+    assert choices and choices[0]["choice"] == "level", choices
+    assert not _events(auto, "fused_mine"), "doomed fused attempt ran"
+    expected, _, _ = oracle.mine(tokenized(lines), 0.02)
+    got = dict(auto._decode_levels(lv, data))
+    got.update(
+        (frozenset((r,)), int(c)) for r, c in enumerate(data.item_counts)
+    )
+    assert got == dict(expected)
+
+
+@needs_native
+def test_auto_warm_run_uses_memo(tmp_path):
+    """Second run of the same profile must skip the decision pre-pass:
+    fused-able data goes straight to ONE fused dispatch (no level-2
+    gather), level-bound data reuses the recorded choice."""
+    lines = random_dataset(11, n_items=60, n_txns=400, max_len=8)
+    d_path = _write_dat(tmp_path, lines)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.02, engine="auto", num_devices=1, log_metrics=True
+        )
+    )
+    miner.run_file_raw(d_path)
+    n_before = len(miner.metrics.records)
+    lv2, data2 = miner.run_file_raw(d_path)
+    warm = miner.metrics.records[n_before:]
+    assert [r for r in warm if r["event"] == "fused_mine"], warm
+    assert not [
+        r for r in warm if r["event"] == "level" and r.get("k") == 2
+    ], "warm fused run paid the pair gather"
+
+    # Level-bound profile: the memoized choice skips the fused machinery.
+    bound = FastApriori(
+        config=MinerConfig(
+            min_support=0.02,
+            engine="auto",
+            num_devices=1,
+            log_metrics=True,
+            fused_m_cap_max=32,
+        )
+    )
+    bound.run_file_raw(d_path)
+    n_before = len(bound.metrics.records)
+    bound.run_file_raw(d_path)
+    warm = bound.metrics.records[n_before:]
+    memo = [r for r in warm if r["event"] == "engine_auto"]
+    assert memo and memo[0].get("memo"), warm
+    assert not [r for r in warm if r["event"] == "fused_mine"]
+
+
+def test_auto_nonpipelined_prepass_bail():
+    """The in-memory (non-pipelined) path: auto with an over-tight
+    ceiling bails at the pair pre-pass — no fused_mine attempt — and the
+    level fallback stays exact (mine_levels_raw route)."""
+    lines = tokenized(random_dataset(5, n_txns=200, max_len=8))
+    expected, _, _ = oracle.mine(lines, 0.03)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.03,
+            engine="auto",
+            num_devices=1,
+            log_metrics=True,
+            fused_m_cap_max=32,
+        )
+    )
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    choices = _events(miner, "engine_auto")
+    assert choices and choices[0]["choice"] == "level"
+    assert not _events(miner, "fused_mine")
+
+
+def test_auto_nonpipelined_picks_fused():
+    """The in-memory path with a small lattice: auto runs fused."""
+    lines = tokenized(random_dataset(2, n_txns=150))
+    expected, _, _ = oracle.mine(lines, 0.05)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.05, engine="auto", num_devices=1, log_metrics=True
+        )
+    )
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    choices = _events(miner, "engine_auto")
+    assert choices and choices[0]["choice"] == "fused"
+    assert _events(miner, "fused_mine")
+
+
+def test_pair_triangle_census_matches_candidate_gen():
+    """The in-kernel level-3 census (cand3 on the k=2 level event) must
+    equal the actual k=3 candidate count the generator produces (the
+    census IS the post-prune candidate space: triangles of the pair
+    graph)."""
+    lines = tokenized(random_dataset(9, n_items=30, n_txns=250, max_len=9))
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.02, engine="level", num_devices=1, log_metrics=True
+        )
+    )
+    miner.run(lines)
+    k2 = [
+        r
+        for r in miner.metrics.records
+        if r["event"] == "level" and r.get("k") == 2
+    ]
+    k3 = [
+        r
+        for r in miner.metrics.records
+        if r["event"] == "level" and r.get("k") == 3
+    ]
+    assert k2 and k3
+    assert k2[0]["cand3"] == k3[0]["candidates"], (k2, k3)
+
+
+def test_auto_salvage_on_midlattice_overflow(tmp_path):
+    """When the census under-predicts (forced here by pinning the
+    headroom ceiling between n2 and the true peak), the fused overflow
+    salvage must hand complete levels to the level engine and the result
+    stays exact — auto never sacrifices correctness."""
+    # Deep identical baskets: n2 small, mid-lattice huge (C(10,5)=252).
+    lines = tokenized(
+        ["1 2 3 4 5 6 7 8 9 10"] * 30 + ["11 12"] * 5 + ["13"]
+    )
+    expected, _, _ = oracle.mine(lines, 0.2)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.2,
+            engine="auto",
+            num_devices=1,
+            log_metrics=True,
+            fused_m_cap_max=128,
+        )
+    )
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
