@@ -1296,22 +1296,24 @@ class FastApriori:
             # Level 2 (C6): one Gram matmul, thresholded ON DEVICE — only
             # the surviving pairs are transferred (local_pair_gather).
             with self.metrics.timed("level", k=2) as m:
-                cap = cfg.pair_cap
+                # Start from the recorded budget when this profile
+                # overflowed before, so repeat runs never re-pay the
+                # retry's extra dispatch.
+                cap_key = ("pair_cap", t_pad, f, min_count)
+                cap = max(cfg.pair_cap, ctx.pair_cap_hint(cap_key) or 0)
                 attempts = 0
                 hb, hw = heavy if heavy is not None else (None, None)
                 while True:
                     attempts += 1
-                    idx, cnt, n2, tri = (
-                        np.asarray(a)
-                        for a in ctx.pair_gather(
-                            bitmap, w_digits, scales, min_count, f, cap,
-                            heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
-                        )
+                    idx, cnt, n2, tri = ctx.pair_gather(
+                        bitmap, w_digits, scales, min_count, f, cap,
+                        heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
                     )
-                    n2 = int(n2)
                     if n2 <= cap:
                         break
                     cap = _next_pow2(n2)
+                if attempts > 1:
+                    ctx.record_pair_cap(cap_key, cap)
                 f_pad = bitmap.shape[1]
                 idx, cnt = idx[:n2], cnt[:n2]
                 cur = np.stack([idx // f_pad, idx % f_pad], axis=1).astype(

@@ -258,3 +258,27 @@ def test_level_engine_heavy_split_cap_fallback():
     miner.HEAVY_SPLIT_CAP = 8  # force the fallback (40 heavy rows)
     got, _, _ = miner.run(lines)
     assert dict(got) == dict(expected)
+
+
+def test_pair_cap_overflow_retry_and_hint():
+    """A pair_cap below the survivor count must retry (exact result) and
+    record the grown budget so the second run pays ONE dispatch (the
+    k=2 macs halve — each retry attempt re-runs the full Gram matmul)."""
+    lines = tokenized(random_dataset(3, n_txns=200, max_len=8))
+    expected, _, _ = oracle.mine(lines, 0.02)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.02, engine="level", num_devices=1, pair_cap=8,
+            log_metrics=True,
+        )
+    )
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    miner.run(lines)
+    k2 = [
+        r
+        for r in miner.metrics.records
+        if r["event"] == "level" and r.get("k") == 2
+    ]
+    assert len(k2) == 2
+    assert k2[1]["macs"] < k2[0]["macs"], "grown pair cap not remembered"
