@@ -106,6 +106,20 @@ class MinerConfig:
     fused_hbm_fraction: float = 0.5
     # Fused engine: max Apriori levels held in the output buffers.
     fused_l_max: int = 24
+    # Shallow-tail fold (level engine): once a level's survivor count
+    # drops to this threshold, the REMAINING loop runs as ONE seeded
+    # device program (ops/fused.py make_tail_miner) instead of one
+    # ~110 ms launch per level.  None = auto (16384 on accelerators,
+    # disabled on cpu where there is no launch floor to amortize and
+    # every distinct seed depth would pay a while-loop compile); 0
+    # disables; an explicit value forces, platform-independent.
+    tail_fuse_rows: Optional[int] = None
+    # Tail fold: compacted candidate-prefix budget per iteration (the
+    # counting matmul runs [p_cap, F] rows, not [m_cap, F]) and the max
+    # tail depth per dispatch (overflowing either resumes the per-level
+    # engine from the last complete level).
+    tail_fuse_p_cap: int = 2048
+    tail_fuse_l_max: int = 8
     # Fused engine: per-device transaction-chunk target — bounds the
     # [chunk, m_cap] containment intermediate in HBM (the scan over chunks
     # accumulates counts).

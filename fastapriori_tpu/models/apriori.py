@@ -1301,19 +1301,21 @@ class FastApriori:
                 # retry's extra dispatch.
                 cap_key = ("pair_cap", t_pad, f, min_count)
                 cap = max(cfg.pair_cap, ctx.pair_cap_hint(cap_key) or 0)
-                attempts = 0
                 hb, hw = heavy if heavy is not None else (None, None)
-                while True:
-                    attempts += 1
-                    idx, cnt, n2, tri = ctx.pair_gather(
-                        bitmap, w_digits, scales, min_count, f, cap,
-                        heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
-                    )
-                    if n2 <= cap:
-                        break
+                idx, cnt, n2, tri, counts_dev = ctx.pair_gather(
+                    bitmap, w_digits, scales, min_count, f, cap,
+                    heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
+                )
+                if n2 > cap:
+                    # Overflow: re-extract at the exact budget over the
+                    # RESIDENT count matrix — no Gram re-run, no matmul
+                    # compile (mesh.pair_regather).
                     cap = _next_pow2(n2)
-                if attempts > 1:
+                    idx, cnt, _ = ctx.pair_regather(
+                        counts_dev, min_count, f, cap
+                    )
                     ctx.record_pair_cap(cap_key, cap)
+                del counts_dev  # free the [F, F] matrix promptly
                 f_pad = bitmap.shape[1]
                 idx, cnt = idx[:n2], cnt[:n2]
                 cur = np.stack([idx // f_pad, idx % f_pad], axis=1).astype(
@@ -1325,8 +1327,8 @@ class FastApriori:
                     candidates=f * (f - 1) // 2,
                     frequent=n2,
                     cand3=int(tri),
-                    macs=attempts * d_eff * t_pad * f_pad * f_pad,
-                    psum_bytes=attempts * 4 * f_pad * f_pad,
+                    macs=d_eff * t_pad * f_pad * f_pad,
+                    psum_bytes=4 * f_pad * f_pad,
                 )
             if need_n2:
                 # Cold path: the pair gather above doubles as the fused
@@ -1350,8 +1352,31 @@ class FastApriori:
 
         # Levels >=3 (C7 + C8), reference termination rule
         # (FastApriori.scala:111).
+        tail_rows = cfg.tail_fuse_rows
+        if tail_rows is None:
+            # Auto: the fold amortizes the per-launch round-trip floor,
+            # which cpu backends don't have (and every distinct seed
+            # depth would pay a fresh while-loop compile there).
+            tail_rows = 0 if ctx.platform == "cpu" else 16384
+        tail_ok = (
+            tail_rows > 0
+            and ctx.cand_shards == 1
+            and data.shard is None
+        )
         k = cur.shape[1] + 1
         while cur.shape[0] >= k:
+            if tail_ok and k > 3 and cur.shape[0] <= tail_rows:
+                tail, complete = self._mine_tail(
+                    data, bitmap, w_digits, scales, cur, n_chunks, heavy
+                )
+                tail_ok = False  # one fold per run (re-trigger can't help)
+                if tail:
+                    levels.extend(tail)
+                    cur = tail[-1][0]
+                    k = cur.shape[1] + 1
+                if complete:
+                    return levels
+                continue  # incomplete: per-level from the last good level
             with self.metrics.timed("level", k=k) as m:
                 nxt, nxt_counts, lvl_stats = self._count_level(
                     ctx,
@@ -1370,6 +1395,96 @@ class FastApriori:
             cur = nxt
             k += 1
         return levels
+
+    def _mine_tail(
+        self, data, bitmap, w_digits, scales, cur: np.ndarray,
+        n_chunks: int, heavy: Optional[tuple],
+    ) -> Tuple[list, bool]:
+        """Shallow-tail fold: mine every remaining level in ONE dispatch
+        seeded from the current level matrix (ops/fused.py
+        _tail_mine_local — the inverse of the fused→level salvage).
+        Returns ``(complete tail levels, loop_finished)``; on overflow
+        or depth bound the caller resumes per-level counting from the
+        last complete level."""
+        from fastapriori_tpu.ops import fused
+
+        cfg = self.config
+        ctx = self.context
+        n0, k0 = cur.shape
+        t_pad, f_pad = bitmap.shape
+        # No 2x headroom (unlike the fused engine's budget): in a
+        # shrinking tail the SEED is the largest level, and the [m, m]
+        # candidate-gen intermediates are the memory wall (8·m² bytes —
+        # headroom at webdocs' 12042-row fold point is the difference
+        # between 2.1 GB and an infeasible 8.6 GB).  A growing tail
+        # overflows the budget and falls back per-level, exact either
+        # way.
+        m_cap = max(
+            _next_pow2(n0),
+            cfg.min_prefix_bucket,
+            _next_pow2(cfg.tail_fuse_l_max + 2),
+        )
+        # The memory model is the fused engine's (conservative: the tail
+        # counts over p_cap rows, not m_cap) — skip the fold rather than
+        # compile a program that could OOM.
+        if m_cap > _fused_m_cap_memory_limit(
+            cfg, ctx, t_pad, f_pad, n_chunks, unpacked_resident=True
+        ):
+            return [], False
+        p_cap = min(cfg.tail_fuse_p_cap, m_cap)
+        # The level engine's chunk count bounds a [t_c, P] intermediate
+        # sized for its own prefix caps; the tail's [t_c, p_cap] is
+        # narrower, so consolidate chunks (fewer scan steps per
+        # iteration — at webdocs scale 104 steps of per-step scan
+        # overhead were ~40% of the fold's wall).
+        tail_chunks = n_chunks
+        per_dev = t_pad // max(ctx.txn_shards, 1)
+        while (
+            tail_chunks % 2 == 0
+            and (per_dev // (tail_chunks // 2)) * p_cap * 4 <= (768 << 20)
+        ):
+            tail_chunks //= 2
+        seed = np.zeros((m_cap, k0), np.int32)
+        seed[:n0] = cur
+        hb, hw = heavy if heavy is not None else (None, None)
+        with self.metrics.timed(
+            "tail_fuse", k0=k0, m_cap=m_cap, p_cap=p_cap,
+            n_chunks=tail_chunks,
+        ) as met:
+            fn = ctx.tail_miner(
+                scales, k0, m_cap, p_cap, cfg.tail_fuse_l_max, tail_chunks,
+                heavy is not None,
+            )
+            args = [
+                bitmap, w_digits, ctx.replicate(seed), jnp.int32(n0),
+                jnp.int32(data.min_count),
+            ]
+            if heavy is not None:
+                args += [hb, hw]
+            packed_out = np.asarray(fn(*args))
+            rows, cols, counts, n_lvl, incomplete, _ = (
+                fused.unpack_fused_result(packed_out, cfg.tail_fuse_l_max)
+            )
+            # MACs: per stored level, candidate gen (two [m_cap, m_cap]
+            # f32 matmuls) + membership/counting over the compacted
+            # [p_cap] prefix rows.
+            n_iters = max(int(np.count_nonzero(n_lvl)), 1)
+            d_eff = len(scales)
+            met.update(
+                levels=int(np.count_nonzero(n_lvl)),
+                incomplete=bool(incomplete),
+                macs=n_iters
+                * (
+                    2 * m_cap * m_cap * f_pad
+                    + (1 + d_eff) * t_pad * p_cap * f_pad
+                ),
+                psum_bytes=n_iters * 4 * p_cap * f_pad,
+                upload_bytes=seed.nbytes * ctx.n_devices,
+            )
+        lvls = fused.decode_level_matrices(
+            rows, cols, counts, n_lvl, max_rows=m_cap, prev=cur
+        )
+        return lvls, not bool(incomplete)
 
     def _count_level(
         self,

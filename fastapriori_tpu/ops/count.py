@@ -211,14 +211,16 @@ def local_pair_gather(
 ) -> tuple:
     """C6, transfer-minimal form: the pair Gram matmul PLUS the threshold,
     on device.  Only surviving pairs leave the chip: returns
-    ``(flat_idx int32[cap], counts int32[cap], n2 int32, tri int32)``
-    where the first ``n2`` entries are the upper-triangle survivors in
-    row-major order (``i = idx // F``, ``j = idx % F``) and ``tri`` is
-    the level-3 candidate census (:func:`_pair_triangles`; -1 when
-    F > TRI_F_CAP) that the engine's auto-choice reads.  ``n2 > cap``
-    signals overflow — the caller retries with a doubled cap.  Replaces
-    transferring the full [F, F] table (16 MB at F=2048) with
-    ~2·cap·4 bytes.
+    ``(flat_idx int32[cap], counts int32[cap], n2 int32, tri int32,
+    counts_mat int32[F, F])`` where the first ``n2`` entries are the
+    upper-triangle survivors in row-major order (``i = idx // F``,
+    ``j = idx % F``) and ``tri`` is the level-3 candidate census
+    (:func:`_pair_triangles`; -1 when F > TRI_F_CAP) that the engine's
+    auto-choice reads.  ``counts_mat`` is the full psum'd count matrix —
+    callers keep it DEVICE-RESIDENT (never fetched) so an ``n2 > cap``
+    overflow re-extracts survivors via :func:`local_pair_regather`
+    without re-running the Gram.  Replaces transferring the full [F, F]
+    table (16 MB at F=2048) with ~2·cap·4 bytes.
 
     ``fast_f32``: run the Gram matmul as ONE float32 matmul (BLAS path on
     CPU backends, where XLA int8 matmuls are orders slower).  Exact only
@@ -246,7 +248,29 @@ def local_pair_gather(
     tri = _pair_triangles(mask) if f <= TRI_F_CAP else jnp.int32(-1)
     (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
     flat_idx = flat_idx.astype(jnp.int32)
-    return flat_idx, jnp.take(counts.reshape(-1), flat_idx), n2, tri
+    return flat_idx, jnp.take(counts.reshape(-1), flat_idx), n2, tri, counts
+
+
+def local_pair_regather(
+    counts: jnp.ndarray,  # [F, F] int32 — resident psum'd pair counts
+    min_count: jnp.ndarray,
+    num_items: jnp.ndarray,
+    cap: int,
+) -> tuple:
+    """Survivor re-extraction at a larger ``cap`` over the ALREADY
+    computed (device-resident) pair-count matrix: the overflow retry of
+    :func:`local_pair_gather` must not re-run the Gram matmul, and —
+    since this kernel has no matmul — its one-off XLA compile is cheap
+    too (re-compiling the full gather at a new static cap cost seconds,
+    to save a one-time payload).  Returns ``(flat_idx, counts, n2)``."""
+    f = counts.shape[0]
+    iu = jnp.arange(f)
+    upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
+    mask = upper & (counts >= min_count)
+    n2 = jnp.sum(mask, dtype=jnp.int32)
+    (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
+    flat_idx = flat_idx.astype(jnp.int32)
+    return flat_idx, jnp.take(counts.reshape(-1), flat_idx), n2
 
 
 def local_level_gather(

@@ -313,6 +313,31 @@ class DeviceContext:
             )
         return self._fns[key]
 
+    def tail_miner(
+        self,
+        scales: Tuple[int, ...],
+        k0: int,
+        m_cap: int,
+        p_cap: int,
+        l_max: int,
+        n_chunks: int,
+        has_heavy: bool,
+    ):
+        """Jitted shallow-tail program (ops/fused.py make_tail_miner),
+        cached per static configuration (one compile per seed depth)."""
+        key = (
+            "tail", tuple(scales), k0, m_cap, p_cap, l_max, n_chunks,
+            has_heavy,
+        )
+        if key not in self._fns:
+            from fastapriori_tpu.ops.fused import make_tail_miner
+
+            self._fns[key] = make_tail_miner(
+                self.mesh, tuple(scales), k0, m_cap, p_cap, l_max,
+                n_chunks, has_heavy,
+            )
+        return self._fns[key]
+
     def fused_m_cap_hint(self, profile: Tuple) -> Optional[int]:
         """Last row budget that compiled AND completed for this static
         profile — lets repeat runs skip the pair-count sizing pre-pass."""
@@ -412,12 +437,14 @@ class DeviceContext:
     ):
         """On-device pair threshold (ops/count.py local_pair_gather);
         returns ``(flat_idx int32[cap], counts int32[cap], n2 int, tri
-        int)`` as HOST values (tri = level-3 candidate census for the
-        engine auto-choice).  The kernel packs all four outputs into one
-        int32 array so the host pays ONE device→host fetch: on a
-        tunneled chip every separate fetch is a full ~110 ms round trip,
-        and the previous four-output form spent ~400 ms of the pair
-        phase on three extra round trips (VERDICT r3 weak #3).
+        int, counts_dev)`` — the first four as HOST values (tri =
+        level-3 candidate census for the engine auto-choice), the last
+        the UNFETCHED device-resident [F, F] count matrix for
+        :meth:`pair_regather`.  The kernel packs the host-bound outputs
+        into one int32 array so the host pays ONE device→host fetch: on
+        a tunneled chip every separate fetch is a full ~110 ms round
+        trip, and the previous four-output form spent ~400 ms of the
+        pair phase on three extra round trips (VERDICT r3 weak #3).
         ``heavy_b``/``heavy_w``: replicated heavy-row remainder arrays
         (single-low-digit weight split) — None runs the legacy
         multi-digit form."""
@@ -429,12 +456,13 @@ class DeviceContext:
 
             def _local(bitmap, w_digits, min_count, num_items, *hv):
                 hb, hw = hv if hv else (None, None)
-                idx, cnt, n2, tri = count_ops.local_pair_gather(
+                idx, cnt, n2, tri, counts = count_ops.local_pair_gather(
                     bitmap, w_digits, scl, min_count, num_items, cap,
                     heavy_b=hb, heavy_w=hw,
                     axis_name=AXIS, fast_f32=fast_f32,
                 )
-                return jnp.concatenate([idx, cnt, jnp.stack([n2, tri])])
+                packed = jnp.concatenate([idx, cnt, jnp.stack([n2, tri])])
+                return packed, counts
 
             in_specs = (P(AXIS, None), P(None, AXIS), P(), P()) + (
                 (P(None, None), P(None)) if has_heavy else ()
@@ -444,19 +472,44 @@ class DeviceContext:
                     _local,
                     mesh=mesh,
                     in_specs=in_specs,
-                    out_specs=P(None),
+                    out_specs=(P(None), P(None, None)),
                 )
             )
         args = [bitmap, w_digits, jnp.int32(min_count), jnp.int32(num_items)]
         if has_heavy:
             args += [heavy_b, heavy_w]
-        out = np.asarray(self._fns[key](*args))
+        packed, counts_dev = self._fns[key](*args)
+        out = np.asarray(packed)
         return (
             out[:cap],
             out[cap : 2 * cap],
             int(out[2 * cap]),
             int(out[2 * cap + 1]),
+            counts_dev,
         )
+
+    def pair_regather(self, counts_dev, min_count: int, num_items: int,
+                      cap: int):
+        """Overflow retry of :meth:`pair_gather` over the resident count
+        matrix (ops/count.py local_pair_regather): no Gram re-run, and a
+        matmul-free one-off compile.  Returns host ``(flat_idx, counts,
+        n2)``."""
+        key = ("pair_regather", cap)
+        if key not in self._fns:
+
+            def _re(counts, min_count, num_items):
+                idx, cnt, n2 = count_ops.local_pair_regather(
+                    counts, min_count, num_items, cap
+                )
+                return jnp.concatenate([idx, cnt, n2[None]])
+
+            self._fns[key] = jax.jit(_re)
+        out = np.asarray(
+            self._fns[key](
+                counts_dev, jnp.int32(min_count), jnp.int32(num_items)
+            )
+        )
+        return out[:cap], out[cap : 2 * cap], int(out[2 * cap])
 
     def level_gather_batch(
         self,

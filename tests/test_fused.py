@@ -185,3 +185,89 @@ def space_budget_for_m(m_target):
     # From _fused_m_cap_memory_limit's bytes_at with small t_c/f_pad the
     # quadratic 8*m^2 term dominates; give 2x headroom over it.
     return 16 * m_target * m_target
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_tail_fold_matches_oracle(n_devices):
+    """Shallow-tail fold (ops/fused.py _tail_mine_local): forcing the
+    fold threshold makes the level engine hand the whole tail to one
+    seeded device program; results must stay oracle-exact."""
+    lines = tokenized(
+        random_dataset(2, n_txns=150, max_len=8)
+        + ["1 2 3 4 5 6 7"] * 20
+    )
+    expected, _, _ = oracle.mine(lines, 0.04)
+    cfg = MinerConfig(
+        min_support=0.04, engine="level", num_devices=n_devices,
+        tail_fuse_rows=1 << 20, log_metrics=True,
+    )
+    miner = FastApriori(config=cfg)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    tails = [
+        r for r in miner.metrics.records if r["event"] == "tail_fuse"
+    ]
+    assert tails and tails[0]["levels"] >= 2, tails
+
+
+def test_tail_fold_p_cap_overflow_falls_back():
+    """A candidate-prefix count above p_cap marks the level invalid; the
+    engine must resume per-level counting from the last complete level
+    and stay exact."""
+    lines = tokenized(
+        random_dataset(2, n_txns=150, max_len=8)
+        + ["1 2 3 4 5 6 7"] * 20
+    )
+    expected, _, _ = oracle.mine(lines, 0.04)
+    cfg = MinerConfig(
+        min_support=0.04, engine="level", num_devices=1,
+        tail_fuse_rows=1 << 20, tail_fuse_p_cap=2, log_metrics=True,
+    )
+    miner = FastApriori(config=cfg)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    tails = [
+        r for r in miner.metrics.records if r["event"] == "tail_fuse"
+    ]
+    assert tails and tails[0]["incomplete"], tails
+    # The per-level engine finished the job after the failed fold.
+    assert [
+        r for r in miner.metrics.records
+        if r["event"] == "level" and r.get("k", 0) >= 4
+    ]
+
+
+def test_tail_fold_depth_bound_falls_back():
+    """More remaining levels than tail_fuse_l_max: fold what fits, then
+    resume per-level (and possibly fold again is NOT allowed — one fold
+    per run); exactness holds."""
+    lines = tokenized(["1 2 3 4 5 6 7 8 9 10 11 12"] * 30 + ["13 14"] * 3)
+    expected, _, _ = oracle.mine(lines, 0.2)
+    cfg = MinerConfig(
+        min_support=0.2, engine="level", num_devices=1,
+        tail_fuse_rows=1 << 20, tail_fuse_l_max=3, log_metrics=True,
+    )
+    miner = FastApriori(config=cfg)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+
+
+def test_tail_fold_with_heavy_weight_split():
+    """Tail counting must include the heavy-row int32 correction (rows
+    with multiplicity >= 128 under the single-low-digit split)."""
+    lines = tokenized(
+        ["1 2 3 4 5"] * 200 + ["1 2 3 4"] * 40 + ["2 3 4 5 6"] * 9
+        + ["6 7"] * 3
+    )
+    ms = 8.0 / len(lines)
+    expected, _, _ = oracle.mine(lines, ms)
+    cfg = MinerConfig(
+        min_support=ms, engine="level", num_devices=1,
+        tail_fuse_rows=1 << 20, log_metrics=True,
+    )
+    miner = FastApriori(config=cfg)
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    assert [
+        r for r in miner.metrics.records if r["event"] == "tail_fuse"
+    ]

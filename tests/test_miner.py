@@ -260,10 +260,10 @@ def test_level_engine_heavy_split_cap_fallback():
     assert dict(got) == dict(expected)
 
 
-def test_pair_cap_overflow_retry_and_hint():
-    """A pair_cap below the survivor count must retry (exact result) and
-    record the grown budget so the second run pays ONE dispatch (the
-    k=2 macs halve — each retry attempt re-runs the full Gram matmul)."""
+def test_pair_cap_overflow_regather_and_hint():
+    """A pair_cap below the survivor count must re-extract over the
+    resident count matrix (exact result, no Gram re-run) and record the
+    grown budget so the second run needs no regather at all."""
     lines = tokenized(random_dataset(3, n_txns=200, max_len=8))
     expected, _, _ = oracle.mine(lines, 0.02)
     miner = FastApriori(
@@ -274,11 +274,8 @@ def test_pair_cap_overflow_retry_and_hint():
     )
     got, _, _ = miner.run(lines)
     assert dict(got) == dict(expected)
-    miner.run(lines)
-    k2 = [
-        r
-        for r in miner.metrics.records
-        if r["event"] == "level" and r.get("k") == 2
-    ]
-    assert len(k2) == 2
-    assert k2[1]["macs"] < k2[0]["macs"], "grown pair cap not remembered"
+    # The grown budget was recorded against this profile...
+    assert miner.context._pair_caps, "grown pair cap not remembered"
+    # ...and a repeat run is still exact (single dispatch path).
+    got2, _, _ = miner.run(lines)
+    assert dict(got2) == dict(expected)
