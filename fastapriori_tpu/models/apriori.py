@@ -1570,13 +1570,41 @@ class FastApriori:
                 max(cfg.level_prefix_cap // n_cs, 1),
             )
             p_cap = p_sh * n_cs
-            # Candidate budget right-sized the same way: the [C_cap]
-            # cand_idx upload and result fetch are per-dispatch fixed
-            # bytes on the host link — a near-empty level must not ship
-            # the full cap.
+            # Chunk boundaries first (pass 1), array materialization
+            # second — the candidate budget must be sized from the MAX
+            # PER-CHUNK candidate count, not the whole block's: with a
+            # 16K-prefix chunk and ~2 extensions/prefix, sizing from the
+            # block total shipped a 1 MB cand_idx per chunk of which
+            # ~87% was padding (multi-MB per big level on the host
+            # link).  Boundaries are computed against the configured
+            # ceiling (c_cap_max >= f_pad by construction, so any single
+            # prefix run — < F extensions — fits).
+            c_bound = c_cap_max
+            chunk_descs = []  # per chunk: list of (start, end, base, n_c)
+            start = 0  # index into uniq_x
+            while start < uniq_x.size:
+                shards = []
+                for sh in range(n_cs):
+                    if start >= uniq_x.size:
+                        break
+                    hi = min(start + p_sh, uniq_x.size)
+                    base = run_start[start]
+                    end = int(
+                        np.searchsorted(
+                            run_end[start:hi] - base, c_bound, side="right"
+                        )
+                    )
+                    end = start + max(end, 1)
+                    shards.append(
+                        (start, end, base, int(run_end[end - 1] - base))
+                    )
+                    start = end
+                chunk_descs.append(shards)
             c_sh = min(
                 max(
-                    _next_pow2(-(-x_idx.size // n_cs)),
+                    _next_pow2(
+                        max(n_c for sh_l in chunk_descs for *_, n_c in sh_l)
+                    ),
                     f_pad,
                 ),
                 c_cap_max,
@@ -1585,40 +1613,25 @@ class FastApriori:
             pcs = []  # per-block-chunk compact prefix tables
             cis = []  # per-block-chunk flat candidate indexes
             placed_all = []  # per-block-chunk placement lists
-            start = 0  # index into uniq_x
-            while start < uniq_x.size:
+            for shards in chunk_descs:
                 prefix_cols = np.full((p_cap, k_pad), zcol, dtype=cols_dt)
                 cand_idx = np.zeros(c_cap, dtype=np.int32)
                 placed = []  # (counts slice, offset in cand_idx, length)
-                for sh in range(n_cs):
-                    if start >= uniq_x.size:
-                        break
-                    hi = min(start + p_sh, uniq_x.size)
-                    # Largest end with candidates <= c_sh (>= 1 prefix; a
-                    # single prefix has < F <= c_sh extensions).
-                    base = run_start[start]
-                    end = int(
-                        np.searchsorted(
-                            run_end[start:hi] - base, c_sh, side="right"
-                        )
-                    )
-                    end = start + max(end, 1)
-                    n_p = end - start
-                    n_c = int(run_end[end - 1] - base)
+                for sh, (c_start, c_end, base, n_c) in enumerate(shards):
+                    n_p = c_end - c_start
                     prefix_cols[sh * p_sh : sh * p_sh + n_p, :s] = level[
-                        uniq_x[start:end]
+                        uniq_x[c_start:c_end]
                     ]
                     ci = slice(base, base + n_c)
                     # Row indexes are LOCAL to the shard's prefix block —
                     # each cand shard sees only its own [p_sh, F] counts.
                     row_of_cand = (
-                        np.searchsorted(uniq_x, x_idx[ci]) - start
+                        np.searchsorted(uniq_x, x_idx[ci]) - c_start
                     ).astype(np.int64)
                     cand_idx[sh * c_sh : sh * c_sh + n_c] = (
                         row_of_cand * f_pad + ys[ci]
                     )
                     placed.append((ci, sh * c_sh, n_c))
-                    start = end
                 pcs.append(prefix_cols)
                 cis.append(cand_idx)
                 placed_all.append(placed)
@@ -1626,12 +1639,16 @@ class FastApriori:
             # ~100+ ms of fixed round-trip cost on tunneled backends (the
             # runtime does not pipeline them), so the chunks ride a
             # device-side scan instead of separate dispatches.  The block
-            # axis pads to a power of two (same bucketing rationale as
-            # p_cap/c_cap: one compile per bucket, not per distinct NB);
-            # dummy chunks are all-zcol prefixes whose counts nothing
-            # reads (`placed` covers only real chunks).
+            # axis pads to a BUCKET — pow2 up to 16, then multiples of 8:
+            # dummy chunks run the full-size matmuls (a scan step cannot
+            # be skipped), so pure pow2 buckets wasted up to ~2x device
+            # work on big levels, while finer buckets would multiply the
+            # distinct compiled scan shapes (each a multi-second XLA
+            # compile on a tunneled backend).  Multiples of 8 cap the
+            # waste at 7 chunks with at most a handful of shapes.
             nb = len(pcs)
-            for _ in range(_next_pow2(nb) - nb):
+            nb_pad = _next_pow2(nb) if nb <= 16 else -(-nb // 8) * 8
+            for _ in range(nb_pad - nb):
                 pcs.append(np.full((p_cap, k_pad), zcol, dtype=cols_dt))
                 cis.append(np.zeros(c_cap, dtype=np.int32))
             hb, hw = heavy if heavy is not None else (None, None)
@@ -1654,11 +1671,13 @@ class FastApriori:
             inflight.append((placed_all, out, counts_blk))
             # Per-launch cost model (metrics/MFU): membership matmul
             # [T, P_cap] + counting matmuls [P_cap, F] over padded
-            # global shapes per scanned chunk; psum reduces each
-            # [C_cap] gather.
+            # global shapes per scanned chunk — including the padding
+            # chunks, which execute the full-size matmuls (the MFU
+            # figure must reflect what the device actually ran); psum
+            # reduces each [C_cap] gather.
             stats["dispatches"] += 1
-            stats["macs"] += nb * (1 + d_eff) * t_pad * p_cap * f_pad
-            stats["psum_bytes"] += nb * 4 * c_cap
+            stats["macs"] += nb_pad * (1 + d_eff) * t_pad * p_cap * f_pad
+            stats["psum_bytes"] += nb_pad * 4 * c_cap
         empty = (
             np.empty((0, s + 1), dtype=np.int32),
             np.empty(0, dtype=np.int64),
