@@ -255,11 +255,12 @@ def preprocess_buffer(data: bytes, min_support: float) -> NativeResult:
         f = int(res.n_items)
         t = int(res.n_baskets)
         items_raw = ctypes.string_at(res.items_buf, res.items_buf_len)
+        # Keyed on f, not the byte length: a frequent EMPTY token (a
+        # dataset with >= min_count blank lines) makes the items string
+        # legitimately empty while f == 1 — split still yields [""].
         freq_items = (
-            items_raw.decode("utf-8").split("\n") if res.items_buf_len else []
+            [] if f == 0 else items_raw.decode("utf-8").split("\n")
         )
-        if f == 0:
-            freq_items = []
         assert len(freq_items) == f, (len(freq_items), f)
         item_counts = np.ctypeslib.as_array(res.item_counts, shape=(max(f, 1),))[
             :f
@@ -399,11 +400,12 @@ def preprocess_buffer_blocks(
         res = res_ptr.contents
         f = int(res.n_items)
         items_raw = ctypes.string_at(res.items_buf, res.items_buf_len)
+        # Keyed on f, not the byte length: a frequent EMPTY token (a
+        # dataset with >= min_count blank lines) makes the items string
+        # legitimately empty while f == 1 — split still yields [""].
         freq_items = (
-            items_raw.decode("utf-8").split("\n") if res.items_buf_len else []
+            [] if f == 0 else items_raw.decode("utf-8").split("\n")
         )
-        if f == 0:
-            freq_items = []
         assert len(freq_items) == f, (len(freq_items), f)
         item_counts = np.ctypeslib.as_array(
             res.item_counts, shape=(max(f, 1),)

@@ -451,3 +451,55 @@ def test_read_shard_remote_fsspec(tmp_path):
         )
         assert s.freq_items == plain.freq_items
         assert s.n_raw == plain.n_raw and s.min_count == plain.min_count
+
+
+def test_simd_scan_matches_scalar_scan(tmp_path):
+    """The AVX-512 pass-1 fast path (digits+whitespace alphabet) must
+    produce byte-identical results to the scalar path on the same
+    buffer: counts, ranks, baskets, weights, offsets.  FA_NO_SIMD
+    forces the scalar path (checked at call time)."""
+    import os
+
+    import numpy as np
+
+    from fastapriori_tpu.native.loader import preprocess_buffer_blocks
+
+    rng = np.random.default_rng(31)
+    lines = []
+    for _ in range(4000):
+        k = rng.integers(0, 9)
+        toks = rng.integers(0, 900, size=k).astype(str)
+        sep = rng.choice([" ", "  ", "\t", " \t", "\x0b"])
+        lines.append(sep.join(toks))
+    # Edge shapes the masks must survive: empty lines, whitespace-only
+    # lines, leading-zero tokens, >7-digit tokens, a 100-digit run that
+    # crosses 64-byte block boundaries, no trailing newline.
+    lines += ["", "   ", "\t\t", "007 7 07", "12345678901 5", "9" * 100]
+    buf = ("\n".join(lines) + " 3 5").encode()
+
+    def run():
+        got = []
+
+        def on_block(f, offsets, items, weights):
+            got.append(
+                (f, offsets.copy(), items.copy(), weights.copy())
+            )
+
+        out = preprocess_buffer_blocks(buf, 0.01, 4, on_block)
+        return out, got
+
+    os.environ.pop("FA_NO_SIMD", None)
+    out_fast, blocks_fast = run()
+    os.environ["FA_NO_SIMD"] = "1"
+    try:
+        out_scalar, blocks_scalar = run()
+    finally:
+        del os.environ["FA_NO_SIMD"]
+    assert out_fast[:2] == out_scalar[:2]  # n_raw, min_count
+    assert out_fast[2] == out_scalar[2]  # freq item order
+    assert np.array_equal(out_fast[3], out_scalar[3])  # item counts
+    assert len(blocks_fast) == len(blocks_scalar)
+    for a, b in zip(blocks_fast, blocks_scalar):
+        assert a[0] == b[0]
+        for x, y in zip(a[1:], b[1:]):
+            assert np.array_equal(x, y)
