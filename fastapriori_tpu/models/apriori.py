@@ -183,7 +183,12 @@ class FastApriori:
         of 1.35M itemsets was a multi-second host phase at Webdocs scale,
         and every consumer — the writer's line formatting, rule gen's
         size-grouped tables — immediately converts back to arrays anyway).
-        1-itemsets live in ``data.item_counts`` by rank."""
+        1-itemsets live in ``data.item_counts`` by rank.
+
+        The returned ``CompressedData``'s rows are per-ingest-block
+        deduplicated under the (default) pipelined ingest — identical
+        baskets from different blocks stay separate weighted rows; see
+        the CompressedData docstring for the exact contract."""
         from fastapriori_tpu.preprocess import preprocess_file
 
         if self._can_pipeline_ingest(d_path):

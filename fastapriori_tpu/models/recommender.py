@@ -64,6 +64,7 @@ class AssociationRules:
         # on the first device run, reused by every later run() — repeat
         # scans pay only the basket upload + result fetch.
         self._rule_dev: Optional[tuple] = None
+        self._rule_dev_key: Optional[tuple] = None
 
     @property
     def context(self) -> DeviceContext:
@@ -162,7 +163,16 @@ class AssociationRules:
         on device; the dense [R, F] form was ~30x the bytes at movielens
         scale."""
         if self._rule_dev is not None:
+            # The cache is keyed on nothing because both inputs are
+            # instance-invariant today (rules from the once-computed
+            # _sorted_rules, f_pad from the fixed item count) — assert
+            # that rather than silently serving a stale table if run()
+            # ever starts filtering rules per call (ADVICE r3).
+            assert self._rule_dev_key == (len(rules), f_pad), (
+                self._rule_dev_key, len(rules), f_pad
+            )
             return self._rule_dev
+        self._rule_dev_key = (len(rules), f_pad)
         ctx = self.context
         cfg = self.config
         f = len(self.freq_items)

@@ -371,6 +371,13 @@ def preprocess_buffer_blocks(
 
     @_FA_BLOCK_CB
     def cb(_ctx, f, t, offs_p, items_p, w_p):
+        # Once any block's consumer has failed, stop producing side
+        # effects (device uploads, queued futures) for the remaining
+        # blocks — the native call keeps compressing either way (no
+        # abort channel in the C ABI), but its results are discarded and
+        # the first error re-raises after it returns (ADVICE r3).
+        if errs:
+            return
         try:
             t = int(t)
             offsets = np.ctypeslib.as_array(offs_p, shape=(t + 1,)).copy()

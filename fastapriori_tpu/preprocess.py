@@ -60,7 +60,16 @@ class CompressedData:
     Baskets are stored CSR-style: ``basket_indices`` holds the sorted item
     ranks of every basket back-to-back; basket ``i`` spans
     ``basket_indices[basket_offsets[i]:basket_offsets[i+1]]``.
-    """
+
+    Row-granularity note: rows are deduplicated WITHIN the producing
+    ingest unit — globally for the plain in-memory/whole-file paths, per
+    byte-range block for the pipelined and multi-host sharded ingests
+    (models/apriori.py) — so identical baskets from different blocks may
+    appear as separate weighted rows.  Every weighted count (and
+    therefore all mining output) is identical either way; only
+    ``total_count``, row order, and per-row weights are
+    representation-dependent.  Consumers must treat rows as a weighted
+    multiset, not as globally distinct baskets."""
 
     n_raw: int  # raw transaction count N (FastApriori.scala:38)
     min_count: int  # ceil(minSupport * N)   (FastApriori.scala:39)

@@ -225,20 +225,24 @@ def test_multi_chunk_batched_level_launch(n_devices, cand):
     assert dict(got) == dict(expected)
 
 
+@pytest.mark.parametrize("cand", [1, 2])
 @pytest.mark.parametrize("dups", [128, 300, 16500])
-def test_level_engine_heavy_weight_split(dups):
+def test_level_engine_heavy_weight_split(dups, cand):
     """Multiplicities >= 128 route through the single-low-digit weight
     split (main kernels count w % 128; the remainder rides the tiny
     heavy-row int32 correction — ops/count.py heavy_*_correction).
     16500 crosses the old 2-digit bound, proving the remainder path has
-    no digit limit.  Must match the oracle exactly."""
+    no digit limit.  Must match the oracle exactly.  cand=2 exercises
+    the _heavy_gate shard-0 gating under a 2-D (txn x cand) mesh, where
+    the one-hot varies over the cand axis (ADVICE r3)."""
     lines = tokenized(
         ["1 2 3"] * dups + ["1 2 4"] * 60 + ["2 3 4 5"] * 9 + ["5 6"] * 3
     )
     expected, _, _ = oracle.mine(lines, 2.0 / len(lines))
     got, _, _ = FastApriori(
         config=MinerConfig(
-            min_support=2.0 / len(lines), engine="level", num_devices=8
+            min_support=2.0 / len(lines), engine="level", num_devices=8,
+            cand_devices=cand,
         )
     ).run(lines)
     assert dict(got) == dict(expected)
