@@ -565,8 +565,20 @@ class FastApriori:
         upool = ThreadPoolExecutor(max_workers=1)
         try:
             with self.metrics.timed("preprocess", path=d_path) as m:
+                # mmap the file instead of copying ~1 GB of page cache
+                # into a bytes object; the native scan reads straight
+                # from the mapping (loader accepts any readonly buffer).
+                import mmap
+
+                mm = None
                 with open(d_path, "rb") as fh:
-                    buf = fh.read()
+                    try:
+                        mm = mmap.mmap(
+                            fh.fileno(), 0, access=mmap.ACCESS_READ
+                        )
+                        buf = np.frombuffer(mm, dtype=np.uint8)
+                    except (ValueError, OSError):  # empty/unsupported
+                        buf = fh.read()
 
                 def on_block(f_, offsets, items, weights):
                     pk, f_pad = build_packed_bitmap_csr(

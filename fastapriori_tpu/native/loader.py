@@ -367,6 +367,31 @@ def preprocess_buffer_blocks(
             "native block-preprocess entry point unavailable; rebuild "
             "with `make -C fastapriori_tpu/native`"
         )
+    # Accept bytes OR any readonly buffer (an mmap'd file via a numpy
+    # view — the caller avoids copying a GB-scale file into a bytes
+    # object just to hand the native scan a pointer).  bytearray goes
+    # through the buffer branch: ctypes' c_char_p accepts only bytes.
+    if isinstance(data, bytes):
+        data_arg: object = data
+        data_len = len(data)
+    else:
+        arr = (
+            data
+            if isinstance(data, np.ndarray)
+            else np.frombuffer(data, dtype=np.uint8)
+        )
+        # Real exceptions, not asserts (python -O), and contiguity is
+        # load-bearing: ctypes.data ignores strides, so a strided view
+        # would scan the WRONG bytes silently.
+        if arr.dtype != np.uint8 or arr.ndim != 1:
+            raise TypeError(
+                "buffer input must be 1-D uint8 (or bytes); got "
+                f"{arr.dtype} ndim={arr.ndim}"
+            )
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("buffer input must be C-contiguous")
+        data_arg = arr.ctypes.data_as(ctypes.c_char_p)
+        data_len = arr.size
     errs: list = []
 
     @_FA_BLOCK_CB
@@ -393,7 +418,7 @@ def preprocess_buffer_blocks(
             errs.append(e)
 
     res_ptr = lib.fa_preprocess_buffer_blocks(
-        data, len(data), ctypes.c_double(min_support), n_blocks,
+        data_arg, data_len, ctypes.c_double(min_support), n_blocks,
         max(n_threads, 1), cb, None
     )
     if not res_ptr:

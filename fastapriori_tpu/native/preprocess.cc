@@ -751,6 +751,51 @@ struct Pass1Capture {
   }
 };
 
+// Collect one line's ranks from the captured token ids into the
+// collector.  AVX-512 fast path for the dominant shape (all-dense ids,
+// bitset-sized F): 16 rank lookups ride one gather — the serial
+// load -> rank lookup -> bit set chain was ~14 cycles/token and pass-2
+// replay is one rank lookup per captured token (226M on webdocs).
+// Frequent lanes compress into a register-packed buffer and set bits
+// scalar (f <= 4096 keeps the words in L1).  Any negative (side-table)
+// lane falls back to the scalar path for that group.
+inline void collect_line_ranks(
+    const Pass1Capture& p1, RankCollector& rc, int64_t ti, int64_t ti_end) {
+#ifdef FA_HAVE_AVX512
+  const int32_t* ids = p1.tok_ids.p;
+  const int32_t* dr = p1.dense_rank;
+  if (dr && rc.use_bitset) {
+    uint64_t* bits = rc.bits.data();
+    for (; ti + 16 <= ti_end; ti += 16) {
+      __m512i v = _mm512_loadu_si512(
+          reinterpret_cast<const void*>(ids + ti));
+      __mmask16 neg =
+          _mm512_cmplt_epi32_mask(v, _mm512_setzero_si512());
+      if (neg) {  // rare: side-table tokens in this group
+        for (int i = 0; i < 16; ++i) {
+          rc.add(p1.rank_plus_1(ids[ti + i]));
+        }
+        continue;
+      }
+      __m512i ranks = _mm512_i32gather_epi32(v, dr, 4);  // rank+1
+      __mmask16 freq =
+          _mm512_cmpgt_epi32_mask(ranks, _mm512_setzero_si512());
+      alignas(64) int32_t rbuf[16];
+      _mm512_store_si512(
+          rbuf,
+          _mm512_maskz_compress_epi32(
+              freq, _mm512_sub_epi32(ranks, _mm512_set1_epi32(1))));
+      const int n = __builtin_popcount(freq);
+      for (int i = 0; i < n; ++i) {
+        const uint32_t rr = static_cast<uint32_t>(rbuf[i]);
+        bits[rr >> 6] |= 1ull << (rr & 63);
+      }
+    }
+  }
+#endif  // FA_HAVE_AVX512
+  for (; ti < ti_end; ++ti) rc.add(p1.rank_plus_1(p1.tok_ids[ti]));
+}
+
 // Marshal the global tables (items in rank order + counts) into res.
 // False on allocation failure.
 bool marshal_tables(const Pass1Capture& p1, FaResult* res) {
@@ -801,10 +846,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
   RankCollector rc(p1.f);
   for (int64_t li = 0; li < p1.n_raw; ++li) {
     rc.reset_list();
-    for (int64_t ti = p1.tok_offsets[li]; ti < p1.tok_offsets[li + 1];
-         ++ti) {
-      rc.add(p1.rank_plus_1(p1.tok_ids[ti]));
-    }
+    collect_line_ranks(p1, rc, p1.tok_offsets[li], p1.tok_offsets[li + 1]);
     const auto& ranks = rc.finish();
     if (ranks.size() <= 1) continue;
     if (!dd.insert(ranks.data(), ranks.size())) {
@@ -1294,10 +1336,8 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
     RankCollector rc(p1.f);
     for (int64_t li = lo; li < hi; ++li) {
       rc.reset_list();
-      for (int64_t ti = p1.tok_offsets[li]; ti < p1.tok_offsets[li + 1];
-           ++ti) {
-        rc.add(p1.rank_plus_1(p1.tok_ids[ti]));
-      }
+      collect_line_ranks(
+          p1, rc, p1.tok_offsets[li], p1.tok_offsets[li + 1]);
       const auto& ranks = rc.finish();
       if (ranks.size() <= 1) continue;
       if (!dd.insert(ranks.data(), ranks.size())) return false;
