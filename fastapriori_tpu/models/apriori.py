@@ -50,6 +50,7 @@ def _fused_m_cap_memory_limit(
     f_pad: int,
     n_chunks: int,
     unpacked_resident: bool = False,
+    cap: Optional[int] = None,
 ) -> int:
     """Largest power-of-two row budget whose fused program provably fits
     the per-device HBM budget — so an oversized m_cap is never compiled
@@ -90,9 +91,14 @@ def _fused_m_cap_memory_limit(
             + (3 * cfg.fused_l_max + 1) * m * 4
         )
 
+    # ``cap`` bounds the search (default: the fused engine's row cap);
+    # the shallow-tail fold passes its own need — its budget is sized
+    # from the seed level, not from fused_m_cap_max.
+    if cap is None:
+        cap = cfg.fused_m_cap_max
     if fixed + bytes_at(m) > budget:
         return 0  # even the floor budget cannot fit: fused is infeasible
-    while 2 * m <= cfg.fused_m_cap_max and fixed + bytes_at(2 * m) <= budget:
+    while 2 * m <= cap and fixed + bytes_at(2 * m) <= budget:
         m *= 2
     return m
 
@@ -1443,9 +1449,11 @@ class FastApriori:
         )
         # The memory model is the fused engine's (conservative: the tail
         # counts over p_cap rows, not m_cap) — skip the fold rather than
-        # compile a program that could OOM.
+        # compile a program that could OOM.  The search cap is the
+        # tail's own need, NOT fused_m_cap_max (an unrelated knob).
         if m_cap > _fused_m_cap_memory_limit(
-            cfg, ctx, t_pad, f_pad, n_chunks, unpacked_resident=True
+            cfg, ctx, t_pad, f_pad, n_chunks, unpacked_resident=True,
+            cap=m_cap,
         ):
             return [], False
         p_cap = min(cfg.tail_fuse_p_cap, m_cap)

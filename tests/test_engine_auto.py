@@ -224,3 +224,33 @@ def test_auto_salvage_on_midlattice_overflow(tmp_path):
     )
     got, _, _ = miner.run(lines)
     assert dict(got) == dict(expected)
+
+
+def test_salvage_then_tail_fold_compose():
+    """The three execution mechanisms compose in one run: a fused
+    attempt overflows mid-lattice (tiny cap), its complete levels
+    salvage-resume the level engine, and the level engine then folds
+    the remaining tail into one seeded dispatch — result stays exact
+    through all three hand-offs."""
+    lines = tokenized(
+        ["1 2 3 4 5 6 7 8 9"] * 40 + ["1 2 3"] * 5 + ["10 11"] * 4
+        + ["12"]
+    )
+    expected, _, _ = oracle.mine(lines, 0.15)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.15,
+            engine="fused",  # force the attempt so overflow salvages
+            num_devices=1,
+            log_metrics=True,
+            fused_m_cap_max=32,  # overflows at C(9,k) peak levels
+            fused_m_cap=8,
+            tail_fuse_rows=1 << 20,  # tail fold force-enabled on cpu
+        )
+    )
+    got, _, _ = miner.run(lines)
+    assert dict(got) == dict(expected)
+    events = [r["event"] for r in miner.metrics.records]
+    assert "fused_mine" in events
+    assert "level_resume" in events or "fused_fallback" in events
+    assert "tail_fuse" in events, events
