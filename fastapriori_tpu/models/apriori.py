@@ -1349,7 +1349,7 @@ class FastApriori:
                 m.update(
                     candidates=f * (f - 1) // 2,
                     frequent=n2,
-                    cand3=int(tri),
+                    cand3=tri,
                     macs=d_eff * t_pad * f_pad * f_pad,
                     psum_bytes=4 * f_pad * f_pad,
                 )
@@ -1359,7 +1359,7 @@ class FastApriori:
                 # lands on the level engine — no wasted dispatch either
                 # way).
                 lv, partial, _ = self._fused_resident(
-                    data, bitmap, n_chunks, t_pad, n2=n2, tri=int(tri)
+                    data, bitmap, n_chunks, t_pad, n2=n2, tri=tri
                 )
                 if lv is not None:
                     return lv
@@ -1388,6 +1388,10 @@ class FastApriori:
         )
         k = cur.shape[1] + 1
         while cur.shape[0] >= k:
+            # k > 3: never fold straight off the pair level — small
+            # lattices that fit a whole-loop program are the fused
+            # engine's job (the auto choice), and the fold's seed should
+            # be a level the per-level engine already counted.
             if tail_ok and k > 3 and cur.shape[0] <= tail_rows:
                 tail, complete = self._mine_tail(
                     data, bitmap, w_digits, scales, cur, n_chunks, heavy
