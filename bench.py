@@ -460,7 +460,7 @@ def _recommend_workload(args, raw, d_path) -> int:
     # user population (a subsample would see a different dedup ratio and
     # skew the comparison).  O(users x rules) in Python — auto-skip past
     # ~1e8 subset checks, like the mining workload's 1e11 guard.
-    n_rules = len(rec._sorted_rules or ())
+    n_rules = rec.n_rules or 0
     if not args.skip_baseline and n_users * n_rules > 1e8:
         print(
             f"baseline skipped: est. cost {n_users} users x {n_rules} "

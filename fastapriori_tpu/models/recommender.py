@@ -25,8 +25,10 @@ from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import dedup_user_baskets
 from fastapriori_tpu.rules.gen import (
     Rule,
+    gen_rule_arrays_levels,
     gen_rules,
-    gen_rules_levels,
+    rule_objects_from_arrays,
+    sort_rule_arrays,
     sort_rules,
 )
 from fastapriori_tpu.utils.logging import MetricsLogger
@@ -57,8 +59,15 @@ class AssociationRules:
         self.metrics = MetricsLogger(enabled=self.config.log_metrics)
         # Rules depend only on the (immutable) mining result — built once
         # per instance, like the reference's single genRules pass
-        # (AssociationRules.scala:72), not once per run() call.
+        # (AssociationRules.scala:72), not once per run() call.  The
+        # matrix-form path (``levels`` given) keeps them as sorted
+        # ARRAYS (ant [R, k_max] 0-padded, lens, cons, conf) — at
+        # webdocs/minSupport=0.092 scale there are 16M rules and the
+        # object form cost minutes of pure materialization; the object
+        # list is built lazily only for the host-scan fallback and
+        # API-parity callers.
         self._sorted_rules: Optional[List[Rule]] = None
+        self._rule_arrays: Optional[tuple] = None
         # Device-resident compact rule table (the reference broadcasts
         # the sorted rules once, AssociationRules.scala:76-78): uploaded
         # on the first device run, reused by every later run() — repeat
@@ -74,6 +83,16 @@ class AssociationRules:
                 cand_devices=self.config.cand_devices,
             )
         return self._context
+
+    @property
+    def n_rules(self) -> Optional[int]:
+        """Sorted-rule count, whichever form holds them (None before the
+        first run() generates them)."""
+        if self._rule_arrays is not None:
+            return len(self._rule_arrays[1])
+        if self._sorted_rules is not None:
+            return len(self._sorted_rules)
+        return None
 
     # ------------------------------------------------------------------
     def run(
@@ -96,22 +115,12 @@ class AssociationRules:
             m.update(
                 users=len(user_lines), distinct=len(baskets), empty=len(empty)
             )
-        if self._sorted_rules is None:
-            with self.metrics.timed("gen_rules") as m:
-                if self._levels is not None:
-                    raw_rules = gen_rules_levels(
-                        self._levels, self._item_counts
-                    )
-                else:
-                    raw_rules = gen_rules(self.freq_itemsets)
-                self._sorted_rules = sort_rules(raw_rules, self.freq_items)
-                m.update(rules=len(self._sorted_rules))
-        rules = self._sorted_rules
+        n_rules = self._ensure_rules()
 
         out: List[Tuple[int, str]] = [(i, "0") for i in empty]
         if not baskets:
             return out
-        if not rules:
+        if not n_rules:
             for rows in indexes:
                 out.extend((i, "0") for i in rows)
             return out
@@ -122,13 +131,13 @@ class AssociationRules:
             # carries ~seconds of fixed dispatch/transfer cost on
             # tunneled chips.  3e7 keeps small jobs on the host while
             # movielens-scale (16K users × 10^5 rules) goes on device.
-            use_device = len(baskets) * len(rules) >= 30_000_000
+            use_device = len(baskets) * n_rules >= 30_000_000
         with self.metrics.timed("first_match", device=use_device) as m:
             if use_device:
-                recs, stats = self._device_first_match(baskets, rules)
+                recs, stats = self._device_first_match(baskets)
                 m.update(**stats)
             else:
-                recs = self._host_first_match(baskets, rules)
+                recs = self._host_first_match(baskets, self._rule_objects())
 
         for rows, rec in zip(indexes, recs):
             item = self.freq_items[rec] if rec >= 0 else "0"
@@ -136,6 +145,34 @@ class AssociationRules:
         return out
 
     # ------------------------------------------------------------------
+    def _ensure_rules(self) -> int:
+        """Generate + priority-sort the rules once per instance; returns
+        the rule count.  Matrix-form mining input stays in ARRAY form;
+        the object-API input (freq_itemsets) keeps the object pipeline."""
+        n = self.n_rules
+        if n is not None:
+            return n
+        with self.metrics.timed("gen_rules") as m:
+            if self._levels is not None:
+                surv = gen_rule_arrays_levels(self._levels, self._item_counts)
+                self._rule_arrays = sort_rule_arrays(surv, self.freq_items)
+                n = len(self._rule_arrays[1])
+            else:
+                self._sorted_rules = sort_rules(
+                    gen_rules(self.freq_itemsets), self.freq_items
+                )
+                n = len(self._sorted_rules)
+            m.update(rules=n)
+        return n
+
+    def _rule_objects(self) -> List[Rule]:
+        """Object form of the sorted rules (host scan / parity callers);
+        materialized lazily from the arrays on the matrix path."""
+        if self._sorted_rules is None:
+            assert self._rule_arrays is not None
+            self._sorted_rules = rule_objects_from_arrays(*self._rule_arrays)
+        return self._sorted_rules
+
     def _host_first_match(
         self, baskets: List[np.ndarray], rules: List[Rule]
     ) -> List[int]:
@@ -154,46 +191,64 @@ class AssociationRules:
             recs.append(rec)
         return recs
 
-    def _rule_table_device(self, rules: List[Rule], f_pad: int) -> tuple:
+    def _rule_table_device(self, f_pad: int) -> tuple:
         """Compact device-resident rule table — built and uploaded ONCE
         per instance (the sorted table is immutable; the reference
         broadcasts it once, AssociationRules.scala:76-78).  Antecedents
         travel as [R_pad, k_max] column indexes (padding positions point
         at the guaranteed all-zero bitmap column) and scatter to one-hot
         on device; the dense [R, F] form was ~30x the bytes at movielens
-        scale."""
+        scale.  Built straight from the sorted rule ARRAYS on the
+        matrix path (a per-rule Python loop cost minutes at 10^7-rule
+        scale); the object path keeps the list form."""
+        n_rules = self.n_rules or 0
         if self._rule_dev is not None:
             # The cache is keyed on nothing because both inputs are
-            # instance-invariant today (rules from the once-computed
-            # _sorted_rules, f_pad from the fixed item count) — assert
-            # that rather than silently serving a stale table if run()
-            # ever starts filtering rules per call (ADVICE r3).
-            assert self._rule_dev_key == (len(rules), f_pad), (
-                self._rule_dev_key, len(rules), f_pad
+            # instance-invariant today (rules built once per instance,
+            # f_pad from the fixed item count) — assert that rather
+            # than silently serving a stale table if run() ever starts
+            # filtering rules per call (ADVICE r3).
+            assert self._rule_dev_key == (n_rules, f_pad), (
+                self._rule_dev_key, n_rules, f_pad
             )
             return self._rule_dev
-        self._rule_dev_key = (len(rules), f_pad)
+        self._rule_dev_key = (n_rules, f_pad)
         ctx = self.context
         cfg = self.config
         f = len(self.freq_items)
-        r = len(rules)
+        r = n_rules
         chunk = pad_axis(max(1, cfg.rule_chunk), 128)  # lane-aligned
         r_pad = pad_axis(r, chunk)
-        ant_rows = [np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules]
-        lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
-        k_max = int(lens.max()) if r else 1
         zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
-        ant = np.full((r_pad, k_max), zcol, dtype=np.int32)
-        if r > 0:
-            rows = np.repeat(np.arange(r, dtype=np.int64), lens)
-            cols = np.concatenate(
-                [np.arange(n, dtype=np.int64) for n in lens]
-            )
-            ant[rows, cols] = np.concatenate(ant_rows)
-        size = np.full(r_pad, f + 1, dtype=np.int32)  # pad rows never hit
-        size[:r] = lens
-        consequent = np.zeros(r_pad, dtype=np.int32)
-        consequent[:r] = [c for _, c, _ in rules]
+        if self._rule_arrays is not None:
+            ant0, lens, cons0, _conf = self._rule_arrays
+            k_max = ant0.shape[1] if r else 1
+            ant = np.full((r_pad, k_max), zcol, dtype=np.int32)
+            if r > 0:
+                mask = np.arange(k_max)[None, :] < lens[:, None]
+                ant[:r][mask] = ant0[mask]
+            size = np.full(r_pad, f + 1, dtype=np.int32)  # pads never hit
+            size[:r] = lens
+            consequent = np.zeros(r_pad, dtype=np.int32)
+            consequent[:r] = cons0
+        else:
+            rules = self._sorted_rules or []
+            ant_rows = [
+                np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules
+            ]
+            lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
+            k_max = int(lens.max()) if r else 1
+            ant = np.full((r_pad, k_max), zcol, dtype=np.int32)
+            if r > 0:
+                rows = np.repeat(np.arange(r, dtype=np.int64), lens)
+                cols = np.concatenate(
+                    [np.arange(n, dtype=np.int64) for n in lens]
+                )
+                ant[rows, cols] = np.concatenate(ant_rows)
+            size = np.full(r_pad, f + 1, dtype=np.int32)  # pads never hit
+            size[:r] = lens
+            consequent = np.zeros(r_pad, dtype=np.int32)
+            consequent[:r] = [c for _, c, _ in rules]
         self._rule_dev = (
             ctx.replicate(ant),
             ctx.replicate(size),
@@ -206,7 +261,7 @@ class AssociationRules:
         return self._rule_dev
 
     def _device_first_match(
-        self, baskets: List[np.ndarray], rules: List[Rule]
+        self, baskets: List[np.ndarray]
     ) -> Tuple[List[int], dict]:
         """Containment-matmul path (ops/contain.py), baskets sharded over
         the mesh, the rule table resident and replicated.
@@ -248,7 +303,7 @@ class AssociationRules:
 
         first_upload = self._rule_dev is None
         ant_dev, size_dev, cons_dev, chunk, r_pad, consequent, rule_bytes = (
-            self._rule_table_device(rules, f_pad)
+            self._rule_table_device(f_pad)
         )
 
         baskets_dev = ctx.shard_rows_local(basket_mat[row])
@@ -259,7 +314,7 @@ class AssociationRules:
         best_np = ctx.local_rows(best)
         chunks_run = int(chunks_run)
         stats = {
-            "rules": len(rules),
+            "rules": self._rule_dev_key[0],
             "chunks_run": chunks_run,
             "chunks_total": r_pad // chunk,
             # Containment matmul per chunk over the padded global shapes

@@ -228,7 +228,7 @@ def test_two_process_cli_end_to_end(tmp_path):
 
 @pytest.mark.parametrize(
     "engine,remote",
-    [("level", False), ("fused", False), ("level", True)],
+    [("level", False), ("fused", False), ("auto", False), ("level", True)],
 )
 def test_two_process_sharded_ingest_matches_oracle(tmp_path, engine, remote):
     """Sharded ingest: each process preprocesses only its byte range of

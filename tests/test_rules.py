@@ -68,3 +68,38 @@ def test_rules_prune_kills_non_increasing():
 def test_rules_empty_when_no_pairs():
     assert gen_rules([(frozenset((0,)), 5)]) == []
     assert gen_rules([]) == []
+
+
+def test_rule_arrays_pipeline_matches_object_pipeline():
+    """The matrix-form rule pipeline (gen_rule_arrays_levels +
+    sort_rule_arrays + rule_objects_from_arrays) must produce the SAME
+    rules in the SAME priority order as the object pipeline — including
+    stable tie order, which the device table's first-match semantics
+    depend on."""
+    from conftest import random_dataset, tokenized
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.models.apriori import FastApriori
+    from fastapriori_tpu.rules.gen import (
+        gen_rule_arrays_levels,
+        gen_rules_levels,
+        rule_objects_from_arrays,
+        sort_rule_arrays,
+        sort_rules,
+    )
+
+    from fastapriori_tpu.preprocess import preprocess
+
+    lines = tokenized(random_dataset(4, n_txns=250, max_len=8))
+    miner = FastApriori(
+        config=MinerConfig(min_support=0.02, engine="level", num_devices=1)
+    )
+    d = preprocess(lines, 0.02)
+    levels = miner.mine_levels_raw(d)
+    objs = sort_rules(gen_rules_levels(levels, d.item_counts), d.freq_items)
+    arrs = sort_rule_arrays(
+        gen_rule_arrays_levels(levels, d.item_counts), d.freq_items
+    )
+    from_arrays = rule_objects_from_arrays(*arrs)
+    assert len(objs) == len(from_arrays)
+    for (a1, c1, f1), (a2, c2, f2) in zip(objs, from_arrays):
+        assert a1 == a2 and c1 == c2 and f1 == f2
