@@ -217,26 +217,34 @@ class AssociationRules:
         cfg = self.config
         f = len(self.freq_items)
         r = n_rules
-        chunk = pad_axis(max(1, cfg.rule_chunk), 128)  # lane-aligned
+        # Lane-aligned chunk, scaled so the on-device scan targets ~256
+        # while-loop iterations: each iteration carries fixed overhead,
+        # and a no-match basket walks the WHOLE table — at 16M rules the
+        # default chunk meant 2000 iterations (~35 s) where 256 bigger
+        # ones do the same MACs.  Early-exit resolution only coarsens
+        # for matched users, whose wasted partial chunk is device noise.
+        # The absolute cap bounds the per-step [Nb, chunk] overlap
+        # buffer: without it the chunk grows linearly with the rule
+        # count ON TOP of the basket count.
+        chunk = pad_axis(
+            max(1, cfg.rule_chunk, min(-(-r // 256), 1 << 16)), 128
+        )
         r_pad = pad_axis(r, chunk)
         zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
         if self._rule_arrays is not None:
-            ant0, lens, cons0, _conf = self._rule_arrays
+            ant0, lens, cons_vals, _conf = self._rule_arrays
             k_max = ant0.shape[1] if r else 1
             ant = np.full((r_pad, k_max), zcol, dtype=np.int32)
             if r > 0:
                 mask = np.arange(k_max)[None, :] < lens[:, None]
                 ant[:r][mask] = ant0[mask]
-            size = np.full(r_pad, f + 1, dtype=np.int32)  # pads never hit
-            size[:r] = lens
-            consequent = np.zeros(r_pad, dtype=np.int32)
-            consequent[:r] = cons0
         else:
             rules = self._sorted_rules or []
             ant_rows = [
                 np.asarray(sorted(a), dtype=np.int32) for a, _, _ in rules
             ]
             lens = np.fromiter((len(a) for a in ant_rows), np.int64, count=r)
+            cons_vals = [c for _, c, _ in rules]
             k_max = int(lens.max()) if r else 1
             ant = np.full((r_pad, k_max), zcol, dtype=np.int32)
             if r > 0:
@@ -245,10 +253,10 @@ class AssociationRules:
                     [np.arange(n, dtype=np.int64) for n in lens]
                 )
                 ant[rows, cols] = np.concatenate(ant_rows)
-            size = np.full(r_pad, f + 1, dtype=np.int32)  # pads never hit
-            size[:r] = lens
-            consequent = np.zeros(r_pad, dtype=np.int32)
-            consequent[:r] = [c for _, c, _ in rules]
+        size = np.full(r_pad, f + 1, dtype=np.int32)  # pad rows never hit
+        size[:r] = lens
+        consequent = np.zeros(r_pad, dtype=np.int32)
+        consequent[:r] = cons_vals
         self._rule_dev = (
             ctx.replicate(ant),
             ctx.replicate(size),

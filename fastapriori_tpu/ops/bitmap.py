@@ -120,25 +120,6 @@ def pad_weights(weights: np.ndarray, txn_pad: int) -> np.ndarray:
     return out
 
 
-def scatter_one_hot(cols, f_pad: int):
-    """Traced: scatter compact row-wise column indexes ``[N, K]`` into a
-    one-hot int8 ``[N, f_pad]``.  Depends on this module's padding
-    invariant: callers point padding positions (and padding rows) at the
-    guaranteed all-zero bitmap column ``f_pad - 1``, so the stray 1 set
-    there never contributes to any count or containment test."""
-    import jax.numpy as jnp
-
-    n = cols.shape[0]
-    # Compact tables may travel in int16 (half the host link bytes);
-    # widen on device for the scatter.
-    cols = cols.astype(jnp.int32)
-    return (
-        jnp.zeros((n, f_pad), jnp.int8)
-        .at[jnp.arange(n)[:, None], cols]
-        .set(1)
-    )
-
-
 def weight_digits(
     weights: np.ndarray, txn_pad: int, min_digits: int = 1
 ) -> Tuple[np.ndarray, List[int]]:
