@@ -212,6 +212,9 @@ def _run(args) -> int:
         n_proc, proc_id = jax.process_count(), jax.process_index()
 
     # Imports deferred so --help works without initializing a backend.
+    from fastapriori_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     from fastapriori_tpu.models.apriori import FastApriori
     from fastapriori_tpu.models.recommender import AssociationRules
 
