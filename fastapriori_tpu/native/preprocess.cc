@@ -281,15 +281,6 @@ struct BasketDeduper {
   size_t table_size = 1 << 12;   // power of two
   std::vector<int64_t> table = std::vector<int64_t>(1 << 12, -1);
 
-  static uint64_t hash_basket(const int32_t* p, size_t n) {
-    uint64_t h = 0x243F6A8885A308D3ull ^ n;  // word-wise mix, not per-byte
-    for (size_t i = 0; i < n; ++i) {
-      h ^= static_cast<uint32_t>(p[i]);
-      h *= 0x9E3779B97F4A7C15ull;
-      h ^= h >> 29;
-    }
-    return h;
-  }
 
   void grow_table() {
     table_size *= 2;
@@ -303,9 +294,10 @@ struct BasketDeduper {
     }
   }
 
-  // Insert one sorted, deduplicated rank list (n >= 2).  False on OOM.
-  bool insert(const int32_t* ranks, size_t n) {
-    const uint64_t h = hash_basket(ranks, n);
+  // Insert one sorted, deduplicated rank list (n >= 2) with its hash
+  // (RankCollector.finish computes it during the collection walk — the
+  // hash function lives THERE; all inserts must use it).  False on OOM.
+  bool insert(const int32_t* ranks, size_t n, uint64_t h) {
     const size_t mask = table_size - 1;
     size_t slot = static_cast<size_t>(h) & mask;
     while (true) {
@@ -358,8 +350,20 @@ struct RankCollector {
     }
   }
   // Returns the sorted unique ranks for the current line (and clears
-  // the bitset for the next one).
+  // the bitset for the next one).  ``hash`` is the deduper's basket
+  // hash: on the bitset fast path it folds into the ctz walk itself
+  // (the ranks are register-hot there, saving the deduper a second
+  // pass over every basket); the sort path (F > 4096) hashes in its
+  // own pass after sort+unique.
+  uint64_t hash = 0;
+  static inline uint64_t mix_rank(uint64_t h, int32_t r) {
+    h ^= static_cast<uint32_t>(r);
+    h *= 0x9E3779B97F4A7C15ull;
+    h ^= h >> 29;
+    return h;
+  }
   inline const std::vector<int32_t>& finish() {
+    uint64_t h = 0x243F6A8885A308D3ull;
     if (use_bitset) {
       scratch.clear();
       for (size_t wi = 0; wi < n_words; ++wi) {
@@ -367,8 +371,10 @@ struct RankCollector {
         if (!w) continue;
         bits[wi] = 0;
         do {
-          scratch.push_back(static_cast<int32_t>(
-              (wi << 6) + static_cast<size_t>(__builtin_ctzll(w))));
+          const int32_t r = static_cast<int32_t>(
+              (wi << 6) + static_cast<size_t>(__builtin_ctzll(w)));
+          scratch.push_back(r);
+          h = mix_rank(h, r);
           w &= w - 1;
         } while (w);
       }
@@ -376,7 +382,9 @@ struct RankCollector {
       std::sort(scratch.begin(), scratch.end());
       scratch.erase(std::unique(scratch.begin(), scratch.end()),
                     scratch.end());
+      for (int32_t r : scratch) h = mix_rank(h, r);
     }
+    hash = h ^ scratch.size();
     return scratch;
   }
   inline void reset_list() {
@@ -849,7 +857,7 @@ FaResult* fa_preprocess_buffer(const char* data, int64_t len,
     collect_line_ranks(p1, rc, p1.tok_offsets[li], p1.tok_offsets[li + 1]);
     const auto& ranks = rc.finish();
     if (ranks.size() <= 1) continue;
-    if (!dd.insert(ranks.data(), ranks.size())) {
+    if (!dd.insert(ranks.data(), ranks.size(), rc.hash)) {
       dd.arena.free_buf();
       return nullptr;
     }
@@ -1046,7 +1054,7 @@ FaResult* fa_compress_with_ranks(const char* data, int64_t len,
     }
     const auto& ranks = rc.finish();
     if (ranks.size() <= 1) return;
-    if (!dd.insert(ranks.data(), ranks.size())) oom = true;
+    if (!dd.insert(ranks.data(), ranks.size(), rc.hash)) oom = true;
   });
   if (oom) {
     dd.arena.free_buf();
@@ -1340,7 +1348,7 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
           p1, rc, p1.tok_offsets[li], p1.tok_offsets[li + 1]);
       const auto& ranks = rc.finish();
       if (ranks.size() <= 1) continue;
-      if (!dd.insert(ranks.data(), ranks.size())) return false;
+      if (!dd.insert(ranks.data(), ranks.size(), rc.hash)) return false;
     }
     return true;
   };
