@@ -965,9 +965,12 @@ def _recommend_workload(args, raw, d_path) -> int:
             break
     wall = sorted(walls)[(len(walls) - 1) // 2]
     assert len(out) == n_users
-    # Phase attribution: mining phases + the rule-pipeline events
-    # (gen_rules runs once, inside the warm-up call above).
-    phases = _phase_summary(miner.metrics.records)
+    # Phase attribution: rule-pipeline events only (gen_rules runs once,
+    # inside the warm-up call above).  Mining phases are deliberately
+    # NOT attached here: the recommend workload mines exactly once, so
+    # its mining records are cold (compile-laden) and would read as a
+    # regression next to the mine workload's warm medians.
+    phases = {}
     for r in rec.metrics.records:
         if r.get("event") == "gen_rules":
             phases["gen_rules_s"] = round(r.get("wall_ms", 0.0) / 1e3, 3)

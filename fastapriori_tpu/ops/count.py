@@ -76,13 +76,20 @@ def _weighted_matmul(
     scales: Sequence[int],  # python ints, len D (static)
 ) -> jnp.ndarray:
     """``out[p,f] = Σ_t w_t lhs[t,p] bitmap[t,f]`` via per-digit int8
-    matmuls with int32 accumulation (exact for counts < 2^31)."""
+    matmuls with int32 accumulation (exact for counts < 2^31).
+
+    The weights scale the F-wide ``bitmap`` side, NOT the P-wide lhs:
+    at level shapes (P up to 16K, F fixed at a few hundred) a scaled
+    [T, P] operand is a multi-GB HBM intermediate written and re-read
+    per digit — the membership phase was bandwidth-bound on exactly
+    that traffic — while ``w ⊙ B`` is [T, F], ~2% of the bytes.
+    Integer arithmetic, so the regrouping is exact."""
     total = None
     for d, scale in enumerate(scales):
-        scaled = lhs_int8 * w_digits[d][:, None]  # int8 in [0,127]
+        scaled = bitmap * w_digits[d][:, None]  # int8 in [0,127]
         part = lax.dot_general(
+            lhs_int8,
             scaled,
-            bitmap,
             (((0,), (0,)), ((), ())),  # contract over T
             preferred_element_type=jnp.int32,
         )
@@ -197,6 +204,35 @@ def heavy_level_correction(
     return _heavy_gate(corr, axis_name)
 
 
+def pair_threshold_pack(
+    counts: jnp.ndarray,  # [F, F] int32 — psum'd pair-count matrix
+    min_count: jnp.ndarray,
+    num_items: jnp.ndarray,
+    cap: int,
+    census: bool,
+) -> jnp.ndarray:
+    """The pair phase's on-device tail, shared by every Gram flavor
+    (:func:`local_pair_gather` and the ingest-overlapped program,
+    parallel/mesh.py ingest_pair_miner): upper-triangle threshold,
+    survivor extraction at ``cap``, level-3 census.  One definition so
+    the two paths can never drift in masking or packing layout.
+    Returns the packed host-bound array
+    ``[flat_idx[cap] | counts[cap] | n2 | tri]`` (tri = -1 when the
+    census is skipped)."""
+    f = counts.shape[0]
+    iu = jnp.arange(f)
+    upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
+    mask = upper & (counts >= min_count)
+    n2 = jnp.sum(mask, dtype=jnp.int32)
+    tri = _pair_triangles(mask) if census else jnp.int32(-1)
+    (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
+    flat_idx = flat_idx.astype(jnp.int32)
+    return jnp.concatenate(
+        [flat_idx, jnp.take(counts.reshape(-1), flat_idx),
+         jnp.stack([n2, tri])]
+    )
+
+
 def local_pair_gather(
     bitmap: jnp.ndarray,  # [T_local, F] int8
     w_digits: jnp.ndarray,  # [D, T_local] int8
@@ -211,16 +247,16 @@ def local_pair_gather(
 ) -> tuple:
     """C6, transfer-minimal form: the pair Gram matmul PLUS the threshold,
     on device.  Only surviving pairs leave the chip: returns
-    ``(flat_idx int32[cap], counts int32[cap], n2 int32, tri int32,
-    counts_mat int32[F, F])`` where the first ``n2`` entries are the
-    upper-triangle survivors in row-major order (``i = idx // F``,
-    ``j = idx % F``) and ``tri`` is the level-3 candidate census
-    (:func:`_pair_triangles`; -1 when F > TRI_F_CAP) that the engine's
-    auto-choice reads.  ``counts_mat`` is the full psum'd count matrix —
-    callers keep it DEVICE-RESIDENT (never fetched) so an ``n2 > cap``
-    overflow re-extracts survivors via :func:`local_pair_regather`
-    without re-running the Gram.  Replaces transferring the full [F, F]
-    table (16 MB at F=2048) with ~2·cap·4 bytes.
+    ``(packed, counts_mat)`` where ``packed`` is
+    :func:`pair_threshold_pack`'s host-bound
+    ``[flat_idx[cap] | counts[cap] | n2 | tri]`` array (upper-triangle
+    survivors in row-major order, ``i = idx // F``, ``j = idx % F``;
+    ``tri`` = level-3 census, -1 when F > TRI_F_CAP) and ``counts_mat``
+    is the full psum'd count matrix — callers keep it DEVICE-RESIDENT
+    (never fetched) so an ``n2 > cap`` overflow re-extracts survivors
+    via :func:`local_pair_regather` without re-running the Gram.
+    Replaces transferring the full [F, F] table (16 MB at F=2048) with
+    ~2·cap·4 bytes.
 
     ``fast_f32``: run the Gram matmul as ONE float32 matmul (BLAS path on
     CPU backends, where XLA int8 matmuls are orders slower).  Exact only
@@ -241,14 +277,10 @@ def local_pair_gather(
     if heavy_b is not None:
         counts = counts + heavy_pair_correction(heavy_b, heavy_w, axis_name)
     counts = _psum_if(counts, axis_name)
-    iu = jnp.arange(f)
-    upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
-    mask = upper & (counts >= min_count)
-    n2 = jnp.sum(mask, dtype=jnp.int32)
-    tri = _pair_triangles(mask) if f <= TRI_F_CAP else jnp.int32(-1)
-    (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
-    flat_idx = flat_idx.astype(jnp.int32)
-    return flat_idx, jnp.take(counts.reshape(-1), flat_idx), n2, tri, counts
+    packed = pair_threshold_pack(
+        counts, min_count, num_items, cap, census=f <= TRI_F_CAP
+    )
+    return packed, counts
 
 
 def local_pair_regather(
@@ -332,6 +364,13 @@ def local_level_gather(
     wd = w_digits.reshape(d, n_chunks, tc).transpose(1, 0, 2)
 
     def body(acc, xs):
+        # HBM discipline (the membership phase is bandwidth-bound, not
+        # MXU-bound, at level shapes): the [tc, P] membership
+        # intermediate stays int8 (counts are bounded by k1 <= K_MAX,
+        # far under 127, so int8 accumulation is exact), and the weights
+        # scale the F-wide bitmap side — ``commonᵀ @ (w ⊙ B)`` — so no
+        # scaled [tc, P] operand is ever materialized.  Same exact
+        # integer result; ~5x fewer intermediate bytes per chunk.
         b_chunk, wd_chunk = xs  # [tc, F] int8, [D, tc] int8
         if fast_f32:
             b_f = b_chunk.astype(jnp.float32)
@@ -341,13 +380,13 @@ def local_level_gather(
                 (((1,), (1,)), ((), ())),  # contract over F -> [tc, P]
                 preferred_element_type=jnp.float32,
             )
-            w_f = _weights_f32(wd_chunk, scales)  # [tc]
-            scaled = jnp.where(
-                member == k1.astype(jnp.float32), w_f[:, None], 0.0
+            common = (member == k1.astype(jnp.float32)).astype(
+                jnp.float32
             )
+            w_f = _weights_f32(wd_chunk, scales)  # [tc]
             total = lax.dot_general(
-                scaled,
-                b_f,
+                common,
+                b_f * w_f[:, None],
                 (((0,), (0,)), ((), ())),  # contract over tc -> [P, F]
                 preferred_element_type=jnp.float32,
             ).astype(jnp.int32)
@@ -356,15 +395,14 @@ def local_level_gather(
             b_chunk,
             onehot,
             (((1,), (1,)), ((), ())),  # contract over F -> [tc, P]
-            preferred_element_type=jnp.int32,
+            preferred_element_type=jnp.int8,
         )
-        common = (member == k1).astype(jnp.int8)
+        common = (member == k1.astype(jnp.int8)).astype(jnp.int8)
         total = None
         for di, scale in enumerate(scales):
-            scaled = common * wd_chunk[di][:, None]
             part = lax.dot_general(
-                scaled,
-                b_chunk,
+                common,
+                b_chunk * wd_chunk[di][:, None],
                 (((0,), (0,)), ((), ())),  # contract over tc -> [P, F]
                 preferred_element_type=jnp.int32,
             )

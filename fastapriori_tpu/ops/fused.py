@@ -104,10 +104,13 @@ def _weighted_counts(common, bitmap, w, n_digits: int, fast_f32: bool):
     total = None
     for d in range(n_digits):
         w_d = ((w // (128**d)) % 128).astype(dtype)
-        scaled = common.astype(dtype) * w_d[:, None]
+        # Weights scale the F-wide bitmap side, not the M-wide common
+        # side: a scaled [T_c, M] operand per digit was the dominant HBM
+        # intermediate at large row budgets (same regrouping as
+        # ops/count.py _weighted_matmul; integer arithmetic, exact).
         part = lax.dot_general(
-            scaled,
-            bitmap.astype(dtype),
+            common.astype(dtype),
+            bitmap.astype(dtype) * w_d[:, None],
             (((0,), (0,)), ((), ())),
             preferred_element_type=acc,
         )
@@ -200,7 +203,10 @@ def _fused_mine_local(
         # Support counting: common = (B Sᵀ == k-1); weighted matmul; psum.
         def contains_prefix(b):
             dt = jnp.float32 if fast_f32 else jnp.int8
-            acc = jnp.float32 if fast_f32 else jnp.int32
+            # int path: int8 output — intersection sizes are bounded by
+            # the set size k-1 <= l_max << 127, and the [T_c, M]
+            # intermediate's HBM traffic (not the MXU) bounds this phase.
+            acc = jnp.float32 if fast_f32 else jnp.int8
             overlap = lax.dot_general(
                 b.astype(dt), s.astype(dt), (((1,), (1,)), ((), ())),
                 preferred_element_type=acc,
@@ -452,11 +458,13 @@ def _tail_mine_local(
 
         def step(acc, xs):
             b_chunk, wd_chunk = xs
+            # int8 membership: values bounded by k-1 << 127, and the
+            # [t_c, p_cap] intermediate's HBM traffic bounds the phase.
             member = lax.dot_general(
                 b_chunk, s_p, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.int32,
+                preferred_element_type=jnp.int8,
             )  # [t_c, p_cap]
-            common = (member == (k - 1)).astype(jnp.int8)
+            common = (member == (k - 1).astype(jnp.int8)).astype(jnp.int8)
             return acc + _weighted_matmul(common, b_chunk, wd_chunk, scales), None
 
         acc0 = jnp.zeros((p_cap, f), dtype=jnp.int32)

@@ -25,6 +25,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fastapriori_tpu.ops import count as count_ops
@@ -456,13 +457,11 @@ class DeviceContext:
 
             def _local(bitmap, w_digits, min_count, num_items, *hv):
                 hb, hw = hv if hv else (None, None)
-                idx, cnt, n2, tri, counts = count_ops.local_pair_gather(
+                return count_ops.local_pair_gather(
                     bitmap, w_digits, scl, min_count, num_items, cap,
                     heavy_b=hb, heavy_w=hw,
                     axis_name=AXIS, fast_f32=fast_f32,
                 )
-                packed = jnp.concatenate([idx, cnt, jnp.stack([n2, tri])])
-                return packed, counts
 
             in_specs = (P(AXIS, None), P(None, AXIS), P(), P()) + (
                 (P(None, None), P(None)) if has_heavy else ()
@@ -487,6 +486,67 @@ class DeviceContext:
             int(out[2 * cap + 1]),
             counts_dev,
         )
+
+    def ingest_pair_miner(self, block_rows, t_pad: int, cap: int,
+                          census: bool):
+        """ONE dispatch from the per-block packed uploads straight to
+        (resident unpacked bitmap, packed pair-survivor output, resident
+        [F, F] count matrix) — the pipelined ingest submits it the moment
+        the last block lands, so bitmap assembly AND the whole pair phase
+        (C5 + C6) execute in the shadow of host-side weight/CSR assembly
+        (VERDICT r4 next #2: the reference's genTwoFreqItems is the first
+        thing after bitmap broadcast, FastApriori.scala:104).  The Gram
+        runs as one f32 matmul over the RAW int32 block weights — exact
+        while every count < 2^24 (the caller gates on n_raw) — so it
+        needs neither the weight-digit split nor the heavy-row
+        correction, which the host is still assembling at that moment.
+
+        Single-device-mesh only (the pipelined capture ingest's
+        precondition).  ``block_rows`` keys the compile on the per-block
+        shapes; ``census`` adds the level-3 triangle count
+        (ops/count.py _pair_triangles) for the engine auto-choice."""
+        key = ("ingest_pair", tuple(block_rows), t_pad, cap, census)
+        if key not in self._fns:
+            from fastapriori_tpu.ops.fused import _unpack
+
+            def _fn(blocks, ws, min_count, num_items):
+                pk = (
+                    jnp.concatenate(blocks, axis=0)
+                    if len(blocks) > 1
+                    else blocks[0]
+                )
+                total = pk.shape[0]
+                if t_pad > total:
+                    pk = jnp.concatenate(
+                        [
+                            pk,
+                            jnp.zeros(
+                                (t_pad - total, pk.shape[1]), jnp.uint8
+                            ),
+                        ],
+                        axis=0,
+                    )
+                bitmap = _unpack(pk)
+                w = jnp.concatenate(ws) if len(ws) > 1 else ws[0]
+                if t_pad > total:
+                    w = jnp.concatenate(
+                        [w, jnp.zeros(t_pad - total, jnp.int32)]
+                    )
+                b_f = bitmap.astype(jnp.float32)
+                scaled = b_f * w.astype(jnp.float32)[:, None]
+                counts = lax.dot_general(
+                    scaled,
+                    b_f,
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).astype(jnp.int32)
+                packed = count_ops.pair_threshold_pack(
+                    counts, min_count, num_items, cap, census
+                )
+                return bitmap, packed, counts
+
+            self._fns[key] = jax.jit(_fn)
+        return self._fns[key]
 
     def pair_regather(self, counts_dev, min_count: int, num_items: int,
                       cap: int):

@@ -170,6 +170,57 @@ def test_pipelined_ingest_matches_plain(tmp_path, seed, blocks, threads):
     assert d_pipe.total_count >= d_plain.total_count
 
 
+@pytest.mark.skipif(
+    not native_available(), reason="native extension not built"
+)
+@pytest.mark.parametrize("engine", ["level", "auto"])
+def test_ingest_overlapped_pair_matches_plain(tmp_path, engine):
+    """The ingest-overlapped pair program (mesh.ingest_pair_miner: one
+    dispatch for concat+unpack+f32 Gram+threshold, submitted before host
+    assembly) must be bit-exact vs the classic post-assembly pair gather
+    — including through a pair-cap overflow (regather over the resident
+    count matrix) and under the engine auto-choice, whose n2/census now
+    come from the same fetch."""
+    from conftest import random_dataset
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.models.apriori import FastApriori
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    d_raw = (
+        ["4 7 9 11"] * 150  # heavy rows: exercises w>=128 exactness
+        + random_dataset(21, n_txns=400, n_items=18, max_len=10)
+    )
+    path = tmp_path / "D.dat"
+    path.write_text("".join(l + "\n" for l in d_raw))
+
+    ctx = DeviceContext(num_devices=1)
+    # pair_cap=4 forces the overflow/regather path over pair_pre's
+    # resident count matrix (18 items make far more than 4 pairs).
+    cfg_pipe = MinerConfig(
+        min_support=0.03, engine=engine, ingest_pipeline_blocks=4,
+        ingest_threads=1, pair_cap=4,
+    )
+    miner_pipe = FastApriori(config=cfg_pipe, context=ctx)
+    lv_pipe, d_pipe = miner_pipe.run_file_raw(str(path))
+    pre_events = [
+        r
+        for r in miner_pipe.metrics.records
+        if r.get("event") == "bitmap_build"
+    ]
+    assert pre_events and pre_events[0].get("pair_overlapped") is True
+
+    cfg_plain = MinerConfig(
+        min_support=0.03, engine=engine, ingest_pipeline_blocks=1
+    )
+    lv_plain, d_plain = FastApriori(
+        config=cfg_plain, context=DeviceContext(num_devices=1)
+    ).run_file_raw(str(path))
+    assert len(lv_pipe) == len(lv_plain)
+    for (m_a, c_a), (m_b, c_b) in zip(lv_pipe, lv_plain):
+        assert (m_a == m_b).all() and (c_a == c_b).all()
+    assert (d_pipe.item_counts == d_plain.item_counts).all()
+
+
 def test_split_buffer_ranges_matches_read_shard(tmp_path):
     """split_buffer_ranges must agree byte-for-byte with read_shard's
     alignment rule on adversarial content (no trailing newline, empty
