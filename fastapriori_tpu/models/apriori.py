@@ -210,6 +210,31 @@ class FastApriori:
             )
         return self.mine_levels_raw(data), data
 
+    def _txn_multiple(self, n_chunks: int, total: int) -> int:
+        """Padding multiple for the transaction axis: per-chunk rows stay
+        whole (the level kernels reshape [T] -> [n_chunks, tc]) and, on
+        TPU, t_pad additionally aligns to 4096-row Pallas tiles — an
+        unaligned t_pad (e.g. 1660672 = 256·6487) forces the fused level
+        kernel down to 256-row tiles whose grid overhead eats the VMEM
+        win.  ``total`` is the actual (deduplicated) row count: the
+        alignment is taken only when it costs <= 5% extra zero-weight
+        rows (an LCM multiple sized far above ``total`` — small or
+        heavily-deduplicated datasets — could otherwise inflate every
+        level matmul by ~25%+; pick_tile just falls back to smaller
+        tiles there)."""
+        import math
+
+        from fastapriori_tpu.ops.bitmap import pad_axis
+
+        base = max(self.config.txn_tile, 32) * n_chunks
+        if self.context.platform == "tpu":
+            aligned = base * 4096 // math.gcd(base, 4096)
+            if pad_axis(total, aligned) <= 1.05 * max(
+                pad_axis(total, base), 1
+            ):
+                return aligned
+        return base
+
     def _can_pipeline_ingest(self, d_path: str) -> bool:
         """Pipelined ingest (per-block compress overlapped with the
         device upload) applies to the plain single-process local-file
@@ -342,7 +367,6 @@ class FastApriori:
         # bounded by n_raw, so an n_chunks derived from it can only be
         # (slightly) finer than the exact-count split — harmless.
         n_chunks = max(1, -(-n_raw // cfg.level_txn_chunk))
-        txn_multiple = max(cfg.txn_tile, 32) * n_chunks
 
         with self.metrics.timed("bitmap_build") as m:
             blocks = []  # (indices, offsets, weights) per block
@@ -394,6 +418,9 @@ class FastApriori:
                 # Host-side assembly (weights, CSR for API parity) runs
                 # BEFORE the upload-tail wait so it hides under the last
                 # blocks' transfers.
+                txn_multiple = self._txn_multiple(
+                    n_chunks, sum(len(bw) for _, _, bw in blocks)
+                )
                 asm = self._assemble_blocks(blocks, txn_multiple, f)
                 dev_blocks = [fu.result() for fu in dev_futures]
 
@@ -652,7 +679,9 @@ class FastApriori:
             # upload-tail wait, and the device concat/unpack book under
             # bitmap_build (the native call above is preprocess).
             n_chunks = max(1, -(-n_raw // cfg.level_txn_chunk))
-            txn_multiple = max(cfg.txn_tile, 32) * n_chunks
+            txn_multiple = self._txn_multiple(
+                n_chunks, sum(len(bw) for _, _, bw in blocks)
+            )
             with self.metrics.timed("bitmap_build") as m:
                 f_pad = state["f_pad"]
                 pair_pre = None
@@ -1260,8 +1289,10 @@ class FastApriori:
             n_chunks = max(1, -(-per_dev // cfg.level_txn_chunk))
             fast_f32 = self._fast_f32(data.n_raw)
             if shard is None:
+                # Alignment guard sized against PER-SHARD rows (the
+                # multiple below is per-shard x txn_shards).
                 txn_multiple = (
-                    max(cfg.txn_tile, 32) * ctx.txn_shards * n_chunks
+                    self._txn_multiple(n_chunks, per_dev) * ctx.txn_shards
                 )
                 packed_np, f_pad = build_packed_bitmap_csr(
                     data.basket_indices,
@@ -1508,6 +1539,15 @@ class FastApriori:
                     levels[:] = partial
                     cur = partial[-1][0]
 
+        # Deferred count resolution (single-process): per-level fetches
+        # carry only survivor bitmasks; counts resolve here in ONE
+        # dispatch + fetch after the loop.
+        pending_map: Dict[int, list] = {}
+        defer = jax.process_count() == 1
+
+        def finish(lvls):
+            return self._resolve_pending_counts(lvls, pending_map)
+
         # Levels >=3 (C7 + C8), reference termination rule
         # (FastApriori.scala:111).
         tail_rows = cfg.tail_fuse_rows
@@ -1537,7 +1577,7 @@ class FastApriori:
                     cur = tail[-1][0]
                     k = cur.shape[1] + 1
                 if complete:
-                    return levels
+                    return finish(levels)
                 continue  # incomplete: per-level from the last good level
             with self.metrics.timed("level", k=k) as m:
                 nxt, nxt_counts, lvl_stats = self._count_level(
@@ -1551,12 +1591,61 @@ class FastApriori:
                     n_chunks,
                     fast_f32,
                     heavy,
+                    defer_counts=defer,
                 )
                 m.update(frequent=nxt.shape[0], **lvl_stats)
+            if isinstance(nxt_counts, list):  # deferred (pending runs)
+                pending_map[len(levels)] = nxt_counts
+                nxt_counts = None
+            elif nxt_counts is None:  # empty level
+                nxt_counts = np.empty(0, dtype=np.int64)
             levels.append((nxt, nxt_counts))
             cur = nxt
             k += 1
-        return levels
+        return finish(levels)
+
+    def _resolve_pending_counts(self, levels, pending_map):
+        """ONE dispatch + ONE fetch for every deferred level's survivor
+        counts (the per-level transfers used to cross the slow tunnel
+        down-link padded ~4 bytes/candidate; this crosses exactly
+        4 bytes/SURVIVOR once).  ``pending_map``: level index ->
+        [(counts_dev, flat positions)] in row order."""
+        if not pending_map:
+            return levels
+        flat = []  # (level idx, counts_dev, pos) in level-major order
+        for idx in sorted(pending_map):
+            for counts_dev, pos in pending_map[idx]:
+                if pos.size:
+                    flat.append((idx, counts_dev, pos))
+        with self.metrics.timed("counts_resolve") as m:
+            out = (
+                self.context.gather_level_counts(
+                    [(c, p) for _, c, p in flat]
+                )
+                if flat
+                else np.empty(0, np.int64)
+            )
+            m.update(
+                levels=len(pending_map),
+                fetch_bytes=4 * int(out.size),
+            )
+        per_level: Dict[int, list] = {}
+        off = 0
+        for idx, _c, p in flat:
+            per_level.setdefault(idx, []).append(out[off : off + p.size])
+            off += p.size
+        resolved = []
+        for i, (mat, cnts) in enumerate(levels):
+            if cnts is None:
+                parts = per_level.get(i, [])
+                cnts = (
+                    np.concatenate(parts)
+                    if parts
+                    else np.empty(0, np.int64)
+                )
+                assert cnts.size == mat.shape[0], (cnts.size, mat.shape)
+            resolved.append((mat, cnts))
+        return resolved
 
     def _mine_tail(
         self, data, bitmap, w_digits, scales, cur: np.ndarray,
@@ -1662,11 +1751,15 @@ class FastApriori:
         n_chunks: int,
         fast_f32: bool = False,
         heavy: Optional[tuple] = None,
-    ) -> Tuple[np.ndarray, np.ndarray, dict]:
+        defer_counts: bool = True,
+    ) -> Tuple[np.ndarray, object, dict]:
         """C8 for one level, transfer-minimal: greedy chunks of at most
         P_CAP prefixes / C_CAP candidates go through the compiled-once
         gather kernel (ops/count.py local_level_gather); only each
-        candidate's own count comes back.
+        candidate's survivor BIT comes back per level — the counts stay
+        device-resident and resolve in one end-of-mine gather
+        (``defer_counts``; the second return is then the pending list,
+        otherwise the eager int64 counts).
 
         ``cand_blocks`` is an ITERATOR of ``(x_idx, ys)`` blocks in
         global ``(x_idx, y)`` order (candidates.gen_candidates_stream).
@@ -1711,8 +1804,8 @@ class FastApriori:
             if x_idx.size == 0:
                 continue
             stats["candidates"] += int(x_idx.size)
-            counts_blk = np.empty(x_idx.size, dtype=np.int64)
-            blocks.append((x_idx, ys, counts_blk))
+            keep_blk = np.empty(x_idx.size, dtype=bool)
+            blocks.append((x_idx, ys, keep_blk))
             # x_idx is sorted, so each unique prefix's candidates are one
             # contiguous run; chunks take whole runs.
             uniq_x, run_start = np.unique(x_idx, return_index=True)
@@ -1816,12 +1909,13 @@ class FastApriori:
                 pcs.append(np.full((p_cap, k_pad), zcol, dtype=cols_dt))
                 cis.append(np.zeros(c_cap, dtype=np.int32))
             hb, hw = heavy if heavy is not None else (None, None)
-            out = ctx.level_gather_batch(
+            bits, counts_out = ctx.level_gather_batch(
                 bitmap,
                 w_digits,
                 scales,
                 np.stack(pcs),
                 s,
+                min_count,
                 np.stack(cis),
                 n_chunks,
                 heavy_b=hb,
@@ -1829,10 +1923,10 @@ class FastApriori:
                 fast_f32=fast_f32,
             )
             try:
-                out.copy_to_host_async()
+                bits.copy_to_host_async()
             except (AttributeError, NotImplementedError):
                 pass
-            inflight.append((placed_all, out, counts_blk))
+            inflight.append((placed_all, bits, counts_out))
             # Per-launch cost model (metrics/MFU): membership matmul
             # [T, P_cap] + counting matmuls [P_cap, F] over padded
             # global shapes per scanned chunk — including the padding
@@ -1844,27 +1938,60 @@ class FastApriori:
             stats["psum_bytes"] += nb_pad * 4 * c_cap
         empty = (
             np.empty((0, s + 1), dtype=np.int32),
-            np.empty(0, dtype=np.int64),
+            None,
             stats,
         )
         if not blocks:
             return empty
-        # Collect: every launch is already in flight, so these waits
-        # overlap each other and any remaining device work.
-        for placed_all, out, counts_blk in inflight:
-            arr = np.asarray(out)  # [NB, C]
+        # Collect: only the survivor BITMASK crosses the link per level
+        # (C/8 bytes; the padded [NB, C] int32 fetch was 1-4 MB over a
+        # ~11-38 MB/s tunnel down-link — often more wall than the
+        # level's device time).  Counts stay device-resident; survivors'
+        # flat positions are recorded for the ONE end-of-mine gather
+        # (_resolve_pending_counts).
+        pending = []  # (counts_dev [NB, C], flat positions int64[n])
+        for (placed_all, bits, counts_out), blk in zip(inflight, blocks):
+            arr = np.unpackbits(np.asarray(bits), axis=1)  # [NB, C]
+            c_tot = arr.shape[1]
+            keep_blk = blk[2]
+            pos_parts = []
             for bi, placed in enumerate(placed_all):
                 for ci, off, n_c in placed:
-                    counts_blk[ci] = arr[bi, off : off + n_c]
+                    kb = arr[bi, off : off + n_c].astype(bool)
+                    keep_blk[ci] = kb
+                    if kb.any():
+                        pos_parts.append(
+                            np.int64(bi) * c_tot
+                            + off
+                            + np.flatnonzero(kb)
+                        )
+            pos = (
+                np.concatenate(pos_parts)
+                if pos_parts
+                else np.empty(0, np.int64)
+            )
+            pending.append((counts_out, pos))
         x_idx = np.concatenate([b[0] for b in blocks])
         ys = np.concatenate([b[1] for b in blocks])
-        counts_all = np.concatenate([b[2] for b in blocks])
-        keep = counts_all >= min_count
+        keep = np.concatenate([b[2] for b in blocks])
         if not keep.any():
             return empty
         nxt = np.concatenate(
             [level[x_idx[keep]], ys[keep, None]], axis=1
         ).astype(np.int32)
+        if not defer_counts:
+            # Multi-process SPMD: the deferred device gather would mix
+            # global and process-local arrays; fetch this level's count
+            # arrays now and slice on host (the pre-deferral behavior).
+            parts = [
+                np.asarray(c).reshape(-1)[p] for c, p in pending if p.size
+            ]
+            counts = (
+                np.concatenate(parts) if parts else np.empty(0, np.int64)
+            ).astype(np.int64)
+            return nxt, counts, stats
         # Blocks arrive in (x_idx, y) order and level is lex-sorted, so
-        # nxt is already lex-sorted — the invariant the next join needs.
-        return nxt, counts_all[keep], stats
+        # nxt is already lex-sorted — the invariant the next join needs;
+        # the pending positions are collected in the same order, so the
+        # resolved counts align row-for-row with nxt.
+        return nxt, pending, stats

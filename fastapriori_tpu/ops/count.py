@@ -318,6 +318,7 @@ def local_level_gather(
     axis_name: Optional[str] = None,
     cand_axis_name: Optional[str] = None,
     fast_f32: bool = False,
+    pallas_tiles: Optional[tuple] = None,
 ) -> jnp.ndarray:
     """C8, transfer-minimal form: one compilation serves EVERY level.
 
@@ -347,6 +348,13 @@ def local_level_gather(
     the weights folded into the membership mask — ONE counting matmul
     instead of D digit matmuls.  Exact only when counts < 2^24 (caller's
     guard); intersection sizes are bounded by F, also f32-exact.
+
+    ``pallas_tiles``: ``(t_tile, m_tile)`` — run the fused Pallas kernel
+    (ops/pallas_level.py) instead of the chunked scan: the [tc, P]
+    membership intermediate stays in VMEM tile-by-tile, removing the HBM
+    write+read that bounds this phase on real chips.  TPU path only;
+    the caller (parallel/mesh.py level_gather_batch) picks tiles that
+    divide the local shapes or passes None.
     """
     t_loc, f_pad = bitmap.shape
     p = prefix_cols.shape[0]
@@ -359,6 +367,27 @@ def local_level_gather(
         .at[jnp.arange(p)[:, None], prefix_cols.astype(jnp.int32)]
         .set(1)
     )
+    if pallas_tiles is not None and not fast_f32:
+        from fastapriori_tpu.ops.pallas_level import level_counts_pallas
+
+        # Caller gates on the single LOW digit; a scaled single digit
+        # (scale != 1) would be silently dropped below, so reject it.
+        assert tuple(scales) == (1,), scales
+        tt, mt = pallas_tiles
+        # w ⊙ B computed here (XLA, one [T, F] int8 elementwise): it is
+        # loop-invariant across the NB-block scan above, so XLA hoists
+        # it to once per launch.
+        wb = bitmap * w_digits[0][:, None]
+        counts = level_counts_pallas(
+            bitmap, wb, onehot, k1, t_tile=tt, m_tile=mt
+        )
+        if heavy_b is not None:
+            counts = counts + heavy_level_correction(
+                onehot, k1, heavy_b, heavy_w, axis_name
+            )
+        local = jnp.take(counts.reshape(-1), cand_idx)
+        return _psum_if(local, axis_name)
+
     tc = t_loc // n_chunks
     bm = bitmap.reshape(n_chunks, tc, f_pad)
     wd = w_digits.reshape(d, n_chunks, tc).transpose(1, 0, 2)
@@ -439,6 +468,7 @@ def local_level_gather_batch(
     axis_name: Optional[str] = None,
     cand_axis_name: Optional[str] = None,
     fast_f32: bool = False,
+    pallas_tiles: Optional[tuple] = None,
 ) -> jnp.ndarray:
     """A whole level's prefix blocks in ONE launch: ``lax.scan`` over the
     stacked blocks, each step = :func:`local_level_gather`.  Kernel
@@ -462,11 +492,30 @@ def local_level_gather_batch(
             axis_name=axis_name,
             cand_axis_name=cand_axis_name,
             fast_f32=fast_f32,
+            pallas_tiles=pallas_tiles,
         )
         return carry, out
 
     _, outs = lax.scan(step, jnp.int32(0), (prefix_stack, cand_stack))
     return outs
+
+
+def pack_bits_msb(mask: jnp.ndarray) -> jnp.ndarray:
+    """Bool [..., C] -> uint8 [..., C//8], MSB-first (numpy.packbits
+    layout, so the host side unpacks with np.unpackbits)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    b = mask.reshape(*mask.shape[:-1], -1, 8).astype(jnp.uint8)
+    return jnp.sum(b << shifts, axis=-1).astype(jnp.uint8)
+
+
+def keep_bits(counts: jnp.ndarray, min_count: jnp.ndarray) -> jnp.ndarray:
+    """Survivor bitmask of a gathered count array — the ONLY per-level
+    host fetch (VERDICT r4 weak #6 follow-through: the [NB, C] int32
+    fetch was 1-4 MB per level over a ~11-38 MB/s tunnel down-link,
+    often exceeding the level's device time; the mask is C/8 bytes and
+    the counts stay device-resident for one packed end-of-mine gather,
+    models/apriori.py _resolve_pending_counts)."""
+    return pack_bits_msb(counts >= min_count)
 
 
 def local_item_supports(

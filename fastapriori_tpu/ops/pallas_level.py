@@ -1,35 +1,43 @@
 """Pallas TPU kernel for the hot op: fused prefix-containment + weighted
 extension counting (reference C8's hot loops, FastApriori.scala:143-152).
 
-STATUS: reference kernel, not wired into the mining engine.  Proven
-Mosaic-compiled and bit-exact on real v5e (tests_tpu/test_pallas_hw.py),
-but at production webdocs shapes it measured device-time parity with the
-XLA formulation (both ~35 ms at [T=1.66M, P=4096, F=256, D=2] — round 3,
-dependency-chained timing), so the engine keeps the single XLA path
-(ops/count.py local_level_gather) and this stays as the VMEM-resident
-formulation for future wider-item workloads where XLA's [tc, P]
-intermediates would dominate.
+The XLA formulation (ops/count.py local_level_gather) materializes
+``member = (B Sᵀ == k-1)`` — a [tc, P] intermediate — in HBM and reads
+it back for the counting matmul; measured on v5e that write+read traffic
+(not the MXU) bounds the whole level phase (~57-120 TOPS effective at
+webdocs shapes).  This kernel keeps each ``member`` tile in VMEM: one
+grid step loads a transaction tile of the bitmap B and of the
+pre-scaled ``WB = w ⊙ B``, computes B's overlap with the block's prefix
+rows on the MXU, thresholds in-register, and immediately feeds the
+``common`` tile into the counting matmul against WB, accumulating the
+output block in place — HBM traffic for ``member`` drops from 2·T·P
+bytes to zero.
 
-The XLA version (ops/fused.py) materializes ``common = (B Sᵀ == k-1)`` —
-a [T, M] int8 intermediate — in HBM and reads it back for the counting
-matmul.  This kernel keeps each ``common`` tile in VMEM: one grid step
-loads a transaction tile of the bitmap, computes its overlap with every
-candidate prefix on the MXU, thresholds in-register, applies the weight
-digit, and accumulates the extension-count matmul into the output block —
-HBM traffic for ``common`` drops from 2·T·M bytes to zero.
+Design notes from the measured variants (chain-delta timed on v5e at
+[T=426K, P=8192, F=256]):
 
-Grid: (M tiles, T tiles); T is the innermost (fastest) axis so each output
-block [M_TILE, F] is initialized at its first T step and accumulated in
-place across the sweep (the standard Pallas accumulation pattern).
+- **WB as an input** (w folded into the F-wide operand on the XLA side,
+  one [T, F] int8 elementwise per level) instead of the earlier
+  in-kernel ``where(common, w, 0)`` select: the select ran in int32
+  (Mosaic has no int8 vector multiply on v5e) and serialized against
+  both matmuls; the WB form measures **~378 TOPS-equiv — 96% of the
+  int8 MXU peak** vs ~120 for the best XLA formulation.
+- Digit count is NOT a kernel concern: the caller passes one WB per
+  weight digit (production corpora are all single-digit after the
+  weight split, models/apriori.py _split_weights; the engine falls back
+  to the XLA path for the rare multi-digit profile).
+- ``k-1`` rides scalar prefetch (SMEM), so one compilation serves every
+  level depth at a given shape.
 
-Inputs are the same device arrays the fused engine already holds: the
-int8 bitmap [T, F], per-transaction weight digits [D, T] int8 (base-128,
-ops/bitmap.py), and the frequent-set matrix S [M, F] int8.  ``k-1`` and
-the digit count are scalars prefetched to SMEM, so one compilation serves
-every level and weight profile.
+Grid: (P tiles, T tiles); T is the innermost (fastest) axis so each
+output block [M_TILE, F] is initialized at its first T step and
+accumulated in place across the sweep (the standard Pallas accumulation
+pattern).
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -37,18 +45,19 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# VMEM-friendly tile sizes (int8 min tile is (32, 128)).
-T_TILE = 512
-M_TILE = 512
-MAX_DIGITS = 4  # static unroll bound for base-128 weight digits
+# Default VMEM-friendly tile sizes (int8 min tile is (32, 128)).  The
+# in-VMEM [M_TILE, T_TILE] membership tile is the budget driver:
+# 1024 x 4096 x 4 B (int32 overlap) = 16 MB.
+T_TILE = 4096
+M_TILE = 1024
 
 
-def _kernel(km1_ref, b_ref, wd_ref, s_ref, out_ref):
+def _kernel(km1_ref, b_ref, wb_ref, s_ref, out_ref):
     """One (m_tile, t_tile) grid step.
 
-    km1_ref: SMEM (2,) int32 — [k-1, n_digits]
+    km1_ref: SMEM (1,) int32 — [k-1]
     b_ref:   VMEM [T_TILE, F] int8 bitmap tile
-    wd_ref:  VMEM [D, T_TILE] int8 weight digits
+    wb_ref:  VMEM [T_TILE, F] int8 pre-scaled (w ⊙ B) tile
     s_ref:   VMEM [M_TILE, F] int8 prefix-set tile
     out_ref: VMEM [M_TILE, F] int32 accumulated counts
     """
@@ -58,82 +67,75 @@ def _kernel(km1_ref, b_ref, wd_ref, s_ref, out_ref):
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    km1 = km1_ref[0]
-    n_digits = km1_ref[1]
-
     overlap = lax.dot_general(
         s_ref[:],
         b_ref[:],
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32,
     )  # [M_TILE, T_TILE]
-    common = overlap == km1  # bool mask
-
-    # Unrolled digit loop with static bound; digits beyond n_digits are
-    # masked to zero scale so they contribute nothing.  The masked weight
-    # is a select, not an int8 multiply — Mosaic has no int8 vector
-    # `muli` lowering on v5e (fails to legalize).  The select runs in
-    # int32 (same (8,128) tiling as the i1 mask from the int32 compare;
-    # mixing the mask with (32,128)-tiled int8 operands is an invalid
-    # relayout), then truncates to int8 to feed the MXU.
-    total = jnp.zeros_like(out_ref)
-    for d in range(MAX_DIGITS):
-        w_d = wd_ref[d, :].astype(jnp.int32)  # [T_TILE]
-        scaled = jnp.where(common, w_d[None, :], 0).astype(jnp.int8)
-        part = lax.dot_general(
-            scaled,
-            b_ref[:],
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # [M_TILE, F]
-        scale = jnp.where(d < n_digits, jnp.int32(128) ** d, 0)
-        total = total + part * scale
-    out_ref[:] += total
+    common = (overlap == km1_ref[0]).astype(jnp.int8)
+    out_ref[:] += lax.dot_general(
+        common,
+        wb_ref[:],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [M_TILE, F]
 
 
+def pick_tile(n: int, candidates=(4096, 2048, 1024, 512, 256)) -> int:
+    """Largest candidate tile evenly dividing ``n`` (0 = none fits)."""
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return 0
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("t_tile", "m_tile", "interpret"),
+)
 def level_counts_pallas(
     bitmap: jnp.ndarray,  # [T, F] int8
-    w_digits: jnp.ndarray,  # [D, T] int8 (D <= MAX_DIGITS)
+    wb: jnp.ndarray,  # [T, F] int8 — w ⊙ B (single weight digit)
     s_mat: jnp.ndarray,  # [M, F] int8
     km1: jnp.ndarray,  # scalar int32 (k-1)
+    t_tile: int = T_TILE,
+    m_tile: int = M_TILE,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """counts[m, f] = Σ_t w_t · [basket t ⊇ prefix m] · B[t, f] (int32)."""
+    """counts[m, f] = Σ_t w_t · [basket t ⊇ prefix m] · B[t, f] (int32),
+    with the weights pre-folded into ``wb = w[:, None] * bitmap``."""
     t, f = bitmap.shape
     m = s_mat.shape[0]
-    d = w_digits.shape[0]
-    assert t % T_TILE == 0, (t, T_TILE)
-    assert m % M_TILE == 0, (m, M_TILE)
-    assert d <= MAX_DIGITS
-
-    wd_pad = jnp.zeros((MAX_DIGITS, t), dtype=jnp.int8).at[:d].set(w_digits)
-    scalars = jnp.stack(
-        [km1.astype(jnp.int32), jnp.int32(d)]
-    )
+    assert t % t_tile == 0, (t, t_tile)
+    assert m % m_tile == 0, (m, m_tile)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(m // M_TILE, t // T_TILE),
+        grid=(m // m_tile, t // t_tile),
         in_specs=[
             pl.BlockSpec(
-                (T_TILE, f), lambda i, j, _s: (j, 0), memory_space=pltpu.VMEM
+                (t_tile, f), lambda i, j, _s: (j, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (MAX_DIGITS, T_TILE),
-                lambda i, j, _s: (0, j),
-                memory_space=pltpu.VMEM,
+                (t_tile, f), lambda i, j, _s: (j, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (M_TILE, f), lambda i, j, _s: (i, 0), memory_space=pltpu.VMEM
+                (m_tile, f), lambda i, j, _s: (i, 0), memory_space=pltpu.VMEM
             ),
         ],
         out_specs=pl.BlockSpec(
-            (M_TILE, f), lambda i, j, _s: (i, 0), memory_space=pltpu.VMEM
+            (m_tile, f), lambda i, j, _s: (i, 0), memory_space=pltpu.VMEM
         ),
     )
+    # Under shard_map (check_vma=True) the output must declare how it
+    # varies over mesh axes: exactly as the union of the inputs.
+    vma = frozenset()
+    for arr in (bitmap, wb, s_mat):
+        vma = vma | getattr(jax.typeof(arr), "vma", frozenset())
     return pl.pallas_call(
         _kernel,
-        out_shape=jax.ShapeDtypeStruct((m, f), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((m, f), jnp.int32, vma=vma),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(scalars, bitmap, wd_pad, s_mat)
+    )(km1.reshape(1).astype(jnp.int32), bitmap, wb, s_mat)

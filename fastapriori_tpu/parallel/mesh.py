@@ -20,6 +20,7 @@ hosts.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -60,6 +61,18 @@ def allgather_bytes(blob: bytes) -> list:
     return [
         bytes(gathered[i, : int(lens[i])]) for i in range(gathered.shape[0])
     ]
+
+
+@jax.jit
+def _gather_counts_jit(counts_list, pos_list):
+    """Per-level survivor gathers concatenated into one fetchable array
+    (jit's own per-shape cache covers the varying level shapes)."""
+    return jnp.concatenate(
+        [
+            jnp.take(c.reshape(-1), p)
+            for c, p in zip(counts_list, pos_list)
+        ]
+    )
 
 
 class DeviceContext:
@@ -578,35 +591,64 @@ class DeviceContext:
         scales,
         prefix_stack,
         k1: int,
+        min_count: int,
         cand_stack,
         n_chunks: int,
         heavy_b=None,
         heavy_w=None,
         fast_f32: bool = False,
-    ) -> jax.Array:
+    ) -> tuple:
         """A whole level's blocks in one launch (ops/count.py
         local_level_gather_batch) — launches carry ~100 ms of fixed
         round-trip cost on tunneled backends, so NB blocks pay it once.
         ``heavy_b``/``heavy_w``: replicated heavy-row remainder arrays
         (single-low-digit weight split); None = legacy multi-digit.
-        Returns ``[NB, C]`` gathered counts."""
+        Returns ``(bits [NB, C//8] uint8, counts [NB, C] int32)`` — the
+        survivor bitmask is the only host-bound output (fetch C/8 bytes,
+        not 4C); counts stay resident for :meth:`gather_level_counts`."""
         has_heavy = heavy_b is not None
+        # Fused Pallas path (TPU only): the [tc, P] membership
+        # intermediate stays in VMEM tile-by-tile instead of round-
+        # tripping HBM — the measured bound of the level phase.  Tiles
+        # must divide the PER-SHARD shapes; any misfit (or
+        # FA_NO_PALLAS=1) falls back to the chunked-scan XLA path.
+        pallas_tiles = None
+        if (
+            self.platform == "tpu"
+            and not fast_f32
+            and tuple(scales) == (1,)  # kernel takes ONE unscaled w ⊙ B
+            and not os.environ.get("FA_NO_PALLAS")
+        ):
+            from fastapriori_tpu.ops.pallas_level import pick_tile
+
+            # t generous (B tiles are cheap: [tt, F] int8), m bounded so
+            # the in-VMEM [mt, tt] membership tile stays <= 16 MB.
+            tt = pick_tile(bitmap.shape[0] // self.txn_shards)
+            mt = pick_tile(
+                prefix_stack.shape[1] // self.cand_shards,
+                candidates=(1024, 512, 256),
+            )
+            if tt and mt:
+                pallas_tiles = (tt, mt)
         key = (
             "level_gather_batch", tuple(scales), n_chunks, fast_f32,
-            has_heavy,
+            has_heavy, pallas_tiles,
         )
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
+            p_tiles = pallas_tiles
 
-            def _local(bitmap, w_digits, ps, k1, cs, *hv):
+            def _local(bitmap, w_digits, ps, k1, mc, cs, *hv):
                 hb, hw = hv if hv else (None, None)
-                return count_ops.local_level_gather_batch(
+                counts = count_ops.local_level_gather_batch(
                     bitmap, w_digits, scl, ps, k1, cs, n_chunks,
                     heavy_b=hb, heavy_w=hw,
                     axis_name=AXIS, cand_axis_name=CAND,
                     fast_f32=fast_f32,
+                    pallas_tiles=p_tiles,
                 )
+                return count_ops.keep_bits(counts, mc), counts
 
             # Blocks unsharded (scanned on device); prefix rows and the
             # candidate gather sharded over cand; heavy remainder arrays
@@ -616,6 +658,7 @@ class DeviceContext:
                 P(None, AXIS),
                 P(None, CAND, None),
                 P(),
+                P(),
                 P(None, CAND),
             ) + ((P(None, None), P(None)) if has_heavy else ())
             self._fns[key] = jax.jit(
@@ -623,13 +666,33 @@ class DeviceContext:
                     _local,
                     mesh=mesh,
                     in_specs=in_specs,
-                    out_specs=P(None, CAND),
+                    out_specs=(P(None, CAND), P(None, CAND)),
                 )
             )
-        args = [bitmap, w_digits, prefix_stack, jnp.int32(k1), cand_stack]
+        args = [
+            bitmap, w_digits, prefix_stack, jnp.int32(k1),
+            jnp.int32(min_count), cand_stack,
+        ]
         if has_heavy:
             args += [heavy_b, heavy_w]
         return self._fns[key](*args)
+
+    def gather_level_counts(self, pending):
+        """End-of-mine survivor-count resolution in ONE dispatch + ONE
+        fetch: ``pending`` is ``[(counts_dev [NB, C] int32, flat
+        positions)]`` per deferred level — each level's survivor
+        positions gathered from its resident count array, concatenated,
+        and fetched once (the per-level count fetches used to cross the
+        slow tunnel down-link padded; this crosses exact bytes once).
+        Positions are cast to int32 on upload ([NB, C] count arrays
+        anywhere near 2^31 elements would exhaust HBM long before the
+        cast could overflow).  Returns concatenated int64 counts
+        (host)."""
+        out = _gather_counts_jit(
+            tuple(c for c, _ in pending),
+            tuple(jnp.asarray(p.astype(np.int32)) for _, p in pending),
+        )
+        return np.asarray(out).astype(np.int64)
 
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
         pair, _, _ = self._get_fns(tuple(scales))
