@@ -617,7 +617,8 @@ class DeviceContext:
             self.platform == "tpu"
             and not fast_f32
             and tuple(scales) == (1,)  # kernel takes ONE unscaled w ⊙ B
-            and not os.environ.get("FA_NO_PALLAS")
+            and os.environ.get("FA_NO_PALLAS", "").lower()
+            not in ("1", "true", "yes")
         ):
             from fastapriori_tpu.ops.pallas_level import pick_tile
 

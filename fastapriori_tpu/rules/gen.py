@@ -141,7 +141,18 @@ def rule_arrays_from_tables(
     f = 1 + max(
         (int(mat.max()) for mat, _ in mats.values() if mat.size), default=0
     )
+    # Raw generation keeps, per k-itemset and deleted column e, the ROW
+    # INDEX of S - {e} in the (k-1)-itemset table (computed anyway for
+    # the confidence denominators).  The dominance prune then addresses
+    # each parent RULE in O(1): raw rules of antecedent size k-2 are
+    # concatenated consequent-position-major, so rule (S-{e} -> j) lives
+    # at flat index j_pos_in(S-{e}) * N_{k-1} + row(S-{e}) — no key
+    # rebuild, no argsort, no searchsorted over 16M-row tables (the
+    # level-wise subset joins were phase 2's dominant cost at
+    # webdocs/minSupport=0.092 scale, VERDICT r4 next #5).
     raw: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+    parent_rows: Dict[int, np.ndarray] = {}  # k -> int32 [k, N_k]
+    n_sets: Dict[int, int] = {}
     for k in sorted(mats):
         if k < 2:
             continue
@@ -154,10 +165,13 @@ def rule_arrays_from_tables(
             )
         mat, cnts = mats[k]
         pmat, pcnts = mats[k - 1]
+        n_sets[k] = mat.shape[0]
+        n_sets[k - 1] = pmat.shape[0]
         pview = _row_keys(pmat, f)
         porder = np.argsort(pview)
         psorted = pview[porder]
         ants, conss, confs = [], [], []
+        rows_e = np.empty((k, mat.shape[0]), dtype=np.int32)
         for j in range(k):
             ant = np.delete(mat, j, axis=1)  # sorted rows stay sorted
             idx, found = _lookup_rows(psorted, porder, _row_keys(ant, f))
@@ -174,11 +188,13 @@ def rule_arrays_from_tables(
             ants.append(ant)
             conss.append(mat[:, j])
             confs.append(cnts / pcnts[idx].astype(np.float64))
+            rows_e[j] = idx
         raw[k - 1] = (
             np.concatenate(ants),
             np.concatenate(conss),
             np.concatenate(confs),
         )
+        parent_rows[k] = rows_e
 
     if not raw:
         return []
@@ -189,37 +205,55 @@ def rule_arrays_from_tables(
 
     surv_ant, surv_cons, surv_conf = raw[min_len]
     out.append((surv_ant, surv_cons, surv_conf))
+    # Raw-indexed survival of the PREVIOUS antecedent size (every
+    # min_len rule survives, matching the reference's base case).
+    prev_surv = np.ones(len(surv_cons), dtype=bool)
+    prev_conf = surv_conf
     for i in range(min_len + 1, max_len + 1):
-        # Surviving lower-level rules keyed by (antecedent cols, cons).
-        low_key = _row_keys(
-            np.concatenate([surv_ant, surv_cons[:, None]], axis=1), f
-        )
-        lorder = np.argsort(low_key)
-        lsorted = low_key[lorder]
-        lconf = surv_conf
         if i not in raw:
             surv_ant = np.zeros((0, i), np.int32)
             surv_cons = np.zeros(0, np.int32)
             surv_conf = np.zeros(0)
+            prev_surv = np.zeros(0, dtype=bool)
+            prev_conf = np.zeros(0)
             continue
         ant, cons, conf = raw[i]
+        k = i + 1  # these rules come from k-itemsets
+        n_k = n_sets[k]
+        n_prev = n_sets[k - 1]
+        rows_e = parent_rows[k]
+        if prev_surv.size == 0 and n_prev > 0:
+            # Defensive twin of the old key-lookup behavior after a
+            # level gap (unreachable while the downward-closure guard
+            # holds): no surviving parents -> every rule here is pruned.
+            surv_ant = np.zeros((0, i), np.int32)
+            surv_cons = np.zeros(0, np.int32)
+            surv_conf = np.zeros(0)
+            out.append((surv_ant, surv_cons, surv_conf))
+            prev_surv = np.zeros(len(cons), dtype=bool)
+            prev_conf = conf
+            continue
         ok = np.ones(len(cons), dtype=bool)
-        for e in range(i):
-            key = _row_keys(
-                np.concatenate(
-                    [np.delete(ant, e, axis=1), cons[:, None]], axis=1
-                ),
-                f,
-            )
-            idx, found = _lookup_rows(lsorted, lorder, key)
-            # Survive iff EVERY (ant - {e}) -> cons survived below (:173)
-            # with strictly lower confidence (:168).
-            sub_conf = np.where(
-                found, lconf[idx] if len(lconf) else 0.0, np.inf
-            )
-            ok &= found & (sub_conf < conf)
+        for j_pos in range(k):
+            # This consequent position's slice of the raw arrays
+            # (concatenation above is j-major).
+            sl = slice(j_pos * n_k, (j_pos + 1) * n_k)
+            conf_j = conf[sl]
+            ok_j = ok[sl]
+            for e_pos in range(k):
+                if e_pos == j_pos:
+                    continue
+                # Parent rule (S - {e_pos}) -> S[j_pos]: consequent
+                # position shifts down when the deleted column precedes
+                # it.  Survive iff the parent survived with strictly
+                # lower confidence (AssociationRules.scala:168,173).
+                jp = j_pos - (e_pos < j_pos)
+                pidx = jp * n_prev + rows_e[e_pos]
+                ok_j &= prev_surv[pidx] & (prev_conf[pidx] < conf_j)
         surv_ant, surv_cons, surv_conf = ant[ok], cons[ok], conf[ok]
         out.append((surv_ant, surv_cons, surv_conf))
+        prev_surv = ok
+        prev_conf = conf
     return out
 
 
