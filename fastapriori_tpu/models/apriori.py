@@ -52,6 +52,7 @@ def _fused_m_cap_memory_limit(
     n_chunks: int,
     unpacked_resident: bool = False,
     cap: Optional[int] = None,
+    tail_chunked: bool = False,
 ) -> int:
     """Largest power-of-two row budget whose fused program provably fits
     the per-device HBM budget — so an oversized m_cap is never compiled
@@ -85,8 +86,14 @@ def _fused_m_cap_memory_limit(
     m = _next_pow2(cfg.fused_l_max + 2)
 
     def bytes_at(m: int) -> int:
+        # Tail folds chunk the [m, m] candidate-gen intermediates
+        # (ops/fused.py tail_cand_row_chunks caps each block at 512 MB),
+        # so their peak is bounded; the fused engine runs unchunked.
+        cand = 8 * m * m
+        if tail_chunked:
+            cand = min(cand, 2 * (512 << 20))
         return (
-            8 * m * m
+            cand
             + 14 * m * f_pad
             + 5 * t_c * m
             + (3 * cfg.fused_l_max + 1) * m * 4
@@ -1550,24 +1557,46 @@ class FastApriori:
 
         # Levels >=3 (C7 + C8), reference termination rule
         # (FastApriori.scala:111).
+        # Shrink evidence is an AUTO-mode heuristic only: an explicit
+        # tail_fuse_rows forces folding whenever the seed fits it
+        # (config.py documents the explicit value as platform-
+        # independent and forcing).
+        auto_tail = cfg.tail_fuse_rows is None
         tail_rows = cfg.tail_fuse_rows
         if tail_rows is None:
             # Auto: the fold amortizes the per-launch round-trip floor,
             # which cpu backends don't have (and every distinct seed
-            # depth would pay a fresh while-loop compile there).
-            tail_rows = 0 if ctx.platform == "cpu" else 16384
+            # depth would pay a fresh while-loop compile there).  The
+            # 64K ceiling is what the chunked candidate-gen +
+            # descending-slot output admit (webdocs folds from the
+            # 64,427-row k=9 level, absorbing k=10..13 in one
+            # dispatch); seeds past the legacy 16K bar additionally
+            # require SHRINKING evidence (see below) so a still-growing
+            # mid-lattice never wastes a doomed fold dispatch.
+            tail_rows = 0 if ctx.platform == "cpu" else 65536
         tail_ok = (
             tail_rows > 0
             and ctx.cand_shards == 1
             and data.shard is None
         )
         k = cur.shape[1] + 1
+        prev_rows = None  # previous level's row count (shrink signal)
         while cur.shape[0] >= k:
             # k > 3: never fold straight off the pair level — small
             # lattices that fit a whole-loop program are the fused
             # engine's job (the auto choice), and the fold's seed should
             # be a level the per-level engine already counted.
-            if tail_ok and k > 3 and cur.shape[0] <= tail_rows:
+            shrink_ok = (
+                not auto_tail
+                or cur.shape[0] <= 16384
+                or (prev_rows is not None and cur.shape[0] < prev_rows)
+            )
+            if (
+                tail_ok
+                and k > 3
+                and cur.shape[0] <= tail_rows
+                and shrink_ok
+            ):
                 tail, complete = self._mine_tail(
                     data, bitmap, w_digits, scales, cur, n_chunks, heavy
                 )
@@ -1600,6 +1629,7 @@ class FastApriori:
             elif nxt_counts is None:  # empty level
                 nxt_counts = np.empty(0, dtype=np.int64)
             levels.append((nxt, nxt_counts))
+            prev_rows = cur.shape[0]
             cur = nxt
             k += 1
         return finish(levels)
@@ -1681,10 +1711,19 @@ class FastApriori:
         # tail's own need, NOT fused_m_cap_max (an unrelated knob).
         if m_cap > _fused_m_cap_memory_limit(
             cfg, ctx, t_pad, f_pad, n_chunks, unpacked_resident=True,
-            cap=m_cap,
+            cap=m_cap, tail_chunked=True,
         ):
             return [], False
-        p_cap = min(cfg.tail_fuse_p_cap, m_cap)
+        # Prefix budget scales with LARGE seeds: a 64K-row fold's first
+        # level can have ~10K prefixes with extensions — the configured
+        # cap (tuned for the legacy 16K regime) would trip the in-kernel
+        # abort on every run.  At or below 16K the knob keeps its exact
+        # configured meaning (tests force tiny caps to drive the abort
+        # path).
+        p_cap = cfg.tail_fuse_p_cap
+        if m_cap > 16384:
+            p_cap = max(p_cap, m_cap // 8)
+        p_cap = min(p_cap, m_cap)
         # The level engine's chunk count bounds a [t_c, P] intermediate
         # sized for its own prefix caps; the tail's [t_c, p_cap] is
         # narrower, so consolidate chunks (fewer scan steps per
@@ -1715,8 +1754,10 @@ class FastApriori:
             if heavy is not None:
                 args += [hb, hw]
             packed_out = np.asarray(fn(*args))
-            rows, cols, counts, n_lvl, incomplete, _ = (
-                fused.unpack_fused_result(packed_out, cfg.tail_fuse_l_max)
+            rows, cols, counts, n_lvl, incomplete = (
+                fused.unpack_tail_result(
+                    packed_out, m_cap, cfg.tail_fuse_l_max
+                )
             )
             # MACs: per stored level, candidate gen (two [m_cap, m_cap]
             # f32 matmuls) + membership/counting over the compacted
@@ -1735,7 +1776,9 @@ class FastApriori:
                 upload_bytes=seed.nbytes * ctx.n_devices,
             )
         lvls = fused.decode_level_matrices(
-            rows, cols, counts, n_lvl, max_rows=m_cap, prev=cur
+            rows, cols, counts, n_lvl,
+            max_rows=fused.tail_slot_caps(m_cap, cfg.tail_fuse_l_max),
+            prev=cur,
         )
         return lvls, not bool(incomplete)
 

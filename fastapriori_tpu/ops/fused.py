@@ -63,7 +63,7 @@ def _unpack(packed: jnp.ndarray) -> jnp.ndarray:
     return bits.reshape(packed.shape[0], packed.shape[1] * 8).astype(jnp.int8)
 
 
-def _gen_candidates_matmul(s, k, col_ids, valid_row):
+def _gen_candidates_matmul(s, k, col_ids, valid_row, row_chunks: int = 1):
     """Candidate generation as matmuls (module docstring): from the
     frequent (k-1)-set one-hot matrix ``s`` [M, F], the Boolean [M, F]
     candidate mask — ``cand[x, y]`` iff every (k-1)-subset of x∪{y}
@@ -72,18 +72,34 @@ def _gen_candidates_matmul(s, k, col_ids, valid_row):
     accumulation is exact — and f32 matmuls hit the fast path on every
     backend (MXU on TPU, BLAS on the CPU fallback; XLA-CPU integer
     matmuls are orders slower).  Shared by the whole-loop miner and the
-    shallow-tail miner so the two can never drift."""
+    shallow-tail miner so the two can never drift.
+
+    ``row_chunks``: process the [M, M] intersection matrix in row
+    blocks of M/row_chunks via lax.scan — the peak intermediate drops
+    from 8·M² bytes to 8·M²/row_chunks, which is what lets the
+    shallow-tail fold take 64K-row seeds (8·65536² = 34 GB unchunked)."""
     s_f = s.astype(jnp.float32)
-    d_mat = lax.dot_general(
-        s_f, s_f, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [M, M] pairwise intersection sizes
-    e_mat = (d_mat == (k - 2).astype(jnp.float32)).astype(jnp.float32)
-    cand_cnt = lax.dot_general(
-        e_mat, s_f, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.int32)  # [M, F]
     rowmax = jnp.max(jnp.where(s > 0, col_ids[None, :], -1), axis=1)
+
+    def blk(s_blk):
+        d_blk = lax.dot_general(
+            s_blk, s_f, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [mb, M] pairwise intersection sizes
+        e_blk = (d_blk == (k - 2).astype(jnp.float32)).astype(jnp.float32)
+        return lax.dot_general(
+            e_blk, s_f, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)  # [mb, F]
+
+    if row_chunks <= 1:
+        cand_cnt = blk(s_f)
+    else:
+        m, f = s_f.shape
+        assert m % row_chunks == 0, (m, row_chunks)
+        sb = s_f.reshape(row_chunks, m // row_chunks, f)
+        _, parts = lax.scan(lambda c, xb: (c, blk(xb)), jnp.int32(0), sb)
+        cand_cnt = parts.reshape(m, f)
     return (
         (cand_cnt == (k - 1))
         & (col_ids[None, :] > rowmax[:, None])
@@ -379,6 +395,8 @@ def _tail_mine_local(
     l_max: int,
     n_chunks: int,
     axis_name: Optional[str],
+    slot_caps: Tuple[int, ...],  # per-tail-level row caps (static)
+    cand_row_chunks: int = 1,
 ):
     """Shallow-tail fold (VERDICT r3 task 4): once the level engine's
     survivor count drops under the fold threshold, the REMAINING level
@@ -403,12 +421,20 @@ def _tail_mine_local(
     - counting uses the level engine's weighted form (base-128 digit
       matmuls + the heavy-row int32 correction, ops/count.py) over the
       ALREADY-resident arrays — no raw-weight upload;
-    - no overflow retry: p_cap/m_cap/l_max overflow marks the level
-      invalid (survivor-count sentinel > m_cap) and the host resumes
-      the per-level engine from the last complete level.
+    - no overflow retry: p_cap/slot-cap/l_max overflow marks the level
+      invalid (survivor-count sentinel > its slot cap) and the host
+      resumes the per-level engine from the last complete level;
+    - DESCENDING per-slot output caps (``slot_caps``): a fold's levels
+      shrink, so slot i only reserves (and the host only FETCHES)
+      ``slot_caps[i]`` rows — at m_cap=65536 a flat l_max x m_cap
+      layout would be a 6 MB fetch over a tunnel down-link measured as
+      low as 6.8 MB/s this round, vs ~1.6 MB compacted;
+    - ``cand_row_chunks`` chunks the [M, M] candidate-gen intermediate
+      (see _gen_candidates_matmul), which is what admits 64K-row seeds.
 
-    Returns the packed [3*l_max+1, m_cap] int32 result; tail level
-    k0+1+i sits at slot i (decode with ``prev=<seed matrix>``)."""
+    Returns a 1-D int32 array: per slot i the compacted
+    ``rows[:cap_i] | cols[:cap_i] | counts[:cap_i]`` runs, then
+    ``n_per_level[l_max] | incomplete`` (unpack_tail_result)."""
     from fastapriori_tpu.ops.count import (
         _weighted_matmul,
         heavy_level_correction,
@@ -443,10 +469,14 @@ def _tail_mine_local(
         s, m, k, *_rest, stop = state
         return (~stop) & (m >= k) & (k <= k0 + l_max)
 
+    slot_caps_arr = jnp.asarray(slot_caps, dtype=jnp.int32)
+
     def body(state):
         s, m, k, o_rows, o_cols, o_counts, o_n, stop = state
         valid_row = (jnp.arange(m_cap, dtype=jnp.int32) < m)[:, None]
-        cand = _gen_candidates_matmul(s, k, col_ids, valid_row)
+        cand = _gen_candidates_matmul(
+            s, k, col_ids, valid_row, row_chunks=cand_row_chunks
+        )
 
         # Prefix compaction: only rows with >= 1 candidate extension go
         # through the counting matmul.
@@ -488,11 +518,11 @@ def _tail_mine_local(
         )
         level_counts = counts_p[rows_p, cols] * valid[:, 0].astype(jnp.int32)
 
-        # Overflow: compaction or row budget exceeded -> this level's
-        # output is unusable; store a sentinel survivor count above
-        # m_cap so the host's decode (max_rows=m_cap) stops before it.
-        bad = (n_pref > p_cap) | (n > m_cap)
+        # Overflow: compaction or this slot's row cap exceeded -> this
+        # level's output is unusable; store a sentinel survivor count
+        # above m_cap so the host's decode stops before it.
         idx = k - k0 - 1  # tail level k0+1+i at slot i
+        bad = (n_pref > p_cap) | (n > slot_caps_arr[idx])
         o_rows = o_rows.at[idx].set(rows)
         o_cols = o_cols.at[idx].set(cols)
         o_counts = o_counts.at[idx].set(level_counts)
@@ -516,16 +546,34 @@ def _tail_mine_local(
     # either way the host resumes the per-level engine from the last
     # complete level.
     incomplete = stop | ((m >= k) & (k > k0 + l_max))
-    meta = (
-        jnp.zeros((m_cap,), dtype=jnp.int32)
-        .at[:l_max]
-        .set(out_n)
-        .at[l_max]
-        .set(incomplete.astype(jnp.int32))
+    parts = []
+    for i, c in enumerate(slot_caps):
+        parts += [out_rows[i, :c], out_cols[i, :c], out_counts[i, :c]]
+    parts.append(out_n)
+    parts.append(incomplete.astype(jnp.int32)[None])
+    return jnp.concatenate(parts)
+
+
+def tail_slot_caps(m_cap: int, l_max: int) -> Tuple[int, ...]:
+    """Descending per-tail-level row caps: slot i reserves m_cap >> i
+    rows (floor 4096, never above m_cap) — a fold's levels shrink, and
+    the compact output keeps the host fetch ~1.6 MB even at 64K-row
+    seeds.  A level that violates the assumption trips the in-kernel
+    ``bad`` sentinel and the host resumes per-level (exact either
+    way)."""
+    return tuple(
+        min(m_cap, max(m_cap >> i, 4096)) for i in range(l_max)
     )
-    return jnp.concatenate(
-        [out_rows, out_cols, out_counts, meta[None, :]], axis=0
-    )
+
+
+def tail_cand_row_chunks(m_cap: int) -> int:
+    """Chunk count for the fold's [M, M] candidate-gen intermediates:
+    smallest power of two keeping the per-chunk f32 block under
+    ~512 MB."""
+    rc = 1
+    while 8 * m_cap * (m_cap // rc) > (512 << 20):
+        rc *= 2
+    return rc
 
 
 def make_tail_miner(
@@ -551,6 +599,8 @@ def make_tail_miner(
         l_max=l_max,
         n_chunks=n_chunks,
         axis_name=AXIS if mesh is not None else None,
+        slot_caps=tail_slot_caps(m_cap, l_max),
+        cand_row_chunks=tail_cand_row_chunks(m_cap),
     )
 
     def wrapped(bitmap, w_digits, seed_cols, n0, min_count, *hv):
@@ -570,6 +620,23 @@ def make_tail_miner(
             out_specs=P(None),
         )
     )
+
+
+def unpack_tail_result(packed: np.ndarray, m_cap: int, l_max: int):
+    """Split the tail miner's compact 1-D result (see _tail_mine_local)
+    into (rows_list, cols_list, counts_list, n_per_level, incomplete) —
+    the lists are per-slot 1-D arrays sized by :func:`tail_slot_caps`,
+    consumable by decode_level_matrices with ``max_rows=slot_caps``."""
+    caps = tail_slot_caps(m_cap, l_max)
+    rows, cols, counts = [], [], []
+    off = 0
+    for c in caps:
+        rows.append(packed[off : off + c]); off += c
+        cols.append(packed[off : off + c]); off += c
+        counts.append(packed[off : off + c]); off += c
+    n_lvl = packed[off : off + l_max]
+    incomplete = bool(packed[off + l_max])
+    return rows, cols, counts, n_lvl, incomplete
 
 
 def unpack_fused_result(
@@ -607,11 +674,12 @@ def decode_level_matrices(
     decode bottleneck — and the extension column is always the largest
     member).
 
-    ``max_rows`` (the attempt's row budget) stops BEFORE the first level
-    whose true survivor count exceeded it: such a level's stored rows are
-    truncated and must never be decoded.  Pass it when salvaging a failed
-    attempt for the level engine to resume from; a successful attempt
-    needs no cap.
+    ``max_rows`` (the attempt's row budget — a scalar, or the tail
+    miner's per-slot cap sequence) stops BEFORE the first level whose
+    true survivor count exceeded it: such a level's stored rows are
+    truncated and must never be decoded.  Pass it when salvaging a
+    failed attempt for the level engine to resume from; a successful
+    attempt needs no cap.
 
     ``prev``: seed member matrix for slot 0's row indexes (the tail
     miner's output chains from the level the host handed it, not from
@@ -619,7 +687,12 @@ def decode_level_matrices(
     out = []
     for lvl in range(len(out_n)):
         n = int(out_n[lvl])
-        if n == 0 or (max_rows is not None and n > max_rows):
+        cap = (
+            max_rows[lvl]
+            if isinstance(max_rows, (list, tuple))
+            else max_rows
+        )
+        if n == 0 or (cap is not None and n > cap):
             break
         rows = np.asarray(out_rows[lvl][:n], dtype=np.int32)
         cols = np.asarray(out_cols[lvl][:n], dtype=np.int32)

@@ -617,8 +617,11 @@ class DeviceContext:
             self.platform == "tpu"
             and not fast_f32
             and tuple(scales) == (1,)  # kernel takes ONE unscaled w ⊙ B
+            # Any set value disables EXCEPT explicit falsy spellings
+            # (so both FA_NO_PALLAS=on and FA_NO_PALLAS=0 mean what
+            # their author intended).
             and os.environ.get("FA_NO_PALLAS", "").lower()
-            not in ("1", "true", "yes")
+            in ("", "0", "false", "no")
         ):
             from fastapriori_tpu.ops.pallas_level import pick_tile
 
