@@ -712,7 +712,7 @@ from fastapriori_tpu.rules.gen import gen_rule_arrays_levels, sort_rule_arrays
 
 d_path = sys.argv[1]
 min_support = float(sys.argv[2])
-miner = FastApriori(config=MinerConfig(min_support=min_support))
+miner = FastApriori(config=MinerConfig(min_support=min_support, retain_csr=False))
 t0 = time.perf_counter()
 levels, data = miner.run_file_raw(d_path)
 mine_s = time.perf_counter() - t0
@@ -940,6 +940,7 @@ def _recommend_workload(args, raw, d_path) -> int:
     cfg = MinerConfig(
         min_support=args.min_support,
         engine=args.engine,
+        retain_csr=False,
     )
     miner = FastApriori(config=cfg)
     # Matrix-form pipeline — the same path the CLI takes: level
@@ -1187,7 +1188,7 @@ def main(argv=None) -> int:
     miner = FastApriori(
         config=MinerConfig(
             min_support=args.min_support, engine=args.engine,
-            log_metrics=True,
+            log_metrics=True, retain_csr=False,
         )
     )
     # The measured object is the matrix-form pipeline (run_file_raw):

@@ -153,6 +153,10 @@ def _run(args) -> int:
         cand_devices=args.cand_devices,
         log_metrics=args.metrics,
         engine=args.engine,
+        # The CLI never reads the basket CSR back (the bitmap is built
+        # block-by-block at ingest); skipping it saves ~0.7 GB of host
+        # copies at webdocs scale.
+        retain_csr=False,
     )
     if args.platform == "cpu":
         import jax

@@ -77,6 +77,19 @@ class MinerConfig:
     # hosts) degenerates to the serial path with no overhead worth
     # noting.
     ingest_threads: Optional[int] = None
+    # Keep the full basket CSR (CompressedData.basket_indices/offsets)
+    # under the capture-replay pipelined ingest.  The CSR costs ~0.7 GB
+    # of per-block numpy copies at webdocs scale and nothing in the
+    # mining pipeline reads it there (the bitmap is built block-by-block
+    # in the callback; heavy rows are extracted at callback time), so
+    # the CLI/bench set False; the library default preserves the
+    # documented CompressedData contract for API callers.  False is an
+    # optimization of the CAPTURE ingest flavor only (single-threaded
+    # host + native extension): the threaded and non-pipelined flavors
+    # materialize the CSR as a byproduct and keep it regardless, so a
+    # CSR-less CompressedData is host-dependent — re-mining one through
+    # a CSR-consuming path raises a ValueError naming this knob.
+    retain_csr: bool = True
     # Mining engine: "auto" (default) picks per dataset — the fused
     # whole-loop program when the level-2 survivor budget AND the level-3
     # candidate census (one extra matmul inside the pair pre-pass,

@@ -496,14 +496,19 @@ class FastApriori:
     HEAVY_SPLIT_CAP = 4096
     HEAVY_SPLIT_BYTES = 16 << 20
 
-    def _split_weights(self, w_np, t_pad, indices, offsets, f):
+    def _split_weights(self, w_np, t_pad, indices, offsets, f,
+                       heavy_pre=None):
         """Single-low-digit weight split: the main kernels run ONE int8
         digit (``w % 128``) for every row — halving the counting matmuls
         when any row's multiplicity reaches 128 — and the exact remainder
         ``w - w%128`` rides a tiny separate heavy-row array added as an
         int32 correction (ops/count.py heavy_*_correction).  Returns
         ``(w_digits, scales, heavy_b | None, heavy_w | None)``; heavy
-        None = legacy multi-digit (no heavy rows, or too many)."""
+        None = legacy multi-digit (no heavy rows, or too many).
+
+        ``heavy_pre``: the heavy rows' basket arrays extracted at ingest
+        callback time (retain_csr=False — no global CSR exists), in the
+        same row order ``np.flatnonzero(w >= 128)`` enumerates."""
         from fastapriori_tpu.ops.bitmap import build_bitmap, pad_axis
 
         heavy_idx = np.flatnonzero(w_np >= 128)
@@ -519,15 +524,38 @@ class FastApriori:
             (w_np % 128).astype(np.int32), t_pad
         )
         assert scales == [1], scales  # low digit only, by construction
-        baskets = [
-            indices[offsets[i] : offsets[i + 1]] for i in heavy_idx
-        ]
+        if heavy_pre is not None:
+            baskets = heavy_pre
+            assert len(baskets) == heavy_idx.size, (
+                len(baskets), heavy_idx.size,
+            )
+        else:
+            baskets = [
+                indices[offsets[i] : offsets[i + 1]] for i in heavy_idx
+            ]
         heavy_b = build_bitmap(baskets, f, 8, self.config.item_tile)
         heavy_w = np.zeros(heavy_b.shape[0], dtype=np.int32)
         heavy_w[: heavy_idx.size] = w_np[heavy_idx] - (
             w_np[heavy_idx] % 128
         )
         return w_digits_np, scales, heavy_b, heavy_w
+
+    @staticmethod
+    def _require_csr(data: CompressedData) -> None:
+        """CSR-consuming paths (the packed fused upload, the plain level
+        bitmap build) must fail loudly on a CompressedData produced with
+        ``retain_csr=False`` — silently mining an empty CSR would return
+        an empty lattice."""
+        if (
+            data.total_count > 0
+            and len(data.basket_offsets) != data.total_count + 1
+        ):
+            raise ValueError(
+                "CompressedData carries no basket CSR (produced by the "
+                "pipelined capture ingest with retain_csr=False); "
+                "re-ingest with retain_csr=True to mine it through "
+                "this path"
+            )
 
     @staticmethod
     def _empty_compressed(
@@ -546,26 +574,39 @@ class FastApriori:
             weights=np.empty(0, np.int32),
         )
 
-    def _assemble_blocks(self, blocks, txn_multiple: int, f: int):
+    def _assemble_blocks(self, blocks, txn_multiple: int, f: int,
+                         heavy_pre=None):
         """Host-side assembly of per-block CSRs: concatenated weights +
         weight digits (single-low-digit split when heavy rows are few) +
         the global CSR (API parity).  Shared by both pipelined ingest
-        flavors; runs while the upload tail drains."""
+        flavors; runs while the upload tail drains.
+
+        ``heavy_pre`` (retain_csr=False): blocks carry ``None`` item
+        arrays — the global CSR is skipped entirely (~0.7 GB of copies
+        at webdocs scale) and the heavy rows' baskets arrive pre-
+        extracted from the ingest callback."""
         from fastapriori_tpu.ops.bitmap import pad_axis
 
         total = sum(len(bw) for _, _, bw in blocks)
         t_pad = pad_axis(total, txn_multiple)
         w_np = np.concatenate([bw for _, _, bw in blocks])
-        indices = np.concatenate([bi for bi, _, _ in blocks])
-        offs = [np.zeros(1, dtype=np.int64)]
-        base = 0
-        for _, bo, _ in blocks:
-            offs.append(bo[1:].astype(np.int64) + base)
-            base += int(bo[-1])
-        offsets = np.concatenate(offs)
-        w_digits_np, scales, heavy_b, heavy_w = self._split_weights(
-            w_np, t_pad, indices, offsets, f
-        )
+        if heavy_pre is None:
+            indices = np.concatenate([bi for bi, _, _ in blocks])
+            offs = [np.zeros(1, dtype=np.int64)]
+            base = 0
+            for _, bo, _ in blocks:
+                offs.append(bo[1:].astype(np.int64) + base)
+                base += int(bo[-1])
+            offsets = np.concatenate(offs)
+            w_digits_np, scales, heavy_b, heavy_w = self._split_weights(
+                w_np, t_pad, indices, offsets, f
+            )
+        else:
+            indices = np.empty(0, np.int32)
+            offsets = np.zeros(1, np.int64)
+            w_digits_np, scales, heavy_b, heavy_w = self._split_weights(
+                w_np, t_pad, indices, offsets, f, heavy_pre=heavy_pre
+            )
         return (
             total, t_pad, w_np, w_digits_np, scales, indices, offsets,
             heavy_b, heavy_w,
@@ -657,14 +698,34 @@ class FastApriori:
                     w_futures.append(
                         upool.submit(jax.device_put, weights, dev)
                     )
-                    blocks.append((items, offsets, weights))
+                    if cfg.retain_csr:
+                        blocks.append((items, offsets, weights))
+                        return
+                    # retain_csr=False: ``items`` is a view into the
+                    # native arena, valid only inside this callback —
+                    # everything that needs item data (the packed bitmap
+                    # above; the heavy rows below) consumes it NOW, and
+                    # the ~0.7 GB global-CSR copy is skipped.  Past the
+                    # split cap the weight split falls back to the
+                    # legacy multi-digit path and never reads these, so
+                    # stop re-materializing CSR slices for a heavily-
+                    # duplicated dataset.
+                    for i in np.flatnonzero(weights >= 128):
+                        if len(heavy_pre) > self.HEAVY_SPLIT_CAP:
+                            break
+                        heavy_pre.append(
+                            items[offsets[i] : offsets[i + 1]].copy()
+                        )
+                    blocks.append((None, offsets, weights))
 
+                heavy_pre: list = []
                 n_raw, min_count, freq_items, item_counts = (
                     preprocess_buffer_blocks(
                         buf,
                         cfg.min_support,
                         max(cfg.ingest_pipeline_blocks, 1),
                         on_block,
+                        copy_items=cfg.retain_csr,
                     )
                 )
                 t_ingest1 = time.perf_counter()
@@ -734,7 +795,10 @@ class FastApriori:
                         "cap": cap,
                         "cap_key": cap_key,
                     }
-                asm = self._assemble_blocks(blocks, txn_multiple, f)
+                asm = self._assemble_blocks(
+                    blocks, txn_multiple, f,
+                    heavy_pre=None if cfg.retain_csr else heavy_pre,
+                )
                 (
                     total, t_pad, w_np, w_digits_np, scales, indices,
                     offsets, heavy_b, heavy_w,
@@ -973,6 +1037,7 @@ class FastApriori:
             self.metrics.emit("fused_skip", reason="memory")
             return None, None
 
+        self._require_csr(data)
         with self.metrics.timed("bitmap_pack") as m:
             # This process's rows only (local_pad == t_pad when not
             # sharded); shard_rows_local assembles the global arrays
@@ -1276,6 +1341,7 @@ class FastApriori:
                 pair_pre=pair_pre,
             )
 
+        self._require_csr(data)
         with self.metrics.timed("bitmap_build") as m:
             # Pad the txn axis so per-device rows split into n_chunks equal
             # scan chunks (ops/count.py local_level_gather).

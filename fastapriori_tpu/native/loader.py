@@ -351,7 +351,7 @@ def has_preprocess_buffer_blocks() -> bool:
 
 def preprocess_buffer_blocks(
     data: bytes, min_support: float, n_blocks: int, on_block,
-    n_threads: int = 1,
+    n_threads: int = 1, copy_items: bool = True,
 ):
     """Capture-replay pipelined preprocessing: pass 1 + rank assignment +
     per-block pass-2 id replay in ONE native call (the raw bytes are
@@ -359,7 +359,11 @@ def preprocess_buffer_blocks(
     std::threads; ``on_block(f, offsets int64[t+1], items int32[nnz],
     weights int32[t])`` fires per block mid-call — always from the
     calling thread, always in block order — with COPIES the callee
-    owns.  Returns the global tables
+    owns, EXCEPT ``items`` when ``copy_items=False``: then it is a view
+    into the native block arena, valid ONLY for the duration of the
+    callback (the copy is ~0.7 GB of memcpy at webdocs scale; callers
+    that consume items inside the callback — bitmap packing, heavy-row
+    extraction — skip it).  Returns the global tables
     ``(n_raw, min_count, freq_items, item_counts)``."""
     lib = get_lib()
     if lib is None or getattr(lib, "fa_preprocess_buffer_blocks", None) is None:
@@ -409,7 +413,9 @@ def preprocess_buffer_blocks(
             nnz = int(offsets[-1])
             items = np.ctypeslib.as_array(items_p, shape=(max(nnz, 1),))[
                 :nnz
-            ].copy()
+            ]
+            if copy_items:
+                items = items.copy()
             weights = np.ctypeslib.as_array(w_p, shape=(max(t, 1),))[
                 :t
             ].copy()

@@ -221,6 +221,56 @@ def test_ingest_overlapped_pair_matches_plain(tmp_path, engine):
     assert (d_pipe.item_counts == d_plain.item_counts).all()
 
 
+@pytest.mark.skipif(
+    not native_available(), reason="native extension not built"
+)
+def test_capture_ingest_without_csr_matches_plain(tmp_path):
+    """retain_csr=False: the capture ingest skips the global basket-CSR
+    copies (items are consumed inside the callback — bitmap packing +
+    heavy-row extraction); levels must stay bit-exact vs the plain path
+    including the heavy-row weight split, and the CSR-consuming paths
+    must fail loudly on the CSR-less CompressedData."""
+    from conftest import random_dataset
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.models.apriori import FastApriori
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    d_raw = (
+        ["2 5 8"] * 150  # heavy rows: w >= 128 forces the heavy split
+        + random_dataset(31, n_txns=300, n_items=20, max_len=9)
+    )
+    path = tmp_path / "D.dat"
+    path.write_text("".join(l + "\n" for l in d_raw))
+
+    ctx = DeviceContext(num_devices=1)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.04, engine="level", ingest_pipeline_blocks=4,
+            ingest_threads=1, retain_csr=False,
+        ),
+        context=ctx,
+    )
+    lv, d = miner.run_file_raw(str(path))
+    assert d.basket_indices.size == 0  # CSR really skipped
+    assert d.total_count > 0
+
+    lv_plain, d_plain = FastApriori(
+        config=MinerConfig(
+            min_support=0.04, engine="level", ingest_pipeline_blocks=1
+        ),
+        context=DeviceContext(num_devices=1),
+    ).run_file_raw(str(path))
+    assert len(lv) == len(lv_plain)
+    for (m_a, c_a), (m_b, c_b) in zip(lv, lv_plain):
+        assert (m_a == m_b).all() and (c_a == c_b).all()
+    assert d.weights.sum() == d_plain.weights.sum()
+
+    # CSR-consuming paths refuse the CSR-less data instead of silently
+    # mining an empty lattice.
+    with pytest.raises(ValueError, match="retain_csr"):
+        miner._mine_levels(d)
+
+
 def test_split_buffer_ranges_matches_read_shard(tmp_path):
     """split_buffer_ranges must agree byte-for-byte with read_shard's
     alignment rule on adversarial content (no trailing newline, empty
