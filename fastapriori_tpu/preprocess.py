@@ -92,6 +92,14 @@ class CompressedData:
     @property
     def baskets(self) -> List[np.ndarray]:
         """Ragged view (one array per basket); prefer the CSR fields."""
+        if self.total_count > 0 and len(self.basket_offsets) != (
+            self.total_count + 1
+        ):
+            raise ValueError(
+                "CompressedData carries no basket CSR (produced by the "
+                "pipelined capture ingest with retain_csr=False); "
+                "re-ingest with retain_csr=True to read baskets"
+            )
         return [
             self.basket_indices[self.basket_offsets[i] : self.basket_offsets[i + 1]]
             for i in range(self.total_count)
