@@ -23,7 +23,7 @@ on the JVM, so the >=-comparisons agree bit-for-bit with the reference.
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, List, Sequence, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -55,6 +55,41 @@ def _row_keys(m: np.ndarray, f: int) -> np.ndarray:
     return np.bitwise_or.reduce(
         m.astype(np.uint64) << shifts[None, :], axis=1
     )
+
+
+def _deleted_row_keys(m: np.ndarray, f: int) -> Optional[np.ndarray]:
+    """``out[:, e] == _row_keys(np.delete(m, e, axis=1), f)`` for every
+    column e, computed incrementally: with ranks packed into
+    ``bits``-wide fields, deleting column e shifts the fields before it
+    down one slot and keeps the fields after it — so every deleted-row
+    key is one prefix-cumsum plus one suffix-cumsum, O(k) array passes
+    instead of the O(k²) repack the per-column ``_row_keys`` calls cost
+    (raw rule generation touches every key of every level's every
+    column: ~100M packs at webdocs/minSupport=0.092 scale).  None when
+    the (k-1)-wide rows don't fit uint64 (callers fall back)."""
+    n, k = m.shape
+    bits = 8 if f <= 256 else (16 if f <= 65536 else 32)
+    if (k - 1) * bits > 64 or k < 2:
+        return None
+    b = np.uint64(bits)
+    mu = m.astype(np.uint64)
+    j = np.arange(k, dtype=np.uint64)
+    # Prefix part: columns j < e land at deleted-row shift bits*(k-2-j).
+    # (Temporaries are [N, k] uint64 — ~1 GB each at 16M-rule levels —
+    # so accumulate in place and free eagerly.)
+    a = mu[:, : k - 1] << ((np.uint64(k - 2) - j[: k - 1]) * b)[None, :]
+    out = np.zeros((n, k), dtype=np.uint64)
+    np.cumsum(a, axis=1, out=out[:, 1:])
+    # Suffix part: columns j > e keep their full-row shift bits*(k-1-j);
+    # fields are disjoint, so += never carries.
+    np.multiply(
+        mu[:, 1:],
+        np.uint64(1) << (((np.uint64(k - 1) - j[1:]) * b))[None, :],
+        out=a,
+    )
+    del mu
+    out[:, : k - 1] += np.cumsum(a[:, ::-1], axis=1)[:, ::-1]
+    return out
 
 
 def _lookup_rows(
@@ -172,9 +207,11 @@ def rule_arrays_from_tables(
         psorted = pview[porder]
         ants, conss, confs = [], [], []
         rows_e = np.empty((k, mat.shape[0]), dtype=np.int32)
+        dk = _deleted_row_keys(mat, f)  # [N, k] or None (wide rows)
         for j in range(k):
             ant = np.delete(mat, j, axis=1)  # sorted rows stay sorted
-            idx, found = _lookup_rows(psorted, porder, _row_keys(ant, f))
+            keys = dk[:, j] if dk is not None else _row_keys(ant, f)
+            idx, found = _lookup_rows(psorted, porder, keys)
             if not found.all():
                 bad = frozenset(ant[int(np.argmin(found))].tolist())
                 raise InputError(

@@ -103,3 +103,30 @@ def test_rule_arrays_pipeline_matches_object_pipeline():
     assert len(objs) == len(from_arrays)
     for (a1, c1, f1), (a2, c2, f2) in zip(objs, from_arrays):
         assert a1 == a2 and c1 == c2 and f1 == f2
+
+
+@pytest.mark.parametrize(
+    "f,k,seed",
+    [(200, 3, 0), (200, 8, 1), (60000, 4, 2), (9, 9, 3), (70000, 4, 4)],
+)
+def test_deleted_row_keys_match_repacked(f, k, seed):
+    """The incremental per-deleted-column keys must equal the repacked
+    _row_keys of np.delete for every column (the raw rule-generation
+    hot path relies on this equivalence)."""
+    import numpy as np
+
+    from fastapriori_tpu.rules.gen import _deleted_row_keys, _row_keys
+
+    rng = np.random.default_rng(seed)
+    m = np.sort(
+        rng.choice(f, size=(50, k), replace=True).astype(np.int32), axis=1
+    )
+    dk = _deleted_row_keys(m, f)
+    bits = 8 if f <= 256 else (16 if f <= 65536 else 32)
+    if (k - 1) * bits > 64:
+        assert dk is None
+        return
+    assert dk is not None
+    for e in range(k):
+        want = _row_keys(np.delete(m, e, axis=1), f)
+        assert (dk[:, e] == want).all(), e
