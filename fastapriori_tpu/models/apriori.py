@@ -1619,7 +1619,9 @@ class FastApriori:
         defer = jax.process_count() == 1
 
         def finish(lvls):
-            return self._resolve_pending_counts(lvls, pending_map)
+            return self._resolve_pending_counts(
+                lvls, pending_map, n_raw=data.n_raw
+            )
 
         # Levels >=3 (C7 + C8), reference termination rule
         # (FastApriori.scala:111).
@@ -1700,7 +1702,7 @@ class FastApriori:
             k += 1
         return finish(levels)
 
-    def _resolve_pending_counts(self, levels, pending_map):
+    def _resolve_pending_counts(self, levels, pending_map, n_raw=None):
         """ONE dispatch + ONE fetch for every deferred level's survivor
         counts (the per-level transfers used to cross the slow tunnel
         down-link padded ~4 bytes/candidate; this crosses exactly
@@ -1714,16 +1716,20 @@ class FastApriori:
                 if pos.size:
                     flat.append((idx, counts_dev, pos))
         with self.metrics.timed("counts_resolve") as m:
+            # Counts < 2^24 (weighted counts are bounded by n_raw) cross
+            # the link as 3 bytes each — the down-link is the scarcest
+            # resource and this is its single largest mining fetch.
+            u24 = n_raw is not None and n_raw < 2**24
             out = (
                 self.context.gather_level_counts(
-                    [(c, p) for _, c, p in flat]
+                    [(c, p) for _, c, p in flat], u24=u24
                 )
                 if flat
                 else np.empty(0, np.int64)
             )
             m.update(
                 levels=len(pending_map),
-                fetch_bytes=4 * int(out.size),
+                fetch_bytes=(3 if u24 else 4) * int(out.size),
             )
         per_level: Dict[int, list] = {}
         off = 0

@@ -75,6 +75,21 @@ def _gather_counts_jit(counts_list, pos_list):
     )
 
 
+@jax.jit
+def _gather_counts_u24_jit(counts_list, pos_list):
+    """3-byte variant: counts provably < 2^24 (callers gate on n_raw)
+    leave the chip as three uint8 planes — 25% fewer bytes over a
+    down-link this round's probes measured as low as 5 MB/s."""
+    g = _gather_counts_jit(counts_list, pos_list)
+    return jnp.stack(
+        [
+            (g & 0xFF).astype(jnp.uint8),
+            ((g >> 8) & 0xFF).astype(jnp.uint8),
+            ((g >> 16) & 0xFF).astype(jnp.uint8),
+        ]
+    )
+
+
 class DeviceContext:
     """Owns the (txn × cand) device mesh and the jitted counting kernels.
 
@@ -681,7 +696,7 @@ class DeviceContext:
             args += [heavy_b, heavy_w]
         return self._fns[key](*args)
 
-    def gather_level_counts(self, pending):
+    def gather_level_counts(self, pending, u24: bool = False):
         """End-of-mine survivor-count resolution in ONE dispatch + ONE
         fetch: ``pending`` is ``[(counts_dev [NB, C] int32, flat
         positions)]`` per deferred level — each level's survivor
@@ -690,13 +705,21 @@ class DeviceContext:
         slow tunnel down-link padded; this crosses exact bytes once).
         Positions are cast to int32 on upload ([NB, C] count arrays
         anywhere near 2^31 elements would exhaust HBM long before the
-        cast could overflow).  Returns concatenated int64 counts
-        (host)."""
-        out = _gather_counts_jit(
+        cast could overflow).  ``u24``: counts provably < 2^24 (the
+        caller's n_raw gate) cross the link as 3 bytes each.  Returns
+        concatenated int64 counts (host)."""
+        args = (
             tuple(c for c, _ in pending),
             tuple(jnp.asarray(p.astype(np.int32)) for _, p in pending),
         )
-        return np.asarray(out).astype(np.int64)
+        if u24:
+            planes = np.asarray(_gather_counts_u24_jit(*args))
+            return (
+                planes[0].astype(np.int64)
+                | (planes[1].astype(np.int64) << 8)
+                | (planes[2].astype(np.int64) << 16)
+            )
+        return np.asarray(_gather_counts_jit(*args)).astype(np.int64)
 
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
         pair, _, _ = self._get_fns(tuple(scales))
