@@ -1,0 +1,20 @@
+# Developer entry points.  `make ci` is the tier-1 flow: lint, then tests.
+
+.PHONY: lint test ci baseline native
+
+lint:
+	python -m tools.lint fastapriori_tpu tests --baseline tools/lint/baseline.json
+
+test:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	    --continue-on-collection-errors -p no:cacheprovider
+
+ci: lint test
+
+# Ratchet reset — only alongside the change that justifies it.
+baseline:
+	python -m tools.lint fastapriori_tpu tests \
+	    --baseline tools/lint/baseline.json --write-baseline
+
+native:
+	$(MAKE) -C fastapriori_tpu/native

@@ -70,6 +70,7 @@ def _fused_m_cap_memory_limit(
     if budget is None:
         try:
             stats = dev.memory_stats()
+        # lint: waive G006 -- backends without memory_stats fall to the 16 GB default
         except Exception:
             stats = None
         hbm = (stats or {}).get("bytes_limit") or 16 * 2**30

@@ -16,5 +16,6 @@ def native_available() -> bool:
         from fastapriori_tpu.native.loader import get_lib
 
         return get_lib() is not None
+    # lint: waive G006 -- optional-dep probe; callers use the Python fallback
     except Exception:
         return False

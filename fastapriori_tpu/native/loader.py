@@ -49,6 +49,7 @@ def _try_build() -> None:
             capture_output=True,
             timeout=120,
         )
+    # lint: waive G006 -- best-effort build; absence falls back to Python path
     except Exception:
         pass
 
@@ -420,6 +421,7 @@ def preprocess_buffer_blocks(
                 :t
             ].copy()
             on_block(int(f), offsets, items, weights)
+        # lint: waive G006 -- captured into errs and re-raised after the C call
         except BaseException as e:  # never unwind through the C frame
             errs.append(e)
 

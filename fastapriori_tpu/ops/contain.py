@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fastapriori_tpu import compat
+
 AXIS = "txn"
 
 
@@ -143,7 +145,7 @@ def local_first_match_scan(
     if axis_name is not None:
         # The carry varies over the mesh axis (it is derived from the
         # sharded baskets); mark the initial value to match.
-        best0 = lax.pcast(best0, (axis_name,), to="varying")
+        best0 = compat.pcast(best0, (axis_name,), to="varying")
     c, best = lax.while_loop(cond, body, (jnp.int32(0), best0))
     if axis_name is not None:
         # Shards may exit at different chunks (no collectives inside the
@@ -159,7 +161,7 @@ def make_sharded_first_match_scan(mesh: Mesh, chunk: int):
     import functools
 
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             functools.partial(
                 local_first_match_scan, chunk=chunk, axis_name=AXIS
             ),

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from fastapriori_tpu import compat
+
 
 def _psum_if(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
     return lax.psum(x, axis_name) if axis_name is not None else x
@@ -61,6 +63,7 @@ def _pair_triangles(mask: jnp.ndarray) -> jnp.ndarray:
     Returns int32; callers with F above :data:`TRI_F_CAP` skip the
     matmul and pass -1 ("not computed") instead."""
     u = mask.astype(jnp.float32)
+    # lint: f32-gate -- entries bounded by F < 2^24; total clamped at 2^30
     paths = lax.dot_general(
         u, u, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -266,6 +269,7 @@ def local_pair_gather(
     if fast_f32:
         b_f = bitmap.astype(jnp.float32)
         scaled = b_f * _weights_f32(w_digits, scales)[:, None]
+        # lint: f32-gate -- fast_f32 callers prove every count < 2^24 first
         counts = lax.dot_general(
             scaled,
             b_f,
@@ -403,6 +407,7 @@ def local_level_gather(
         b_chunk, wd_chunk = xs  # [tc, F] int8, [D, tc] int8
         if fast_f32:
             b_f = b_chunk.astype(jnp.float32)
+            # lint: f32-gate -- intersection sizes bounded by k1 <= K_MAX << 2^24
             member = lax.dot_general(
                 b_f,
                 onehot,
@@ -413,6 +418,7 @@ def local_level_gather(
                 jnp.float32
             )
             w_f = _weights_f32(wd_chunk, scales)  # [tc]
+            # lint: f32-gate -- fast_f32 callers prove every count < 2^24 first
             total = lax.dot_general(
                 common,
                 b_f * w_f[:, None],
@@ -445,7 +451,7 @@ def local_level_gather(
     # mark the initial carry accordingly.
     varying = tuple(a for a in (axis_name, cand_axis_name) if a is not None)
     if varying:
-        init = lax.pcast(init, varying, to="varying")
+        init = compat.pcast(init, varying, to="varying")
     counts, _ = lax.scan(body, init, (bm, wd))
     if heavy_b is not None:
         counts = counts + heavy_level_correction(

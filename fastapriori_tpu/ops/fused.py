@@ -46,6 +46,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fastapriori_tpu import compat
+
 AXIS = "txn"
 
 
@@ -82,11 +84,13 @@ def _gen_candidates_matmul(s, k, col_ids, valid_row, row_chunks: int = 1):
     rowmax = jnp.max(jnp.where(s > 0, col_ids[None, :], -1), axis=1)
 
     def blk(s_blk):
+        # lint: f32-gate -- intersection sizes bounded by F < 2^24 (docstring)
         d_blk = lax.dot_general(
             s_blk, s_f, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [mb, M] pairwise intersection sizes
         e_blk = (d_blk == (k - 2).astype(jnp.float32)).astype(jnp.float32)
+        # lint: f32-gate -- subset-prune vote counts bounded by F < 2^24
         return lax.dot_general(
             e_blk, s_f, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -183,7 +187,7 @@ def _fused_mine_local(
         if axis_name is not None:
             # Mark the carry as device-varying over the mesh axis (each
             # shard accumulates its own partial sums; psum comes later).
-            acc0 = lax.pcast(acc0, (axis_name,), to="varying")
+            acc0 = compat.pcast(acc0, (axis_name,), to="varying")
         acc, _ = lax.scan(step, acc0, (packed_c, w_c))
         return acc
 
@@ -316,7 +320,7 @@ def make_pair_counter(
 
         acc0 = jnp.zeros((f, f), dtype=jnp.int32)
         if mesh is not None:
-            acc0 = lax.pcast(acc0, (AXIS,), to="varying")
+            acc0 = compat.pcast(acc0, (AXIS,), to="varying")
         pair, _ = lax.scan(step, acc0, (packed_c, w_c))
         if mesh is not None:
             pair = lax.psum(pair, AXIS)
@@ -331,7 +335,7 @@ def make_pair_counter(
     if mesh is None:
         return jax.jit(local)
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             local,
             mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS), P()),
@@ -370,7 +374,7 @@ def make_fused_miner(
     if mesh is None:
         return jax.jit(kernel)
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             kernel,
             mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS), P()),
@@ -499,7 +503,7 @@ def _tail_mine_local(
 
         acc0 = jnp.zeros((p_cap, f), dtype=jnp.int32)
         if axis_name is not None:
-            acc0 = lax.pcast(acc0, (axis_name,), to="varying")
+            acc0 = compat.pcast(acc0, (axis_name,), to="varying")
         counts_p, _ = lax.scan(step, acc0, (bm, wd))
         if heavy_b is not None:
             counts_p = counts_p + heavy_level_correction(
@@ -613,7 +617,7 @@ def make_tail_miner(
         (P(None, None), P(None)) if has_heavy else ()
     )
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             wrapped,
             mesh=mesh,
             in_specs=in_specs,

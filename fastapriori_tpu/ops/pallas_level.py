@@ -45,6 +45,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fastapriori_tpu import compat
+
 # Default VMEM-friendly tile sizes (int8 min tile is (32, 128)).  The
 # in-VMEM [M_TILE, T_TILE] membership tile is the budget driver:
 # 1024 x 4096 x 4 B (int32 overlap) = 16 MB.
@@ -132,10 +134,10 @@ def level_counts_pallas(
     # varies over mesh axes: exactly as the union of the inputs.
     vma = frozenset()
     for arr in (bitmap, wb, s_mat):
-        vma = vma | getattr(jax.typeof(arr), "vma", frozenset())
+        vma = vma | getattr(compat.typeof(arr), "vma", frozenset())
     return pl.pallas_call(
         _kernel,
-        out_shape=jax.ShapeDtypeStruct((m, f), jnp.int32, vma=vma),
+        out_shape=compat.shape_dtype_struct((m, f), jnp.int32, vma=vma),
         grid_spec=grid_spec,
         interpret=interpret,
     )(km1.reshape(1).astype(jnp.int32), bitmap, wb, s_mat)

@@ -19,7 +19,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # JAX >= 0.5 spells the device split as a config option; 0.4.x
+    # (e.g. the pinned 0.4.37) rejects the name — there the XLA flag
+    # set above is the only (and sufficient) mechanism.
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # narrow catch; the XLA flag set above already covers 0.4.x
 
 import random  # noqa: E402
 from typing import List  # noqa: E402

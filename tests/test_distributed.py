@@ -15,10 +15,22 @@ import socket
 import subprocess
 import sys
 
+import jax
 import pytest
 
 from conftest import random_dataset
 from fastapriori_tpu import oracle
+
+# jax 0.4.x's CPU backend rejects multiprocess computations outright
+# ("Multiprocess computations aren't implemented on the CPU backend"),
+# so the two-process contract is only provable on >= 0.5 (or real
+# chips).  Skip, don't fail: an environmental impossibility must stay
+# distinguishable from a regression in the CI gate.
+_JAX_VERSION = tuple(int(p) for p in jax.__version__.split(".")[:2])
+pytestmark = pytest.mark.skipif(
+    _JAX_VERSION < (0, 5),
+    reason="multiprocess-on-CPU needs jax >= 0.5",
+)
 
 _CHILD = r"""
 import json, sys

@@ -1,0 +1,17 @@
+"""graftlint — project-native static analysis for the JAX/TPU invariants
+this codebase's performance tricks depend on (see tools/lint/README.md).
+
+Pure stdlib (``ast`` + ``tokenize``); importing this package must never
+import jax — the linter has to run in seconds on a box with no
+accelerator runtime at all.
+"""
+
+from tools.lint.engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    lint_paths,
+    lint_sources,
+)
+from tools.lint.rules import ALL_RULES  # noqa: F401
+
+__all__ = ["Finding", "LintResult", "lint_paths", "lint_sources", "ALL_RULES"]

@@ -1,0 +1,5 @@
+import sys
+
+from tools.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,151 @@
+"""graftlint CLI: ``python -m tools.lint [paths...] [options]``.
+
+Exit codes: 0 = clean (or everything frozen in the baseline), 1 = new
+findings or unparsable files, 2 = usage error.  ``--write-baseline``
+regenerates the freeze file from the current findings and exits 0 —
+that's a deliberate ratchet-reset; reviewers should see the baseline
+diff in the same PR as whatever it freezes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tools.lint import engine
+from tools.lint.rules import ALL_RULES, RULES_BY_ID
+
+DEFAULT_PATHS = ["fastapriori_tpu", "tests"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: enforce this repo's JAX/TPU invariants",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help=f"files/directories to lint (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="freeze file; only findings beyond it fail the run",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite --baseline from the current findings and exit 0",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        dest="fmt",
+    )
+    p.add_argument(
+        "--select",
+        metavar="IDS",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--root",
+        default=".",
+        help="repo root that relative paths (and baselines) resolve against",
+    )
+    p.add_argument(
+        "--show-baselined",
+        action="store_true",
+        help="also print findings the baseline already freezes",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    paths = args.paths or DEFAULT_PATHS
+
+    rules = list(ALL_RULES)
+    if args.select:
+        wanted = [s.strip().upper() for s in args.select.split(",") if s.strip()]
+        unknown = [w for w in wanted if w not in RULES_BY_ID]
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(RULES_BY_ID))})",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [RULES_BY_ID[w] for w in wanted]
+
+    baseline = None
+    if args.baseline and not args.write_baseline:
+        try:
+            baseline = engine.load_baseline(args.baseline)
+        except FileNotFoundError:
+            baseline = None  # first run: everything is "new"
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+
+    result = engine.lint_paths(
+        paths, root=args.root, baseline=baseline, rules=rules
+    )
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("--write-baseline requires --baseline FILE", file=sys.stderr)
+            return 2
+        if args.select:
+            # A partial-rule rewrite would silently un-freeze every other
+            # rule's fingerprints.
+            print(
+                "--write-baseline cannot be combined with --select: the "
+                "baseline must be regenerated from the full rule set",
+                file=sys.stderr,
+            )
+            return 2
+        data = engine.make_baseline(result.findings)
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+        print(
+            f"baseline written: {args.baseline} "
+            f"({len(result.findings)} finding(s) frozen)"
+        )
+        return 0
+
+    shown = result.findings if args.show_baselined else result.new_findings
+    reported = list(result.parse_errors) + list(shown)
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in reported],
+                    "total": len(result.findings),
+                    "new": len(result.new_findings),
+                    "parse_errors": len(result.parse_errors),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in reported:
+            print(f.format_text())
+            if f.snippet:
+                print(f"    {f.snippet}")
+        frozen = len(result.findings) - len(result.new_findings)
+        tail = (
+            f"{len(result.new_findings)} new finding(s), "
+            f"{frozen} baselined, {len(result.parse_errors)} parse error(s)"
+        )
+        print(("FAIL: " if result.failed else "OK: ") + tail)
+    return 1 if result.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,354 @@
+"""graftlint rule engine: file contexts, waivers, baseline, and the runner.
+
+The engine is deliberately dumb about JAX semantics — each rule
+(tools/lint/rules.py) encodes ONE contract of this codebase and gets a
+parsed view of every file plus a package-wide symbol table (declared mesh
+axis names, module-level string/int constants).  Everything here is
+stdlib-only; the linter must run on machines with no JAX installed.
+
+Waiver syntax (the audit trail the rules exist to force):
+
+    x = np.asarray(counts_dev)  # lint: fetch-site -- end-of-mine fetch
+    except Exception:  # lint: waive G006 -- optional-dep probe
+
+A ``# lint:`` comment on the flagged line or the line directly above it
+waives matching rules on that line.  Tokens are either a rule id
+(``G001``) after the word ``waive``, or a rule's named alias
+(``fetch-site``); anything after ``--`` is the human justification and is
+ignored by the matcher (but reviewers should insist on it).
+
+Baselines freeze pre-existing findings so the CLI only fails on NEW ones:
+a finding's fingerprint is ``rule|path|stripped-source-line`` (line
+numbers excluded on purpose — unrelated edits must not un-freeze a
+baselined finding), stored with a count so adding a second identical
+violation on a new line still trips the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_WAIVER_RE = re.compile(r"lint:\s*([^#]*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # "G001"
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    snippet: str  # stripped source line (fingerprint component)
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.snippet}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format_text(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    new_findings: List[Finding]  # after baseline subtraction
+    parse_errors: List[Finding]  # syntax errors reported as G000
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new_findings) or bool(self.parse_errors)
+
+
+def _parse_waiver_tokens(comment: str) -> Set[str]:
+    """``# lint: waive G001, G006 -- why`` -> {"G001", "G006"}.
+
+    The justification separator accepts ``--`` and the unicode dashes
+    people actually type (– —); and only well-formed tokens (rule ids /
+    kebab-case aliases) count, so a missing separator can never let a
+    justification word accidentally waive another rule."""
+    m = _WAIVER_RE.search(comment)
+    if not m:
+        return set()
+    body = re.split(r"--|[–—]", m.group(1))[0]
+    tokens = {
+        t
+        for t in re.split(r"[,\s]+", body.strip())
+        if re.fullmatch(r"[A-Za-z][A-Za-z0-9_-]*", t)
+    }
+    tokens.discard("waive")
+    return tokens
+
+
+class FileContext:
+    """One parsed file: AST + comment map + waiver map + module constants."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[Finding] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = Finding(
+                rule="G000",
+                path=self.path,
+                line=e.lineno or 1,
+                col=(e.offset or 1) - 1,
+                message=f"syntax error: {e.msg}",
+                snippet=self._line(e.lineno or 1),
+            )
+        self.comments: Dict[int, str] = {}
+        self.waivers: Dict[int, Set[str]] = {}
+        self._scan_comments()
+        self.str_consts: Dict[str, str] = {}
+        self.int_consts: Dict[str, int] = {}
+        if self.tree is not None:
+            self._collect_consts()
+
+    def _line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _scan_comments(self) -> None:
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+                    waived = _parse_waiver_tokens(tok.string)
+                    if waived:
+                        self.waivers[tok.start[0]] = waived
+        except (tokenize.TokenError, IndentationError):
+            pass  # parse_error already carries the report
+
+    def _collect_consts(self) -> None:
+        for node in ast.iter_child_nodes(self.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(node.value, ast.Constant):
+                if isinstance(node.value.value, str):
+                    self.str_consts[tgt.id] = node.value.value
+                elif isinstance(node.value.value, int) and not isinstance(
+                    node.value.value, bool
+                ):
+                    self.int_consts[tgt.id] = node.value.value
+
+    def is_waived(self, rule_id: str, aliases: Sequence[str], line: int) -> bool:
+        for ln in (line, line - 1):
+            toks = self.waivers.get(ln)
+            if toks and (rule_id in toks or any(a in toks for a in aliases)):
+                return True
+        return False
+
+
+class PackageContext:
+    """Cross-file facts rules may consult (built in a first pass)."""
+
+    def __init__(self, files: Sequence[FileContext]):
+        self.files = files
+        # NAME -> str value, package-wide (for `from ... import AXIS`).
+        self.str_consts: Dict[str, str] = {}
+        for f in files:
+            self.str_consts.update(f.str_consts)
+        self.declared_axes: Set[str] = set()
+        for f in files:
+            if f.tree is not None:
+                self._collect_axes(f)
+
+    def _collect_axes(self, ctx: FileContext) -> None:
+        """Mesh axis declarations: string literals (or resolvable names)
+        anywhere in the arguments of ``Mesh(...)`` / ``make_mesh(...)`` /
+        ``AbstractMesh(...)`` calls."""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in (
+                "Mesh",
+                "make_mesh",
+                "AbstractMesh",
+            ):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    s = resolve_str(sub, ctx, self)
+                    if s is not None:
+                        self.declared_axes.add(s)
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """`jax.experimental.shard_map.shard_map` -> "shard_map"; Name -> id."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Full dotted path when the expression is a pure attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_str(
+    node: ast.AST, ctx: FileContext, pkg: Optional["PackageContext"] = None
+) -> Optional[str]:
+    """Constant str, or a Name resolvable to a module-level / package-level
+    string constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in ctx.str_consts:
+            return ctx.str_consts[node.id]
+        if pkg is not None and node.id in pkg.str_consts:
+            return pkg.str_consts[node.id]
+    return None
+
+
+def resolve_int(node: ast.AST, ctx: FileContext) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name) and node.id in ctx.int_consts:
+        return ctx.int_consts[node.id]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Runner
+
+
+def iter_py_files(paths: Iterable[str], root: str = ".") -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p) if not os.path.isabs(p) else p
+        if os.path.isfile(full) and full.endswith(".py"):
+            out.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d
+                    for d in dirnames
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _run_rules(
+    files: Sequence[FileContext], rules: Sequence
+) -> Tuple[List[Finding], List[Finding]]:
+    pkg = PackageContext(files)
+    findings: List[Finding] = []
+    parse_errors = [f.parse_error for f in files if f.parse_error is not None]
+    for ctx in files:
+        if ctx.tree is None:
+            continue
+        for rule in rules:
+            for finding in rule.check(ctx, pkg):
+                if not ctx.is_waived(
+                    rule.id, rule.aliases, finding.line
+                ):
+                    findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, parse_errors
+
+
+def lint_sources(
+    sources: Sequence[Tuple[str, str]], rules: Optional[Sequence] = None
+) -> LintResult:
+    """In-memory entry point (what tests/test_lint.py drives):
+    ``sources`` is [(relpath, source_text), ...]."""
+    if rules is None:
+        from tools.lint.rules import ALL_RULES as rules  # noqa: N811
+    files = [FileContext(p, s) for p, s in sources]
+    findings, parse_errors = _run_rules(files, rules)
+    return LintResult(findings, list(findings), parse_errors)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    root: str = ".",
+    baseline: Optional[dict] = None,
+    rules: Optional[Sequence] = None,
+) -> LintResult:
+    if rules is None:
+        from tools.lint.rules import ALL_RULES as rules  # noqa: N811
+    files = []
+    for fp in iter_py_files(paths, root):
+        rel = os.path.relpath(fp, root)
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                files.append(FileContext(rel, fh.read()))
+        except (OSError, UnicodeDecodeError) as e:
+            files.append(FileContext(rel, ""))
+            files[-1].parse_error = Finding(
+                "G000", rel.replace(os.sep, "/"), 1, 0, f"unreadable: {e}", ""
+            )
+    findings, parse_errors = _run_rules(files, rules)
+    new = subtract_baseline(findings, baseline or {})
+    return LintResult(findings, new, parse_errors)
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "fingerprints" not in data:
+        raise ValueError(f"{path}: not a graftlint baseline file")
+    return data
+
+
+def make_baseline(findings: Sequence[Finding]) -> dict:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    return {
+        "version": 1,
+        "comment": (
+            "Findings frozen at baseline time; the CLI fails only on "
+            "findings beyond these counts.  Regenerate with "
+            "`python -m tools.lint ... --write-baseline`."
+        ),
+        "fingerprints": dict(sorted(counts.items())),
+    }
+
+
+def subtract_baseline(
+    findings: Sequence[Finding], baseline: dict
+) -> List[Finding]:
+    budget = dict(baseline.get("fingerprints", {}))
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            new.append(f)
+    return new

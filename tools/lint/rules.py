@@ -1,0 +1,688 @@
+"""graftlint rules G001-G008 — each encodes one invariant this repo's
+performance tricks depend on (tools/lint/README.md documents the "why"
+per rule; keep that file in sync when touching these).
+
+Conventions shared by all rules:
+
+- a rule yields Findings; the engine drops the waived ones (see
+  engine.FileContext.is_waived for the waiver grammar);
+- "terminal name" matching (``lax.psum`` and ``psum`` both match
+  "psum") — this codebase imports both ways, and a linter that misses
+  the aliased spelling teaches people to alias around it;
+- name resolution is intentionally shallow (module-level constants,
+  package-wide constants): anything deeper is a heuristic, and a lint
+  heuristic that guesses wrong silently is worse than one that asks for
+  a waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.engine import (
+    FileContext,
+    Finding,
+    PackageContext,
+    dotted_name,
+    resolve_int,
+    resolve_str,
+    terminal_name,
+)
+
+_JIT_NAMES = {"jit", "pjit"}
+_SHARD_NAMES = {"shard_map", "smap", "pmap"}
+_NUMPY_ROOTS = {"np", "numpy"}
+
+
+class Rule:
+    id: str = "G000"
+    name: str = ""
+    aliases: Tuple[str, ...] = ()
+
+    def check(
+        self, ctx: FileContext, pkg: PackageContext
+    ) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, ctx: FileContext, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id,
+            path=ctx.path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=ctx._line(line),
+        )
+
+
+def _is_jit_spelling(node: ast.AST) -> bool:
+    """jit / jax.jit / pjit — as a bare reference (decorator or callee)."""
+    t = terminal_name(node)
+    return t in _JIT_NAMES
+
+
+def _decorator_marks_device_fn(dec: ast.AST) -> bool:
+    """True for @jit, @jax.jit, @shard_map, @partial(jax.jit, ...),
+    @jax.jit(...)-style decorators."""
+    t = terminal_name(dec)
+    if t in _JIT_NAMES or t in _SHARD_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        ft = terminal_name(dec.func)
+        if ft in _JIT_NAMES or ft in _SHARD_NAMES:
+            return True
+        if ft == "partial":
+            for a in list(dec.args) + [kw.value for kw in dec.keywords]:
+                at = terminal_name(a)
+                if at in _JIT_NAMES or at in _SHARD_NAMES:
+                    return True
+    return False
+
+
+def _device_functions(ctx: FileContext) -> List[ast.FunctionDef]:
+    """Functions whose bodies are traced/compiled: @jit/@shard_map
+    decorated, or ``*_kernel``-named (the Pallas kernel convention)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.endswith("_kernel") or node.name == "_kernel":
+            out.append(node)
+        elif any(_decorator_marks_device_fn(d) for d in node.decorator_list):
+            out.append(node)
+    return out
+
+
+class HostSyncRule(Rule):
+    """G001 — device→host synchronization.
+
+    (a) Inside traced code (@jit/@shard_map/`*_kernel`), any host-sync
+        call is a bug: it either fails at trace time or silently turns a
+        compiled region into a round trip per dispatch.
+    (b) In the device-mesh layer (``parallel/``), every ``np.asarray`` /
+        ``jax.device_get`` / ``.item()`` / ``.block_until_ready()`` IS a
+        device fetch crossing a link measured as low as 5 MB/s — each
+        site must carry a ``# lint: fetch-site`` waiver naming why the
+        fetch is necessary, so the audited-fetch-sites inventory lives
+        in the code itself.
+    """
+
+    id = "G001"
+    name = "host-sync"
+    # fetch-site: audited device→host fetch.  host-data: the argument is
+    # host-side data (e.g. a Python list of Device handles), not a device
+    # array — a false-positive suppression, not a fetch audit.
+    aliases = ("fetch-site", "host-data")
+    # Directories (path substrings) where ALL host fetches need an audit
+    # waiver, not just those inside traced functions.
+    fetch_audit_dirs: Tuple[str, ...] = ("parallel/",)
+
+    _SYNC_ATTRS = {"item", "block_until_ready", "tolist", "copy_to_host_async"}
+
+    def _sync_call_reason(self, node: ast.Call) -> Optional[str]:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in self._SYNC_ATTRS:
+                return f".{node.func.attr}() forces a device sync"
+            d = dotted_name(node.func)
+            if d is not None:
+                root, _, rest = d.partition(".")
+                if root in _NUMPY_ROOTS and rest in ("asarray", "array"):
+                    # A literal container argument is host data already —
+                    # no device round trip to audit.
+                    if node.args and isinstance(
+                        node.args[0],
+                        (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.Constant),
+                    ):
+                        return None
+                    return f"{d}() on a device array copies it to host"
+                if rest == "device_get" or d.endswith("device_get"):
+                    return f"{d}() copies to host"
+        elif isinstance(node.func, ast.Name):
+            if node.func.id == "device_get":
+                return "device_get() copies to host"
+        return None
+
+    def check(self, ctx, pkg):
+        device_fns = _device_functions(ctx)
+        traced_lines: Set[int] = set()
+        for fn in device_fns:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = self._sync_call_reason(node)
+                if reason is None and isinstance(node.func, ast.Name):
+                    # int()/float()/bool() on a non-constant inside traced
+                    # code concretizes a tracer (host sync or trace error).
+                    if node.func.id in ("int", "float", "bool") and (
+                        len(node.args) == 1
+                        and not isinstance(node.args[0], ast.Constant)
+                    ):
+                        reason = (
+                            f"{node.func.id}() on a traced value forces "
+                            "concretization"
+                        )
+                if reason is not None:
+                    traced_lines.add(node.lineno)
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"host sync inside traced function "
+                        f"`{fn.name}`: {reason}",
+                    )
+        if not any(d in ctx.path for d in self.fetch_audit_dirs):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if node.lineno in traced_lines:
+                continue  # already reported above
+            reason = self._sync_call_reason(node)
+            if reason is not None:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"device fetch in the mesh layer ({reason}); annotate "
+                    "the audited site with `# lint: fetch-site -- why`",
+                )
+
+
+class CollectiveAxisRule(Rule):
+    """G002 — collective axis names must tie back to a Mesh declaration.
+
+    A psum over a misspelled axis name fails only at trace time on a
+    mesh-bearing path — i.e. in the multi-chip job, not in unit tests.
+    Axis arguments must be string literals (or constants resolving to
+    literals) found in some ``Mesh(...)`` declaration in the linted
+    package, or flow through an ``axis``-named parameter (the
+    ``axis_name=None`` plumbing idiom, checked at its literal source).
+    """
+
+    id = "G002"
+    name = "collective-axis"
+    aliases = ("axis-ok",)
+
+    _COLLECTIVES = {
+        "psum": 1,
+        "pmean": 1,
+        "pmax": 1,
+        "pmin": 1,
+        "all_gather": 1,
+        "psum_scatter": 1,
+        "all_to_all": 1,
+        "ppermute": 1,
+        "axis_index": 0,
+        "axis_size": 0,
+    }
+
+    def _axis_arg(self, node: ast.Call, pos: int) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        if len(node.args) > pos:
+            return node.args[pos]
+        return None
+
+    def _check_axis_expr(
+        self, expr: ast.AST, ctx: FileContext, pkg: PackageContext
+    ) -> Optional[str]:
+        """None = fine; str = complaint."""
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                bad = self._check_axis_expr(el, ctx, pkg)
+                if bad:
+                    return bad
+            return None
+        s = resolve_str(expr, ctx, pkg)
+        if s is not None:
+            if pkg.declared_axes and s not in pkg.declared_axes:
+                return (
+                    f"axis name {s!r} does not appear in any Mesh "
+                    f"declaration (declared: {sorted(pkg.declared_axes)})"
+                )
+            return None
+        if isinstance(expr, ast.Constant) and expr.value is None:
+            return None  # the `axis_name or identity` guard idiom
+        t = terminal_name(expr)
+        if t is not None and "axis" in t.lower():
+            return None  # axis_name plumbing parameter
+        return (
+            "collective axis is not a string literal, a resolvable "
+            "constant, or an `axis`-named parameter"
+        )
+
+    def check(self, ctx, pkg):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = terminal_name(node.func)
+            if t not in self._COLLECTIVES:
+                continue
+            expr = self._axis_arg(node, self._COLLECTIVES[t])
+            if expr is None:
+                continue
+            complaint = self._check_axis_expr(expr, ctx, pkg)
+            if complaint:
+                yield self.finding(ctx, node, f"{t}: {complaint}")
+
+
+class RecompileHazardRule(Rule):
+    """G003 — recompile hazards.
+
+    Each distinct static-argument value is a full XLA compile (seconds);
+    unhashable statics are a TypeError at call time; a ``jax.jit`` call
+    constructed inside a loop body builds a NEW cache entry per
+    iteration and compiles every time.  The blessed pattern is the
+    ``self._fns`` memo in parallel/mesh.py.
+    """
+
+    id = "G003"
+    name = "recompile-hazard"
+    aliases = ("compile-cache-ok",)
+
+    def _jit_calls(self, root: ast.AST) -> Iterator[ast.Call]:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) and _is_jit_spelling(node.func):
+                yield node
+
+    def check(self, ctx, pkg):
+        for node in self._jit_calls(ctx.tree):
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") and (
+                    isinstance(kw.value, (ast.List, ast.Set, ast.Dict))
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{kw.arg} given a mutable {type(kw.value).__name__}"
+                        " literal — unhashable; use a tuple",
+                    )
+        # jit constructed inside a loop body (direct call or decorator on
+        # a nested def) — a fresh jit wrapper per iteration defeats the
+        # compile cache.  One recursive pass carrying an in-loop flag:
+        # ast.walk from every enclosing loop would report the same call
+        # once per nesting level and over-freeze the baseline.
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, ast.Call) and _is_jit_spelling(node.func):
+                if in_loop:
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "jit() constructed inside a loop body — every "
+                            "iteration makes a new wrapper and recompiles; "
+                            "hoist it (or memoize like DeviceContext._fns)",
+                        )
+                    )
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                if in_loop and any(
+                    _decorator_marks_device_fn(d)
+                    for d in node.decorator_list
+                ):
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            f"@jit function `{node.name}` defined inside "
+                            "a loop body recompiles per iteration",
+                        )
+                    )
+                in_loop = False  # a nested def's body runs per-call
+            elif isinstance(node, (ast.For, ast.While)):
+                for child in node.body + node.orelse:
+                    visit(child, True)
+                for child in ast.iter_child_nodes(node):
+                    if child not in node.body and child not in node.orelse:
+                        visit(child, in_loop)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_loop)
+
+        visit(ctx.tree, False)
+        yield from findings
+
+
+class DtypeDisciplineRule(Rule):
+    """G004 — dtype discipline.
+
+    Counting is int32-exact by contract (ROADMAP); 64-bit device dtypes
+    silently downcast while ``jax_enable_x64`` is off, so a ``jnp.int64``
+    outside the audited key-packing modules is at best a no-op and at
+    worst a wrong-answer generator.  Conversely a function that claims
+    exactness in its name/docstring must not accumulate through float32
+    without stating its gate (the ``< 2^24`` mantissa bound) in a waiver.
+    """
+
+    id = "G004"
+    name = "dtype-discipline"
+    aliases = ("f32-gate", "key-packing")
+
+    # Modules allowed to talk 64-bit on purpose (key packing packs rule
+    # rows into uint64 lanes; order.py is the historical home).
+    allowed_path_parts: Tuple[str, ...] = ("utils/order", "rules/gen")
+
+    _WIDE = {"int64", "float64", "uint64"}
+
+    def _is_jnp_root(self, d: Optional[str]) -> bool:
+        return d is not None and (
+            d.startswith("jnp.") or d.startswith("jax.numpy.")
+        )
+
+    def check(self, ctx, pkg):
+        allowed = any(p in ctx.path for p in self.allowed_path_parts)
+        if not allowed:
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and node.attr in self._WIDE
+                    and self._is_jnp_root(dotted_name(node))
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{dotted_name(node)} outside the key-packing "
+                        "modules: 64-bit is silently downcast while "
+                        "jax_enable_x64 is off",
+                    )
+                elif isinstance(node, ast.Call):
+                    d = dotted_name(node.func)
+                    if self._is_jnp_root(d):
+                        for kw in node.keywords:
+                            if (
+                                kw.arg == "dtype"
+                                and isinstance(kw.value, ast.Constant)
+                                and kw.value.value in self._WIDE
+                            ):
+                                yield self.finding(
+                                    ctx,
+                                    node,
+                                    f"dtype={kw.value.value!r} string on a "
+                                    "jnp call outside the key-packing "
+                                    "modules",
+                                )
+        # Exactness claims vs f32 accumulation.
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            doc = ast.get_docstring(fn) or ""
+            if "exact" not in fn.name.lower() and not re.search(
+                r"\bexact", doc, re.IGNORECASE
+            ):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "preferred_element_type":
+                        continue
+                    d = dotted_name(kw.value)
+                    if d in ("jnp.float32", "jax.numpy.float32"):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"`{fn.name}` claims exactness but accumulates "
+                            "in float32 — state the mantissa gate "
+                            "(counts < 2^24) in a `# lint: f32-gate` "
+                            "waiver or accumulate in int32",
+                        )
+
+
+class PallasConstraintRule(Rule):
+    """G005 — Pallas/TPU kernel constraints.
+
+    Mosaic tiles are (8, 128)-granular: a BlockSpec whose trailing dims
+    are not multiples of (8, 128) either fails to lower or pads and
+    silently wastes VMEM.  And a Python ``if`` on a ref value inside a
+    kernel body is a trace-time error masked until the kernel is next
+    recompiled — use ``pl.when`` / ``jnp.where``.
+    """
+
+    id = "G005"
+    name = "pallas-constraint"
+    aliases = ("tile-ok",)
+
+    def _imports_pallas(self, ctx: FileContext) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and (
+                ("pallas" in (node.module or ""))
+                or any("pallas" in a.name for a in node.names)
+            ):
+                return True
+            if isinstance(node, ast.Import) and any(
+                "pallas" in a.name for a in node.names
+            ):
+                return True
+        return False
+
+    def check(self, ctx, pkg):
+        if not self._imports_pallas(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) != "BlockSpec":
+                continue
+            shape = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "block_shape":
+                    shape = kw.value
+            if not isinstance(shape, (ast.Tuple, ast.List)):
+                continue
+            dims = [resolve_int(e, ctx) for e in shape.elts]
+            if len(dims) >= 1 and dims[-1] is not None and dims[-1] % 128:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"BlockSpec lane dim {dims[-1]} is not a multiple of "
+                    "128 (Mosaic tile granularity)",
+                )
+            if len(dims) >= 2 and dims[-2] is not None and dims[-2] % 8:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"BlockSpec sublane dim {dims[-2]} is not a multiple "
+                    "of 8 (Mosaic tile granularity)",
+                )
+        # Python `if` on ref values inside kernel bodies.
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ref_params = {
+                a.arg
+                for a in list(fn.args.args) + list(fn.args.posonlyargs)
+                if a.arg.endswith("_ref")
+            }
+            if not ref_params:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.IfExp)):
+                    continue
+                for sub in ast.walk(node.test):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and sub.id in ref_params
+                    ):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"Python `if` on ref `{sub.id}` in kernel "
+                            f"`{fn.name}` — refs are traced; use pl.when "
+                            "or jnp.where",
+                        )
+                        break
+
+
+class SilentExceptRule(Rule):
+    """G006 — swallowed exceptions.
+
+    ``except Exception: <no raise>`` hid the conftest collection failure
+    class of bug for five rounds; a broad handler must re-raise, convert
+    to the typed ``InputError`` family, or carry a waiver saying why
+    best-effort is correct (optional-dep probes, cache warming).
+    """
+
+    id = "G006"
+    name = "silent-except"
+    aliases = ("best-effort",)
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, ctx, pkg):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or (
+                terminal_name(node.type) in self._BROAD
+            )
+            if not broad:
+                continue
+            raises = any(
+                isinstance(sub, ast.Raise) for sub in ast.walk(node)
+            )
+            converts = any(
+                isinstance(sub, ast.Call)
+                and (terminal_name(sub.func) or "").endswith("Error")
+                for sub in ast.walk(node)
+            )
+            if raises or converts:
+                continue
+            what = (
+                "bare except:"
+                if node.type is None
+                else f"except {terminal_name(node.type)}:"
+            )
+            yield self.finding(
+                ctx,
+                node,
+                f"{what} swallows without re-raise or InputError "
+                "conversion; narrow it, raise, or waive with the reason "
+                "best-effort is safe here",
+            )
+
+
+class HazardousDefaultsRule(Rule):
+    """G007 — mutable defaults and import-time device work.
+
+    A mutable default is shared across calls (stale-state bugs that only
+    repro on the second run); a module-level jnp array construction
+    grabs a device and compiles at import time — which on a tunneled
+    TPU turns `import fastapriori_tpu` into a multi-second stall and
+    breaks JAX_PLATFORMS overrides applied after import.
+    """
+
+    id = "G007"
+    name = "hazardous-defaults"
+    aliases = ("import-time-ok",)
+
+    _JNP_CONSTRUCTORS = {
+        "array",
+        "asarray",
+        "zeros",
+        "ones",
+        "full",
+        "arange",
+        "linspace",
+        "eye",
+        "zeros_like",
+        "ones_like",
+    }
+
+    def check(self, ctx, pkg):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for default in list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None
+            ]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    yield self.finding(
+                        ctx,
+                        default,
+                        f"mutable default argument in `{fn.name}` is "
+                        "shared across calls; default to None",
+                    )
+        # Module/class level statements only — anything inside a def is
+        # deferred and fine.
+        def _toplevel(body):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    yield from _toplevel(stmt.body)
+                    continue
+                yield stmt
+
+        for stmt in _toplevel(ctx.tree.body):
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d is None:
+                    continue
+                root, _, rest = d.partition(".")
+                is_jnp = root == "jnp" or d.startswith("jax.numpy.")
+                if (is_jnp and node.func.attr in self._JNP_CONSTRUCTORS) or d in (
+                    "jax.device_put",
+                    "jax.devices",
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"module-level {d}() grabs a device backend at "
+                        "import time; construct lazily inside a function",
+                    )
+
+
+class TodoIssueRule(Rule):
+    """G008 — TODO/FIXME must reference an issue.
+
+    An unanchored TODO is a baseline-file entry nobody ever triages;
+    forcing a reference (#123, GH-123, an ISSUE/ROADMAP pointer, or a
+    URL) keeps the backlog in a place that gets read.
+    """
+
+    id = "G008"
+    name = "todo-issue"
+    aliases = ()
+
+    _TODO = re.compile(r"\b(TODO|FIXME|XXX)\b", re.IGNORECASE)
+    _REF = re.compile(
+        r"(#\d+|\bGH-\d+\b|\bISSUE\b|\bROADMAP\b|https?://)", re.IGNORECASE
+    )
+
+    def check(self, ctx, pkg):
+        for line_no, comment in sorted(ctx.comments.items()):
+            if self._TODO.search(comment) and not self._REF.search(comment):
+                yield Finding(
+                    rule=self.id,
+                    path=ctx.path,
+                    line=line_no,
+                    col=0,
+                    message=(
+                        "TODO/FIXME without an issue reference "
+                        "(#N, GH-N, ISSUE/ROADMAP pointer, or URL)"
+                    ),
+                    snippet=ctx._line(line_no),
+                )
+
+
+ALL_RULES: Sequence[Rule] = (
+    HostSyncRule(),
+    CollectiveAxisRule(),
+    RecompileHazardRule(),
+    DtypeDisciplineRule(),
+    PallasConstraintRule(),
+    SilentExceptRule(),
+    HazardousDefaultsRule(),
+    TodoIssueRule(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}
