@@ -1,6 +1,8 @@
-# Developer entry points.  `make ci` is the tier-1 flow: lint, then tests.
+# Developer entry points.  `make ci` is the tier-1 flow: lint, tests,
+# then the failpoint smoke pass (reliability wiring under injected
+# failure — see tools/failpoint_smoke.py).
 
-.PHONY: lint test ci baseline native
+.PHONY: lint test smoke ci baseline native
 
 lint:
 	python -m tools.lint fastapriori_tpu tests --baseline tools/lint/baseline.json
@@ -9,7 +11,10 @@ test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 	    --continue-on-collection-errors -p no:cacheprovider
 
-ci: lint test
+smoke:
+	env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
+
+ci: lint test smoke
 
 # Ratchet reset — only alongside the change that justifies it.
 baseline:

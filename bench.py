@@ -154,6 +154,13 @@ def _phase_summary(records, cold_s=None):
         elif ev == "fused_mine":
             ph["fused_mine_ms"] = round(w, 1)
             ph["dispatches"] += 1
+        elif ev == "degraded":
+            # A degraded run must be VISIBLY degraded in the record
+            # (reliability/ledger.py): every silent fallback — Pallas
+            # off, fused->level, int8 widen, cap-overflow retry, fetch
+            # retries — lands here, not just in a slower wall figure.
+            d = ph.setdefault("degraded", {})
+            d[r.get("kind", "?")] = d.get(r.get("kind", "?"), 0) + 1
     if levels_ms:
         ph["levels_ms"] = levels_ms
         ph["levels_total_ms"] = round(sum(levels_ms.values()), 1)

@@ -93,9 +93,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--resume-from",
         default=None,
-        help="prefix holding a previously saved freqItems artifact; "
-        "skips mining and runs recommendation only (reference "
-        "Utils.getAll, Utils.scala:65-81)",
+        help="prefix holding previous run artifacts: with a complete "
+        "freqItems table, skips mining and runs recommendation only "
+        "(reference Utils.getAll, Utils.scala:65-81); with only a "
+        "mid-mine checkpoint.npz (from --checkpoint-every-level), "
+        "restarts mining from the deepest completed level.  Artifacts "
+        "are validated against the run's MANIFEST.json when present",
+    )
+    p.add_argument(
+        "--checkpoint-every-level",
+        action="store_true",
+        help="crash-safe mining: atomically rewrite "
+        "<output>checkpoint.npz after every completed Apriori level so "
+        "an interrupted mine resumes mid-lattice via --resume-from "
+        "(costs eager per-level count fetches and skips the fused "
+        "whole-loop engine)",
     )
     p.add_argument(
         "--profile-dir",
@@ -157,6 +169,9 @@ def _run(args) -> int:
         # block-by-block at ingest); skipping it saves ~0.7 GB of host
         # copies at webdocs scale.
         retain_csr=False,
+        checkpoint_prefix=(
+            args.output if args.checkpoint_every_level else None
+        ),
     )
     if args.platform == "cpu":
         import jax
@@ -228,10 +243,43 @@ def _run(args) -> int:
 
     t1 = time.perf_counter()
     levels = item_counts = None
+    resume_ckpt = None
     if args.resume_from:
-        from fastapriori_tpu.io.resume import load_phase1
+        from fastapriori_tpu.errors import InputError
+        from fastapriori_tpu.io.checkpoint import (
+            checkpoint_available,
+            load_checkpoint,
+        )
+        from fastapriori_tpu.io.resume import load_phase1, phase1_available
 
-        freq_itemsets, item_to_rank, freq_items = load_phase1(args.resume_from)
+        if phase1_available(args.resume_from):
+            # Complete phase-1 artifacts: recommendation-only restart
+            # (the reference's Utils.getAll path).
+            try:
+                freq_itemsets, item_to_rank, freq_items = load_phase1(
+                    args.resume_from
+                )
+            except InputError:
+                # A torn phase-1 set (crash between the freqItems write
+                # and its aux artifacts, or a failed validation) must
+                # not wedge resume when a valid mid-mine checkpoint
+                # exists under the same prefix.
+                if not checkpoint_available(args.resume_from):
+                    raise
+                resume_ckpt = load_checkpoint(args.resume_from)
+        elif checkpoint_available(args.resume_from):
+            # Mid-mine checkpoint only: re-ingest D.dat and restart the
+            # level loop from the deepest completed level.
+            resume_ckpt = load_checkpoint(args.resume_from)
+        else:
+            raise InputError(
+                f"--resume-from {args.resume_from!r}: found neither the "
+                "phase-1 artifacts a --save-counts run writes "
+                "(freqItems, FreqItems, ItemsToRank) nor the mid-mine "
+                "checkpoint.npz a --checkpoint-every-level run writes"
+            )
+    if args.resume_from and resume_ckpt is None:
+        pass  # phase-1 resume: skip mining entirely
     else:
         profiler = None
         if args.profile_dir:
@@ -242,6 +290,11 @@ def _run(args) -> int:
         # the way into the writer and rule generator — no per-itemset
         # Python objects (multi-second at 10^6-itemset scale).
         miner = FastApriori(args.min_support, config=config)
+        if resume_ckpt is not None:
+            ck_levels, ck_meta = resume_ckpt
+            miner.set_resume_levels(
+                ck_levels, ck_meta, label=args.resume_from
+            )
         if n_proc > 1:
             # Multi-host: each process preprocesses only its own byte
             # range of D.dat (sharded ingest); results are replicated.
@@ -254,16 +307,25 @@ def _run(args) -> int:
         if profiler is not None:
             profiler.stop_trace()
         if proc_id == 0:  # one writer, like the reference's driver
-            from fastapriori_tpu.io.writer import save_freq_itemsets_levels
+            from fastapriori_tpu.io.writer import (
+                save_freq_itemsets_levels,
+                write_manifest,
+            )
 
+            manifest = {}
             save_freq_itemsets_levels(
                 args.output, levels, item_counts, freq_items,
                 with_counts_path=args.save_counts,
+                manifest=manifest,
             )
             if args.save_counts:
                 from fastapriori_tpu.io.resume import save_phase1_aux
 
-                save_phase1_aux(args.output, freq_items, item_to_rank)
+                save_phase1_aux(
+                    args.output, freq_items, item_to_rank,
+                    manifest=manifest,
+                )
+            write_manifest(args.output, manifest)
     print(
         "==== Total time for get freqItemsets "
         f"{int((time.perf_counter() - t1) * 1e3)}",
@@ -282,7 +344,11 @@ def _run(args) -> int:
     )
     recommends = recommender.run(u_lines)
     if proc_id == 0:
-        save_recommends(args.output, recommends)
+        from fastapriori_tpu.io.writer import write_manifest
+
+        manifest = {}
+        save_recommends(args.output, recommends, manifest=manifest)
+        write_manifest(args.output, manifest)
     print(
         "==== Total time for get recommends "
         f"{int((time.perf_counter() - t2) * 1e3)}",

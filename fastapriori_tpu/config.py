@@ -137,3 +137,14 @@ class MinerConfig:
     # [chunk, m_cap] containment intermediate in HBM (the scan over chunks
     # accumulates counts).
     fused_txn_chunk: int = 1 << 17
+    # Crash-safe mid-mine checkpointing (CLI --checkpoint-every-level):
+    # when set, the level loop rewrites <prefix>checkpoint.npz (atomic
+    # write + run-manifest entry, io/checkpoint.py) after EVERY completed
+    # level, so --resume-from restarts from the deepest completed level
+    # instead of from scratch.  Costs: per-level counts resolve eagerly
+    # (the deferred single-fetch optimization is incompatible with
+    # durable per-level state), and the whole-lattice fused engine is
+    # skipped (one opaque multi-level dispatch has no mid-points to
+    # checkpoint; the shallow-tail fold stays on — it checkpoints at the
+    # fold boundary).  None disables (the default).
+    checkpoint_prefix: Optional[str] = None

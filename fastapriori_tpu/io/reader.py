@@ -55,6 +55,15 @@ def _open(path: str):
     return open(path, "r")
 
 
+def _open_bytes(path: str):
+    """Binary twin of :func:`_open` — manifest validation hashes raw
+    bytes, so artifact reads must not round-trip through text decoding
+    first."""
+    if "://" in path:
+        return _require_fsspec(path).open(path, "rb").open()
+    return open(path, "rb")
+
+
 def split_lines_java(content: str) -> List[str]:
     """Split on ``\\n`` ONLY, dropping the empty tail a trailing newline
     leaves — the record-splitting rule of the native scanner

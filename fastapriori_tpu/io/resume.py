@@ -1,4 +1,5 @@
-"""Phase-1 checkpoint/resume (reference C14, Utils.scala:65-81).
+"""Phase-1 checkpoint/resume (reference C14, Utils.scala:65-81) with
+manifest-backed integrity validation.
 
 The reference has a manual, hardcoded restart hook: ``Utils.getAll`` reloads
 previously saved ``freqItemset``/``FreqItems``/``ItemsToRank`` files from
@@ -8,18 +9,30 @@ unused ``saveFreqItemsetWithCount`` (counts embedded as ``...[count]``,
 parsed back at Utils.scala:75-77).  Here it is a first-class
 ``--resume-from`` flag: :func:`save_phase1` writes the three artifacts under
 a prefix, :func:`load_phase1` round-trips them.
+
+Every artifact read first validates against the run's
+``<prefix>MANIFEST.json`` (written by ``fastapriori_tpu.io.writer``):
+size + sha256 of the *intended* content.  A truncated or corrupted
+artifact — a torn copy, a disk-full write from a pre-manifest tool, an
+injected ``write.<name>:truncate@N`` failpoint — raises
+:class:`InputError` naming the file instead of parsing cleanly into a
+silently-smaller lattice.  A missing manifest skips validation
+(artifacts from older runs stay loadable).
 """
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, List, Sequence, Tuple
+import hashlib
+import json
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 from fastapriori_tpu.errors import InputError
-from fastapriori_tpu.io.reader import _open, split_lines_java
+from fastapriori_tpu.io.reader import _open, _open_bytes, split_lines_java
 from fastapriori_tpu.io.writer import (
-    _ensure_parent,
-    open_write,
+    MANIFEST_NAME,
     save_freq_itemsets_with_count,
+    write_artifact,
+    write_manifest,
 )
 
 ItemsetWithCount = Tuple[FrozenSet[int], int]
@@ -34,42 +47,120 @@ def save_phase1(
     """Write ``<prefix>freqItems`` (itemsets with [count] suffixes,
     Utils.scala:51-63), ``<prefix>FreqItems`` (one item per line) and
     ``<prefix>ItemsToRank`` ("item rank" per line, the format
-    Utils.getAll parses at Utils.scala:72)."""
-    save_freq_itemsets_with_count(prefix, freq_itemsets, freq_items)
-    save_phase1_aux(prefix, freq_items, item_to_rank)
+    Utils.getAll parses at Utils.scala:72), plus the run manifest."""
+    manifest: Dict[str, dict] = {}
+    save_freq_itemsets_with_count(
+        prefix, freq_itemsets, freq_items, manifest=manifest
+    )
+    save_phase1_aux(prefix, freq_items, item_to_rank, manifest=manifest)
+    write_manifest(prefix, manifest)
 
 
 def save_phase1_aux(
-    prefix: str, freq_items: Sequence[str], item_to_rank: Dict[str, int]
+    prefix: str,
+    freq_items: Sequence[str],
+    item_to_rank: Dict[str, int],
+    manifest: Optional[Dict[str, dict]] = None,
 ) -> None:
     """The two small phase-1 artifacts (FreqItems, ItemsToRank); the
     itemset table itself comes from either writer variant (frozenset or
     matrix form)."""
-    path_items = prefix + "FreqItems"
-    _ensure_parent(path_items)
-    with open_write(path_items) as f:
-        f.writelines(item + "\n" for item in freq_items)
-    path_ranks = prefix + "ItemsToRank"
-    _ensure_parent(path_ranks)
-    with open_write(path_ranks) as f:
-        f.writelines(f"{item} {rank}\n" for item, rank in item_to_rank.items())
+    write_artifact(
+        prefix + "FreqItems",
+        (item + "\n" for item in freq_items),
+        "FreqItems",
+        manifest,
+    )
+    write_artifact(
+        prefix + "ItemsToRank",
+        (f"{item} {rank}\n" for item, rank in item_to_rank.items()),
+        "ItemsToRank",
+        manifest,
+    )
+
+
+def load_manifest(prefix: str) -> Optional[Dict[str, dict]]:
+    """The artifact table of ``<prefix>MANIFEST.json``, or None when no
+    manifest exists (pre-manifest runs).  A manifest that exists but
+    cannot be parsed is an InputError — integrity metadata that cannot
+    be read must not silently disable integrity checking."""
+    path = prefix + MANIFEST_NAME
+    try:
+        with _open_bytes(path) as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return None
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+        artifacts = doc["artifacts"]
+        if not isinstance(artifacts, dict):
+            raise ValueError("artifacts is not an object")
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise InputError(
+            f"corrupt run manifest {path!r}: {e} — delete it to skip "
+            "integrity validation, or re-run the producing step"
+        ) from None
+    return artifacts
+
+
+def validate_artifact_bytes(
+    prefix: str,
+    name: str,
+    raw: bytes,
+    manifest: Optional[Dict[str, dict]] = None,
+) -> None:
+    """Check ``raw`` (the full content of ``<prefix><name>``) against the
+    run manifest; InputError naming the file on any mismatch.  No-op when
+    no manifest exists or the manifest has no entry for ``name``."""
+    artifacts = load_manifest(prefix) if manifest is None else manifest
+    entry = (artifacts or {}).get(name)
+    if entry is None:
+        return
+    expected_bytes = entry.get("bytes")
+    expected_sha = entry.get("sha256")
+    if len(raw) != expected_bytes:
+        raise InputError(
+            f"artifact {prefix + name!r} fails manifest validation: "
+            f"expected {expected_bytes} bytes, found {len(raw)} — the "
+            "file is truncated or was modified after the run wrote it; "
+            "re-run the producing step"
+        )
+    if hashlib.sha256(raw).hexdigest() != expected_sha:
+        raise InputError(
+            f"artifact {prefix + name!r} fails manifest validation: "
+            "content checksum mismatch — the file was modified or "
+            "corrupted after the run wrote it; re-run the producing step"
+        )
 
 
 def _read_artifact(prefix: str, name: str) -> List[str]:
     path = prefix + name
     try:
-        # \n-only splitting (split_lines_java): an item token containing
-        # \x85, \x1c-\x1e or U+2028 is legal (not Java \s), and
-        # str.splitlines() would split artifacts the writer itself
-        # produced into bogus lines.
-        with _open(path) as f:
-            return split_lines_java(f.read())
+        with _open_bytes(path) as f:
+            raw = f.read()
     except FileNotFoundError:
         raise InputError(
             f"resume artifact {path!r} not found — --resume-from needs the "
             "three files a --save-counts run writes (freqItems, FreqItems, "
             "ItemsToRank) under the given prefix"
         ) from None
+    validate_artifact_bytes(prefix, name, raw)
+    # \n-only splitting (split_lines_java): an item token containing
+    # \x85, \x1c-\x1e or U+2028 is legal (not Java \s), and
+    # str.splitlines() would split artifacts the writer itself
+    # produced into bogus lines.
+    return split_lines_java(raw.decode("utf-8"))
+
+
+def phase1_available(prefix: str) -> bool:
+    """True when the phase-1 resume artifact set exists under ``prefix``
+    (probe: the freqItems table — the other two cannot be written
+    without it)."""
+    try:
+        with _open(prefix + "freqItems"):
+            return True
+    except FileNotFoundError:
+        return False
 
 
 def load_phase1(

@@ -1,22 +1,40 @@
-"""Output writers (reference: Utils.scala:29-63).
+"""Output writers (reference: Utils.scala:29-63), crash-safe.
 
 The reference writes through Spark ``saveAsTextFile``, producing a directory
-(``<output>freqItemset/part-00000``).  This framework writes a single plain
-file at ``<output>freqItemset`` / ``<output>recommends`` with byte-identical
-*content*: itemset lines print ranks in descending order mapped back to item
-strings, the whole file sorted lexicographically (Utils.scala:36-39);
-recommends are sorted by row index, one item per line (Utils.scala:48).
+(``<output>freqItemset/part-00000``) — and inherits atomicity from the
+Hadoop output committer (write to ``_temporary``, rename on commit).  This
+framework writes a single plain file at ``<output>freqItemset`` /
+``<output>recommends`` with byte-identical *content*: itemset lines print
+ranks in descending order mapped back to item strings, the whole file
+sorted lexicographically (Utils.scala:36-39); recommends are sorted by row
+index, one item per line (Utils.scala:48).
+
+Every artifact goes through :func:`write_artifact`: the committer analog —
+tmp file + fsync + atomic rename for local paths, so a crash mid-write
+can never leave a half-written artifact under the final name.  Writers
+optionally record each artifact's intended size + sha256 into a manifest
+dict; :func:`write_manifest` persists it as ``<prefix>MANIFEST.json`` and
+``fastapriori_tpu.io.resume`` validates artifacts against it on load, so
+a truncated/corrupted artifact fails loudly instead of parsing cleanly.
 
 Remote output prefixes (``hdfs://``, ``gs://``, ``memory://`` …) go through
 fsspec, mirroring the reader's ingest path — the reference wrote its
 results to HDFS (Utils.scala:36-40,48; run instructions README.md:33), so
-a remote *output* is part of the parity surface, not just input.
+a remote *output* is part of the parity surface, not just input.  Remote
+writes stream without the tmp+rename step (object stores commit on close);
+the manifest still guards them.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Iterable, Sequence, Tuple
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+from fastapriori_tpu.reliability import failpoints
+
+MANIFEST_NAME = "MANIFEST.json"
 
 
 def _ensure_parent(path: str) -> None:
@@ -29,18 +47,134 @@ def _ensure_parent(path: str) -> None:
 
 def open_write(path: str):
     """``open(path, "w")`` with an fsspec branch for remote URLs —
-    the writer twin of ``fastapriori_tpu.io.reader._open``."""
+    the writer twin of ``fastapriori_tpu.io.reader._open``.  Prefer
+    :func:`write_artifact` for run artifacts: this raw handle has no
+    atomicity, no manifest entry, and no failpoint instrumentation."""
     if "://" in path:
         try:
             import fsspec
 
+            # lint: waive G009 -- the raw remote text handle write_artifact builds on
             return fsspec.open(path, "w").open()
         except ImportError as e:  # pragma: no cover - environment dependent
             raise RuntimeError(
                 f"remote output path {path!r} requires fsspec, which is "
                 "not installed; write to a local path instead"
             ) from e
+    # lint: waive G009 -- the raw local text handle write_artifact builds on
     return open(path, "w")
+
+
+def _open_write_bytes(path: str):
+    if "://" in path:
+        try:
+            import fsspec
+
+            # lint: waive G009 -- write_artifact internals (atomic helper itself)
+            return fsspec.open(path, "wb").open()
+        except ImportError as e:  # pragma: no cover - environment dependent
+            raise RuntimeError(
+                f"remote output path {path!r} requires fsspec, which is "
+                "not installed; write to a local path instead"
+            ) from e
+    # lint: waive G009 -- write_artifact internals (atomic helper itself)
+    return open(path, "wb")
+
+
+def write_artifact_bytes(
+    path: str,
+    chunks: Iterable[bytes],
+    name: str,
+    manifest: Optional[Dict[str, dict]] = None,
+) -> str:
+    """Crash-safe artifact write: local paths write ``<path>.tmp`` +
+    fsync + atomic ``os.replace`` (a crash mid-write leaves only the tmp
+    file, never a torn artifact under the final name); remote paths
+    stream.  Failpoint site ``write.<name>`` can inject OSError/OOM or
+    truncate the physical bytes at byte N — the manifest entry records
+    the FULL intended content (size + sha256), so an injected truncation
+    is exactly what resume-side validation must catch.  Records into
+    ``manifest[name]`` when given; returns ``path``."""
+    site = "write." + name
+    failpoints.fire(site)
+    trunc = failpoints.truncation(site)
+    digest = hashlib.sha256()
+    # The manifest records the INTENDED artifact (full size + full-content
+    # sha256) even when a truncate failpoint shortens the physical file —
+    # that mismatch is exactly the integrity violation resume-side
+    # validation exists to catch.
+    intended = 0
+    written = 0
+    _ensure_parent(path)
+    local = "://" not in path
+    tmp = path + ".tmp" if local else path
+    f = _open_write_bytes(tmp)
+    try:
+        with f:
+            for chunk in chunks:
+                digest.update(chunk)
+                intended += len(chunk)
+                if trunc is not None:
+                    chunk = chunk[: max(trunc - written, 0)]
+                if chunk:
+                    f.write(chunk)
+                    written += len(chunk)
+            if local:
+                f.flush()
+                os.fsync(f.fileno())
+        if local:
+            os.replace(tmp, path)
+    except BaseException:
+        if local and os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    if manifest is not None:
+        manifest[name] = {
+            "bytes": intended,
+            "sha256": digest.hexdigest(),
+        }
+    return path
+
+
+def write_artifact(
+    path: str,
+    lines: Iterable[str],
+    name: str,
+    manifest: Optional[Dict[str, dict]] = None,
+) -> str:
+    """Text form of :func:`write_artifact_bytes` (utf-8)."""
+    return write_artifact_bytes(
+        path, (line.encode("utf-8") for line in lines), name, manifest
+    )
+
+
+def write_manifest(prefix: str, entries: Dict[str, dict]) -> str:
+    """Persist ``<prefix>MANIFEST.json``, merging over any existing
+    manifest at the same prefix (phase-1 artifacts and the recommends
+    file are written at different times by the same run).  The manifest
+    write itself is atomic; it is deliberately the LAST write, so a crash
+    between an artifact and its manifest entry leaves a manifest that
+    still validates the artifacts it lists."""
+    path = prefix + MANIFEST_NAME
+    merged: Dict[str, dict] = {}
+    try:
+        # Remote prefixes merge too — a recommends-phase rewrite that
+        # dropped the mining entries would silently disable integrity
+        # validation for exactly the artifacts --resume-from parses.
+        from fastapriori_tpu.io.reader import _open_bytes
+
+        with _open_bytes(path) as f:
+            prev = json.loads(f.read().decode("utf-8"))
+        artifacts = prev.get("artifacts", {})
+        if isinstance(artifacts, dict):
+            merged.update(artifacts)
+    except (OSError, ValueError, UnicodeDecodeError):
+        pass  # absent or corrupt old manifest: superseded by the rewrite
+    merged.update(entries)
+    body = json.dumps(
+        {"version": 1, "artifacts": merged}, indent=2, sort_keys=True
+    )
+    return write_artifact(path, [body + "\n"], MANIFEST_NAME)
 
 
 def format_itemset_line(ranks: Iterable[int], freq_items: Sequence[str]) -> str:
@@ -53,6 +187,7 @@ def save_freq_itemsets(
     output_prefix: str,
     freq_itemsets: Sequence[Tuple[frozenset, int]],
     freq_items: Sequence[str],
+    manifest: Optional[Dict[str, dict]] = None,
 ) -> str:
     """Write ``<output>freqItemset`` (Utils.scala:29-41).  Lines sorted
     lexicographically (``sortBy(x => x)`` on strings — code-unit order,
@@ -60,16 +195,16 @@ def save_freq_itemsets(
     lines = [format_itemset_line(s, freq_items) for s, _ in freq_itemsets]
     lines.sort()
     path = output_prefix + "freqItemset"
-    _ensure_parent(path)
-    with open_write(path) as f:
-        f.writelines(line + "\n" for line in lines)
-    return path
+    return write_artifact(
+        path, (line + "\n" for line in lines), "freqItemset", manifest
+    )
 
 
 def save_freq_itemsets_with_count(
     output_prefix: str,
     freq_itemsets: Sequence[Tuple[frozenset, int]],
     freq_items: Sequence[str],
+    manifest: Optional[Dict[str, dict]] = None,
 ) -> str:
     """Write ``<output>freqItems`` with counts embedded as ``...[count]``
     (Utils.scala:51-63) — the resume artifact parsed back by
@@ -81,10 +216,9 @@ def save_freq_itemsets_with_count(
     ]
     lines.sort()
     path = output_prefix + "freqItems"
-    _ensure_parent(path)
-    with open_write(path) as f:
-        f.writelines(line + "\n" for line in lines)
-    return path
+    return write_artifact(
+        path, (line + "\n" for line in lines), "freqItems", manifest
+    )
 
 
 def _level_joined(levels, freq_items: Sequence[str]):
@@ -112,6 +246,7 @@ def save_freq_itemsets_levels(
     item_counts,
     freq_items: Sequence[str],
     with_counts_path: bool = False,
+    manifest: Optional[Dict[str, dict]] = None,
 ) -> str:
     """Matrix-form twin of :func:`save_freq_itemsets` (+ optionally the
     ``freqItems`` resume artifact of
@@ -134,9 +269,9 @@ def save_freq_itemsets_levels(
     lines.extend(freq_items)
     lines.sort()
     path = output_prefix + "freqItemset"
-    _ensure_parent(path)
-    with open_write(path) as f:
-        f.writelines(line + "\n" for line in lines)
+    write_artifact(
+        path, (line + "\n" for line in lines), "freqItemset", manifest
+    )
     if with_counts_path:
         clines.extend(
             f"{tok}[{int(c)}]"
@@ -144,20 +279,26 @@ def save_freq_itemsets_levels(
         )
         clines.sort()
         cpath = output_prefix + "freqItems"
-        with open_write(cpath) as f:
-            f.writelines(line + "\n" for line in clines)
+        write_artifact(
+            cpath, (line + "\n" for line in clines), "freqItems", manifest
+        )
     return path
 
 
 def save_recommends(
-    output_prefix: str, recommends: Sequence[Tuple[int, str]]
+    output_prefix: str,
+    recommends: Sequence[Tuple[int, str]],
+    manifest: Optional[Dict[str, dict]] = None,
 ) -> str:
     """Write ``<output>recommends``: sorted by original row index, one
     recommended item (or "0") per line (Utils.scala:43-49)."""
     path = output_prefix + "recommends"
-    _ensure_parent(path)
-    with open_write(path) as f:
-        f.writelines(
-            item + "\n" for _, item in sorted(recommends, key=lambda x: x[0])
-        )
-    return path
+    return write_artifact(
+        path,
+        (
+            item + "\n"
+            for _, item in sorted(recommends, key=lambda x: x[0])
+        ),
+        "recommends",
+        manifest,
+    )

@@ -32,9 +32,19 @@ from fastapriori_tpu.ops.bitmap import (
 )
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import CompressedData, preprocess
+from fastapriori_tpu.reliability import failpoints, ledger, retry
 from fastapriori_tpu.utils.logging import MetricsLogger
 
 ItemsetWithCount = Tuple[FrozenSet[int], int]
+
+# Concrete types the device-probe calls below can raise: backends
+# without the probe (AttributeError/NotImplementedError) and the XLA
+# runtime's own error types (reliability/retry.py) — a bare Exception
+# here once hid real engine bugs behind the 16 GB default (ADVICE r5).
+_PROBE_ERRORS: Tuple[type, ...] = (
+    AttributeError,
+    NotImplementedError,
+) + retry.xla_runtime_error_types()
 
 
 def _next_pow2(n: int) -> int:
@@ -70,8 +80,8 @@ def _fused_m_cap_memory_limit(
     if budget is None:
         try:
             stats = dev.memory_stats()
-        # lint: waive G006 -- backends without memory_stats fall to the 16 GB default
-        except Exception:
+        except _PROBE_ERRORS:
+            # Backends without memory_stats fall to the 16 GB default.
             stats = None
         hbm = (stats or {}).get("bytes_limit") or 16 * 2**30
         budget = int(cfg.fused_hbm_fraction * hbm)
@@ -135,7 +145,14 @@ class FastApriori:
         if num_devices is not None:
             self.config.num_devices = num_devices
         self._context = context
-        self.metrics = MetricsLogger(enabled=self.config.log_metrics)
+        self.metrics = MetricsLogger(
+            enabled=self.config.log_metrics
+        ).bind_global_ledger()
+        # Mid-mine resume state (io/checkpoint.py): levels already
+        # counted by an interrupted run, consumed by the first mine.
+        self._resume_levels: Optional[list] = None
+        self._resume_meta: Optional[Dict[str, int]] = None
+        self._resume_label = "checkpoint"
 
     # Fluent setters (FastApriori.scala:21-29).
     def set_min_support(self, min_support: float) -> "FastApriori":
@@ -146,6 +163,80 @@ class FastApriori:
         self.config.num_devices = num_devices
         self._context = None
         return self
+
+    def set_resume_levels(
+        self,
+        levels: list,
+        meta: Optional[Dict[str, int]] = None,
+        label: str = "checkpoint",
+    ) -> "FastApriori":
+        """Seed the next mine with levels an interrupted run already
+        completed (``--resume-from`` a ``--checkpoint-every-level``
+        checkpoint, io/checkpoint.py): the level loop restarts from the
+        deepest one instead of recounting.  ``meta`` (``n_raw`` /
+        ``min_count`` / ``num_items``) pins the levels to their dataset;
+        a mismatch with the freshly ingested data raises InputError
+        rather than silently grafting one dataset's lattice onto
+        another."""
+        self._resume_levels = levels
+        self._resume_meta = meta
+        self._resume_label = label
+        return self
+
+    def _take_resume(self, data: CompressedData) -> Optional[list]:
+        levels = self._resume_levels
+        if not levels:
+            return None
+        # One-shot: a later mine() on this instance must never silently
+        # re-graft the stale lattice (check_meta pins only three ints —
+        # a different dataset could collide on all of them).
+        meta, label = self._resume_meta, self._resume_label
+        self._resume_levels = None
+        self._resume_meta = None
+        if meta is not None:
+            from fastapriori_tpu.io.checkpoint import check_meta
+
+            check_meta(
+                meta,
+                n_raw=data.n_raw,
+                min_count=data.min_count,
+                num_items=data.num_items,
+                prefix=label,
+            )
+        return levels
+
+    def _checkpoint_levels(self, levels: list, data: CompressedData) -> None:
+        """Crash-safe per-level checkpoint (config.checkpoint_prefix):
+        atomic rewrite of ``<prefix>checkpoint.npz`` + manifest after a
+        completed level, then the ``level.<k>`` failpoint — so tests can
+        kill the run at exactly the point where the checkpoint exists
+        but nothing after it does."""
+        if not levels:
+            return
+        prefix = self.config.checkpoint_prefix
+        k = int(levels[-1][0].shape[1])
+        if prefix and jax.process_index() == 0:
+            from fastapriori_tpu.io.checkpoint import save_checkpoint
+
+            with self.metrics.timed("checkpoint", levels=len(levels), k=k):
+                save_checkpoint(
+                    prefix,
+                    levels,
+                    {
+                        "n_raw": data.n_raw,
+                        "min_count": data.min_count,
+                        "num_items": data.num_items,
+                    },
+                )
+        failpoints.fire(f"level.{k}")
+
+    def _fused_fallback(self, partial: Optional[list]) -> None:
+        """One call per fused→level fallback: the legacy metrics event
+        (asserted by the engine tests / bench parsers) plus the
+        degradation-ledger entry."""
+        n = len(partial) if partial else 0
+        self.metrics.emit("fused_fallback", resume_levels=n)
+        ledger.record("fused_fallback", resume_levels=n)
 
     @property
     def context(self) -> DeviceContext:
@@ -351,6 +442,7 @@ class FastApriori:
             n_raw = sum(p[0] for p in parts)
             merged: Counter = Counter()
             for _, toks, cnts in parts:
+                # lint: host-data -- native pass-1 count tables are host numpy
                 for tok, c in zip(toks, cnts.tolist()):
                     merged[tok] += c
             min_count = math.ceil(cfg.min_support * n_raw)
@@ -700,6 +792,17 @@ class FastApriori:
                         upool.submit(jax.device_put, weights, dev)
                     )
                     if cfg.retain_csr:
+                        # Block-RETAINING caller: storing `items` past
+                        # this callback is only legal for the owned copy
+                        # copy_items=True produces — the loader freezes
+                        # its arena views (writeable=False), so a wiring
+                        # mistake that stored a dangling view dies here,
+                        # not as corrupted baskets three phases later.
+                        assert items.flags.writeable, (
+                            "retain_csr requires copy_items=True: `items`"
+                            " is a read-only native-arena view valid only"
+                            " inside the callback"
+                        )
                         blocks.append((items, offsets, weights))
                         return
                     # retain_csr=False: ``items`` is a view into the
@@ -765,6 +868,9 @@ class FastApriori:
                     n_raw < 2**24
                     and ctx.txn_shards == 1
                     and ctx.cand_shards == 1
+                    # A mid-mine resume skips level 2 entirely — don't
+                    # burn the overlapped pair dispatch for it.
+                    and self._resume_levels is None
                 ):
                     from fastapriori_tpu.ops.count import TRI_F_CAP
 
@@ -787,6 +893,7 @@ class FastApriori:
                         jnp.int32(min_count), jnp.int32(f),
                     )
                     try:
+                        # lint: fetch-site -- non-blocking prefetch of the audited pair fetch below
                         pair_packed.copy_to_host_async()
                     except (AttributeError, NotImplementedError):
                         pass
@@ -888,15 +995,17 @@ class FastApriori:
             data.shard.global_count if data.shard else data.total_count
         )
         if data.num_items >= 2 and total > 0:
-            if self.config.engine in ("fused", "auto"):
+            # Mid-mine resume and per-level checkpointing both force the
+            # level engine: the whole-lattice fused dispatch has no
+            # mid-points to seed from or checkpoint at.
+            if self.config.engine in ("fused", "auto") and not (
+                self._resume_levels or self.config.checkpoint_prefix
+            ):
                 levels, partial = self._mine_fused(
                     data, auto=self.config.engine == "auto"
                 )
                 if levels is None:  # row budget / level bound / auto choice
-                    self.metrics.emit(
-                        "fused_fallback",
-                        resume_levels=len(partial) if partial else 0,
-                    )
+                    self._fused_fallback(partial)
                     levels = self._mine_levels(data, resume=partial or None)
             else:
                 levels = self._mine_levels(data)
@@ -915,6 +1024,7 @@ class FastApriori:
             freq_itemsets: List[ItemsetWithCount] = []
             for mat, cnts in levels:
                 freq_itemsets.extend(
+                    # lint: host-data -- level matrices are host numpy by here
                     zip(map(frozenset, mat.tolist()), cnts.tolist())
                 )
             m.update(n=len(freq_itemsets))
@@ -1175,8 +1285,12 @@ class FastApriori:
             with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
                 fn = build(m_cap)
                 # ONE device->host transfer for the whole mining result.
-                packed_out = np.asarray(
-                    fn(bitmap_arg, w, jnp.int32(min_count))
+                packed_out = retry.fetch(
+                    # lint: fetch-site -- the fused engine's single audited fetch, retry-wrapped
+                    lambda: np.asarray(
+                        fn(bitmap_arg, w, jnp.int32(min_count))
+                    ),
+                    "fused",
                 )
                 rows, cols, counts, n_lvl, incomplete, overflow = (
                     fused.unpack_fused_result(packed_out, cfg.fused_l_max)
@@ -1330,6 +1444,11 @@ class FastApriori:
         ctx = self.context
         f = data.num_items
         min_count = data.min_count
+        if resume is None:
+            # Mid-mine checkpoint resume rides the same mechanism as the
+            # fused-salvage resume; every mining entry point funnels
+            # through here, so the take happens exactly once.
+            resume = self._take_resume(data)
 
         if preupload is not None:
             bitmap, w_digits, scales, n_chunks, t_pad, f_pad, heavy = (
@@ -1489,7 +1608,10 @@ class FastApriori:
             """Host values from the overlapped pair program (memoized —
             the fused auto-choice and level 2 share one fetch)."""
             if "host" not in pair_pre:
-                out = np.asarray(pair_pre["packed"])
+                out = retry.fetch(
+                    # lint: fetch-site -- the overlapped pair program's ONE audited fetch, retry-wrapped
+                    lambda: np.asarray(pair_pre["packed"]), "pair_pre"
+                )
                 cap = pair_pre["cap"]
                 pair_pre["host"] = (
                     out[:cap],
@@ -1503,6 +1625,7 @@ class FastApriori:
             not resume
             and try_fused
             and cfg.engine in ("fused", "auto")
+            and not cfg.checkpoint_prefix  # no mid-points to checkpoint
             and ctx.cand_shards == 1
             and data.shard is None
         )
@@ -1526,9 +1649,7 @@ class FastApriori:
             if lv is not None:
                 return lv
             if partial:
-                self.metrics.emit(
-                    "fused_fallback", resume_levels=len(partial)
-                )
+                self._fused_fallback(partial)
                 resume = partial
 
         if resume:
@@ -1548,6 +1669,9 @@ class FastApriori:
                     idx, cnt, n2, tri = pair_fetch()
                     cap = pair_pre["cap"]
                     if n2 > cap:
+                        ledger.record(
+                            "pair_cap_overflow", n2=int(n2), cap=cap
+                        )
                         cap = _next_pow2(n2)
                         idx, cnt, _ = ctx.pair_regather(
                             pair_pre["counts_dev"], min_count, f, cap
@@ -1573,6 +1697,9 @@ class FastApriori:
                         # Overflow: re-extract at the exact budget over
                         # the RESIDENT count matrix — no Gram re-run, no
                         # matmul compile (mesh.pair_regather).
+                        ledger.record(
+                            "pair_cap_overflow", n2=int(n2), cap=cap
+                        )
                         cap = _next_pow2(n2)
                         idx, cnt, _ = ctx.pair_regather(
                             counts_dev, min_count, f, cap
@@ -1607,17 +1734,18 @@ class FastApriori:
                     # Salvaged complete levels include level 2 (bit-exact
                     # with the gather above — both are exact weighted
                     # counts over the same bitmap).
-                    self.metrics.emit(
-                        "fused_fallback", resume_levels=len(partial)
-                    )
+                    self._fused_fallback(partial)
                     levels[:] = partial
                     cur = partial[-1][0]
+            self._checkpoint_levels(levels, data)
 
         # Deferred count resolution (single-process): per-level fetches
         # carry only survivor bitmasks; counts resolve here in ONE
-        # dispatch + fetch after the loop.
+        # dispatch + fetch after the loop.  Checkpointing forces eager
+        # counts — a durable level must carry its counts, and deferring
+        # them would leave every checkpoint one crash away from useless.
         pending_map: Dict[int, list] = {}
-        defer = jax.process_count() == 1
+        defer = jax.process_count() == 1 and not cfg.checkpoint_prefix
 
         def finish(lvls):
             return self._resolve_pending_counts(
@@ -1674,6 +1802,7 @@ class FastApriori:
                     levels.extend(tail)
                     cur = tail[-1][0]
                     k = cur.shape[1] + 1
+                    self._checkpoint_levels(levels, data)
                 if complete:
                     return finish(levels)
                 continue  # incomplete: per-level from the last good level
@@ -1698,6 +1827,8 @@ class FastApriori:
             elif nxt_counts is None:  # empty level
                 nxt_counts = np.empty(0, dtype=np.int64)
             levels.append((nxt, nxt_counts))
+            if nxt.shape[0]:
+                self._checkpoint_levels(levels, data)
             prev_rows = cur.shape[0]
             cur = nxt
             k += 1
@@ -1826,7 +1957,8 @@ class FastApriori:
             ]
             if heavy is not None:
                 args += [hb, hw]
-            packed_out = np.asarray(fn(*args))
+            # lint: fetch-site -- the tail fold's single audited fetch, retry-wrapped
+            packed_out = retry.fetch(lambda: np.asarray(fn(*args)), "tail")
             rows, cols, counts, n_lvl, incomplete = (
                 fused.unpack_tail_result(
                     packed_out, m_cap, cfg.tail_fuse_l_max
@@ -2039,6 +2171,7 @@ class FastApriori:
                 fast_f32=fast_f32,
             )
             try:
+                # lint: fetch-site -- non-blocking prefetch of the audited bitmask fetch below
                 bits.copy_to_host_async()
             except (AttributeError, NotImplementedError):
                 pass
@@ -2067,7 +2200,9 @@ class FastApriori:
         # (_resolve_pending_counts).
         pending = []  # (counts_dev [NB, C], flat positions int64[n])
         for (placed_all, bits, counts_out), blk in zip(inflight, blocks):
-            arr = np.unpackbits(np.asarray(bits), axis=1)  # [NB, C]
+            # lint: fetch-site -- the per-level survivor-bitmask fetch (C/8 bytes), retry-wrapped
+            mask = retry.fetch(lambda b=bits: np.asarray(b), "level_bits")
+            arr = np.unpackbits(mask, axis=1)  # [NB, C]
             c_tot = arr.shape[1]
             keep_blk = blk[2]
             pos_parts = []
@@ -2096,11 +2231,16 @@ class FastApriori:
             [level[x_idx[keep]], ys[keep, None]], axis=1
         ).astype(np.int32)
         if not defer_counts:
-            # Multi-process SPMD: the deferred device gather would mix
-            # global and process-local arrays; fetch this level's count
-            # arrays now and slice on host (the pre-deferral behavior).
+            # Multi-process SPMD (and checkpointing runs): the deferred
+            # device gather would mix global and process-local arrays;
+            # fetch this level's count arrays now and slice on host (the
+            # pre-deferral behavior).
             parts = [
-                np.asarray(c).reshape(-1)[p] for c, p in pending if p.size
+                # lint: fetch-site -- eager per-level count fetch (defer off), retry-wrapped
+                retry.fetch(lambda c=c: np.asarray(c), "level_counts")
+                .reshape(-1)[p]
+                for c, p in pending
+                if p.size
             ]
             counts = (
                 np.concatenate(parts) if parts else np.empty(0, np.int64)

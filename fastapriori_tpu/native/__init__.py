@@ -16,6 +16,9 @@ def native_available() -> bool:
         from fastapriori_tpu.native.loader import get_lib
 
         return get_lib() is not None
-    # lint: waive G006 -- optional-dep probe; callers use the Python fallback
-    except Exception:
+    except (OSError, AttributeError):
+        # get_lib converts CDLL load failures to None (and a ledger
+        # event); a filesystem-level surprise (OSError) or a stale .so
+        # missing a hard-bound symbol (AttributeError from the restype/
+        # argtypes binding) still means "no native path" here.
         return False

@@ -49,9 +49,18 @@ def _try_build() -> None:
             capture_output=True,
             timeout=120,
         )
-    # lint: waive G006 -- best-effort build; absence falls back to Python path
-    except Exception:
-        pass
+    except (OSError, subprocess.SubprocessError) as e:
+        # Best-effort build: absence falls back to the Python path — but
+        # that fallback is a real slowdown at scale, so it is a recorded
+        # degradation, not a silent one.
+        from fastapriori_tpu.reliability import ledger
+
+        ledger.record(
+            "native_unavailable",
+            once_key="build",
+            stage="build",
+            error=f"{type(e).__name__}: {e}"[:200],
+        )
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -62,7 +71,24 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _try_build()
     if not os.path.exists(_SO):
         return None
-    lib = ctypes.CDLL(_SO)
+    try:
+        from fastapriori_tpu.reliability import failpoints
+
+        failpoints.fire("native.load")
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:
+        # A present-but-unloadable .so (stale build, injected
+        # native.load failpoint): same contract as absence — callers
+        # fall back to the Python path, loudly.
+        from fastapriori_tpu.reliability import ledger
+
+        ledger.record(
+            "native_unavailable",
+            once_key="load",
+            stage="load",
+            error=f"{type(e).__name__}: {e}"[:200],
+        )
+        return None
     lib.fa_preprocess_buffer.restype = ctypes.POINTER(_FaResult)
     lib.fa_preprocess_buffer.argtypes = [
         ctypes.c_char_p,
@@ -366,6 +392,9 @@ def preprocess_buffer_blocks(
     that consume items inside the callback — bitmap packing, heavy-row
     extraction — skip it).  Returns the global tables
     ``(n_raw, min_count, freq_items, item_counts)``."""
+    from fastapriori_tpu.reliability import failpoints
+
+    failpoints.fire("native.blocks")
     lib = get_lib()
     if lib is None or getattr(lib, "fa_preprocess_buffer_blocks", None) is None:
         raise RuntimeError(
@@ -417,6 +446,15 @@ def preprocess_buffer_blocks(
             ]
             if copy_items:
                 items = items.copy()
+            else:
+                # The view dies with this callback (the native arena is
+                # reused for the next block); freeze it so a consumer
+                # that tries to mutate a stored dangling view fails
+                # loudly instead of scribbling on recycled memory
+                # (ADVICE r5 #3).  Reads of a stored view are still
+                # dangling — hence the retaining callers assert
+                # copy_items=True (models/apriori.py).
+                items.flags.writeable = False
             weights = np.ctypeslib.as_array(w_p, shape=(max(t, 1),))[
                 :t
             ].copy()

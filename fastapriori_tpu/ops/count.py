@@ -323,6 +323,7 @@ def local_level_gather(
     cand_axis_name: Optional[str] = None,
     fast_f32: bool = False,
     pallas_tiles: Optional[tuple] = None,
+    wide_member: bool = False,
 ) -> jnp.ndarray:
     """C8, transfer-minimal form: one compilation serves EVERY level.
 
@@ -359,6 +360,13 @@ def local_level_gather(
     write+read that bounds this phase on real chips.  TPU path only;
     the caller (parallel/mesh.py level_gather_batch) picks tiles that
     divide the local shapes or passes None.
+
+    ``wide_member``: int32 membership accumulation.  The int8 fast path
+    is exact only while the intersection size is bounded by ``k1 <= 127``
+    (int8 saturates/wraps past that, silently matching or missing
+    prefixes — ADVICE r5 #1); dispatch sites set this for levels with
+    ``k1 >= 128`` instead of miscounting.  4x the [tc, P] intermediate
+    bytes, paid only on absurdly deep lattices.
     """
     t_loc, f_pad = bitmap.shape
     p = prefix_cols.shape[0]
@@ -377,6 +385,9 @@ def local_level_gather(
         # Caller gates on the single LOW digit; a scaled single digit
         # (scale != 1) would be silently dropped below, so reject it.
         assert tuple(scales) == (1,), scales
+        # The Pallas kernel shares the int8 membership bound; dispatch
+        # sites route k1 >= 128 levels to the XLA wide path instead.
+        assert not wide_member, "wide_member has no Pallas path"
         tt, mt = pallas_tiles
         # w ⊙ B computed here (XLA, one [T, F] int8 elementwise): it is
         # loop-invariant across the NB-block scan above, so XLA hoists
@@ -426,13 +437,16 @@ def local_level_gather(
                 preferred_element_type=jnp.float32,
             ).astype(jnp.int32)
             return acc + total, None
+        # int8 accumulation is exact only for k1 <= 127 (docstring);
+        # wide_member dispatches widen to int32 rather than miscount.
+        member_dt = jnp.int32 if wide_member else jnp.int8
         member = lax.dot_general(
             b_chunk,
             onehot,
             (((1,), (1,)), ((), ())),  # contract over F -> [tc, P]
-            preferred_element_type=jnp.int8,
+            preferred_element_type=member_dt,
         )
-        common = (member == k1.astype(jnp.int8)).astype(jnp.int8)
+        common = (member == k1.astype(member_dt)).astype(jnp.int8)
         total = None
         for di, scale in enumerate(scales):
             part = lax.dot_general(
@@ -475,6 +489,7 @@ def local_level_gather_batch(
     cand_axis_name: Optional[str] = None,
     fast_f32: bool = False,
     pallas_tiles: Optional[tuple] = None,
+    wide_member: bool = False,
 ) -> jnp.ndarray:
     """A whole level's prefix blocks in ONE launch: ``lax.scan`` over the
     stacked blocks, each step = :func:`local_level_gather`.  Kernel
@@ -499,6 +514,7 @@ def local_level_gather_batch(
             cand_axis_name=cand_axis_name,
             fast_f32=fast_f32,
             pallas_tiles=pallas_tiles,
+            wide_member=wide_member,
         )
         return carry, out
 

@@ -224,9 +224,16 @@ def _fused_mine_local(
         def contains_prefix(b):
             dt = jnp.float32 if fast_f32 else jnp.int8
             # int path: int8 output — intersection sizes are bounded by
-            # the set size k-1 <= l_max << 127, and the [T_c, M]
-            # intermediate's HBM traffic (not the MXU) bounds this phase.
-            acc = jnp.float32 if fast_f32 else jnp.int8
+            # the set size k-1 <= l_max, exact while l_max <= 127; an
+            # l_max past that widens the accumulator to int32 (ADVICE r5
+            # #1 — int8 would silently wrap) at 4x the [T_c, M]
+            # intermediate's HBM traffic, which is what bounds this
+            # phase.
+            acc = (
+                jnp.float32
+                if fast_f32
+                else (jnp.int32 if l_max >= 128 else jnp.int8)
+            )
             overlap = lax.dot_general(
                 b.astype(dt), s.astype(dt), (((1,), (1,)), ((), ())),
                 preferred_element_type=acc,
@@ -492,13 +499,19 @@ def _tail_mine_local(
 
         def step(acc, xs):
             b_chunk, wd_chunk = xs
-            # int8 membership: values bounded by k-1 << 127, and the
-            # [t_c, p_cap] intermediate's HBM traffic bounds the phase.
+            # int8 membership: values bounded by k-1 <= k0+l_max-1, and
+            # the [t_c, p_cap] intermediate's HBM traffic bounds the
+            # phase.  A tail reaching depth >= 129 widens to int32
+            # rather than wrapping (ADVICE r5 #1); the static bound is
+            # known at build time, so shallow tails pay nothing.
+            member_dt = (
+                jnp.int32 if k0 + l_max - 1 >= 128 else jnp.int8
+            )
             member = lax.dot_general(
                 b_chunk, s_p, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.int8,
+                preferred_element_type=member_dt,
             )  # [t_c, p_cap]
-            common = (member == (k - 1).astype(jnp.int8)).astype(jnp.int8)
+            common = (member == (k - 1).astype(member_dt)).astype(jnp.int8)
             return acc + _weighted_matmul(common, b_chunk, wd_chunk, scales), None
 
         acc0 = jnp.zeros((p_cap, f), dtype=jnp.int32)

@@ -32,9 +32,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fastapriori_tpu import compat
 
 from fastapriori_tpu.ops import count as count_ops
+from fastapriori_tpu.reliability import failpoints, ledger, retry
 
 AXIS = "txn"
 CAND = "cand"
+
+# FA_NO_PALLAS is a kill switch for the production hot path, so its
+# spelling is STRICT: a typo ("of", "fasle") used to silently disable
+# the Pallas kernel for the whole run (ADVICE r5 #4) — now it is an
+# InputError at the first dispatch.
+_PALLAS_ENV_FALSY = ("", "0", "false", "no")
+_PALLAS_ENV_TRUTHY = ("1", "true", "yes", "on")
+
+
+def pallas_disabled_by_env() -> bool:
+    """Strictly parsed ``FA_NO_PALLAS``: True = the Pallas level kernel
+    is disabled.  Unrecognized spellings raise
+    :class:`~fastapriori_tpu.errors.InputError` instead of silently
+    degrading the hot path."""
+    raw = os.environ.get("FA_NO_PALLAS", "")
+    val = raw.strip().lower()
+    if val in _PALLAS_ENV_FALSY:
+        return False
+    if val in _PALLAS_ENV_TRUTHY:
+        return True
+    from fastapriori_tpu.errors import InputError
+
+    raise InputError(
+        f"unrecognized FA_NO_PALLAS value {raw!r}: use one of "
+        f"{'/'.join(_PALLAS_ENV_TRUTHY)} to disable the Pallas level "
+        f"kernel, {'/'.join(p for p in _PALLAS_ENV_FALSY if p)} (or "
+        "unset) to keep it"
+    )
 
 
 def initialize_distributed(**kwargs) -> None:
@@ -49,6 +78,7 @@ def allgather_bytes(blob: bytes) -> list:
     tables (item counts, shard sizes) — the analog of the reference's
     collect-to-driver for C3 (FastApriori.scala:58); the BULK data (the
     basket shards) never crosses hosts.  Single-process: [blob]."""
+    failpoints.fire("allgather")
     if jax.process_count() == 1:
         return [blob]
     from jax.experimental import multihost_utils
@@ -281,8 +311,8 @@ class DeviceContext:
         which must be deduplicated, not concatenated) stays in one
         place."""
         if jax.process_count() == 1:
-            # lint: fetch-site -- local_rows IS the host-materialization API
-            return np.asarray(arr)
+            # lint: fetch-site -- local_rows IS the host-materialization API, retry-wrapped
+            return retry.fetch(lambda: np.asarray(arr), "local_rows")
         seen = {}
         for s in arr.addressable_shards:
             start = s.index[0].start or 0
@@ -334,6 +364,14 @@ class DeviceContext:
         """Jitted whole-loop mining program (ops/fused.py), cached per
         static configuration.  ``packed_input=False`` = the variant fed
         by the level engine's resident unpacked bitmap."""
+        if not fast_f32 and l_max >= 128:
+            # The fused kernel widens its membership accumulator to
+            # int32 past int8's exactness bound (ops/fused.py
+            # contains_prefix) — a real HBM-traffic degradation worth a
+            # ledger entry.
+            ledger.record(
+                "int8_widen", once_key="fused", site="fused", l_max=l_max
+            )
         key = (
             "fused", m_cap, l_max, n_digits, n_chunks, fast_f32,
             packed_input,
@@ -359,6 +397,14 @@ class DeviceContext:
     ):
         """Jitted shallow-tail program (ops/fused.py make_tail_miner),
         cached per static configuration (one compile per seed depth)."""
+        if k0 + l_max - 1 >= 128:
+            # Same widen as the fused engine, reached when the SEED depth
+            # plus tail depth crosses int8's bound (ops/fused.py
+            # _tail_mine_local).
+            ledger.record(
+                "int8_widen", once_key="tail", site="tail", k0=k0,
+                l_max=l_max,
+            )
         key = (
             "tail", tuple(scales), k0, m_cap, p_cap, l_max, n_chunks,
             has_heavy,
@@ -511,8 +557,8 @@ class DeviceContext:
         if has_heavy:
             args += [heavy_b, heavy_w]
         packed, counts_dev = self._fns[key](*args)
-        # lint: fetch-site -- the pair phase's ONE audited fetch (packed 2cap+2 ints)
-        out = np.asarray(packed)
+        # lint: fetch-site -- the pair phase's ONE audited fetch (packed 2cap+2 ints), retry-wrapped
+        out = retry.fetch(lambda: np.asarray(packed), "pair")
         return (
             out[:cap],
             out[cap : 2 * cap],
@@ -599,11 +645,14 @@ class DeviceContext:
                 return jnp.concatenate([idx, cnt, n2[None]])
 
             self._fns[key] = jax.jit(_re)
-        # lint: fetch-site -- overflow-retry fetch of the re-packed survivors
-        out = np.asarray(
-            self._fns[key](
-                counts_dev, jnp.int32(min_count), jnp.int32(num_items)
-            )
+        out = retry.fetch(
+            # lint: fetch-site -- overflow-retry fetch of the re-packed survivors, retry-wrapped
+            lambda: np.asarray(
+                self._fns[key](
+                    counts_dev, jnp.int32(min_count), jnp.int32(num_items)
+                )
+            ),
+            "pair_regather",
         )
         return out[:cap], out[cap : 2 * cap], int(out[2 * cap])
 
@@ -630,6 +679,18 @@ class DeviceContext:
         survivor bitmask is the only host-bound output (fetch C/8 bytes,
         not 4C); counts stay resident for :meth:`gather_level_counts`."""
         has_heavy = heavy_b is not None
+        # int8 membership accumulation is exact only for prefix widths
+        # k1 <= 127 (ops/count.py local_level_gather); deeper levels
+        # widen to int32 instead of silently miscounting (ADVICE r5 #1).
+        wide_member = not fast_f32 and k1 >= 128
+        if wide_member:
+            ledger.record(
+                "int8_widen", once_key="level", site="level", k1=int(k1)
+            )
+        # Strict FA_NO_PALLAS parse runs on EVERY backend: a typo'd
+        # value must fail loudly even on runs where Pallas was never a
+        # candidate.
+        no_pallas_env = pallas_disabled_by_env()
         # Fused Pallas path (TPU only): the [tc, P] membership
         # intermediate stays in VMEM tile-by-tile instead of round-
         # tripping HBM — the measured bound of the level phase.  Tiles
@@ -639,32 +700,40 @@ class DeviceContext:
         if (
             self.platform == "tpu"
             and not fast_f32
+            and not wide_member  # no Pallas path for the int32 widen
             and tuple(scales) == (1,)  # kernel takes ONE unscaled w ⊙ B
-            # Any set value disables EXCEPT explicit falsy spellings
-            # (so both FA_NO_PALLAS=on and FA_NO_PALLAS=0 mean what
-            # their author intended).
-            and os.environ.get("FA_NO_PALLAS", "").lower()
-            in ("", "0", "false", "no")
         ):
-            from fastapriori_tpu.ops.pallas_level import pick_tile
+            if no_pallas_env:
+                # The run IS degraded (the XLA fallback round-trips the
+                # membership intermediate through HBM) — say so once.
+                ledger.record(
+                    "pallas_disabled",
+                    once_key="env",
+                    reason="FA_NO_PALLAS",
+                    value=os.environ.get("FA_NO_PALLAS", ""),
+                )
+            else:
+                from fastapriori_tpu.ops.pallas_level import pick_tile
 
-            # t generous (B tiles are cheap: [tt, F] int8), m bounded so
-            # the in-VMEM [mt, tt] membership tile stays <= 16 MB.
-            tt = pick_tile(bitmap.shape[0] // self.txn_shards)
-            mt = pick_tile(
-                prefix_stack.shape[1] // self.cand_shards,
-                candidates=(1024, 512, 256),
-            )
-            if tt and mt:
-                pallas_tiles = (tt, mt)
+                # t generous (B tiles are cheap: [tt, F] int8), m
+                # bounded so the in-VMEM [mt, tt] membership tile stays
+                # <= 16 MB.
+                tt = pick_tile(bitmap.shape[0] // self.txn_shards)
+                mt = pick_tile(
+                    prefix_stack.shape[1] // self.cand_shards,
+                    candidates=(1024, 512, 256),
+                )
+                if tt and mt:
+                    pallas_tiles = (tt, mt)
         key = (
             "level_gather_batch", tuple(scales), n_chunks, fast_f32,
-            has_heavy, pallas_tiles,
+            has_heavy, pallas_tiles, wide_member,
         )
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
             p_tiles = pallas_tiles
+            wide = wide_member
 
             def _local(bitmap, w_digits, ps, k1, mc, cs, *hv):
                 hb, hw = hv if hv else (None, None)
@@ -674,6 +743,7 @@ class DeviceContext:
                     axis_name=AXIS, cand_axis_name=CAND,
                     fast_f32=fast_f32,
                     pallas_tiles=p_tiles,
+                    wide_member=wide,
                 )
                 return count_ops.keep_bits(counts, mc), counts
 
@@ -721,15 +791,19 @@ class DeviceContext:
             tuple(jnp.asarray(p.astype(np.int32)) for _, p in pending),
         )
         if u24:
-            # lint: fetch-site -- audited end-of-mine fetch, 3-byte planes (u24 gate)
-            planes = np.asarray(_gather_counts_u24_jit(*args))
+            planes = retry.fetch(
+                # lint: fetch-site -- audited end-of-mine fetch, 3-byte planes (u24 gate), retry-wrapped
+                lambda: np.asarray(_gather_counts_u24_jit(*args)), "counts"
+            )
             return (
                 planes[0].astype(np.int64)
                 | (planes[1].astype(np.int64) << 8)
                 | (planes[2].astype(np.int64) << 16)
             )
-        # lint: fetch-site -- audited end-of-mine fetch of survivor counts
-        return np.asarray(_gather_counts_jit(*args)).astype(np.int64)
+        return retry.fetch(
+            # lint: fetch-site -- audited end-of-mine fetch of survivor counts, retry-wrapped
+            lambda: np.asarray(_gather_counts_jit(*args)), "counts"
+        ).astype(np.int64)
 
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
         pair, _, _ = self._get_fns(tuple(scales))

@@ -1,0 +1,23 @@
+"""Reliability layer: deterministic fault injection, bounded retries,
+and the degradation ledger.
+
+The reference inherited all of this from Spark (RDD lineage re-execution,
+task retries, loud executor loss); the JAX port replaced that substrate
+with raw ``shard_map``/``psum`` and had nothing — a crash anywhere in a
+multi-hour mine lost everything, and every graceful fallback degraded
+silently.  Three cooperating parts (each module documents its own
+contract):
+
+- :mod:`~fastapriori_tpu.reliability.failpoints` — named injection sites
+  (``FA_FAILPOINTS``) so every failure path is testable on CPU;
+- :mod:`~fastapriori_tpu.reliability.retry` — transient/fatal/user error
+  classification + bounded backoff around device fetches and fs ops;
+- :mod:`~fastapriori_tpu.reliability.ledger` — structured, warn-once
+  degradation events into the metrics/bench record.
+
+Crash-safe *persistence* (atomic writes, the per-run ``MANIFEST.json``,
+mid-mine checkpoints) lives with the artifact formats in
+``fastapriori_tpu/io/``; it consumes this package's failpoints and
+ledger."""
+
+from fastapriori_tpu.reliability import failpoints, ledger, retry  # noqa: F401

@@ -1,0 +1,101 @@
+"""Degradation ledger: every silent fallback becomes a structured event.
+
+ADVICE r5 catalogued the failure mode this fixes: the engine already
+degrades gracefully in half a dozen places — fused→level-by-level
+fallback, the Pallas kernel disabled by ``FA_NO_PALLAS``, pair-cap
+overflow retries, int8→int32 accumulation widening — but gracefully AND
+silently, so a degraded run is indistinguishable from a slow one in
+``BENCH_*.json``.  Every such fallback now calls :func:`record`; the
+event lands in three places:
+
+- the in-memory ledger (``snapshot()``/``summary()`` — bench.py attaches
+  it to the round record);
+- the active :class:`~fastapriori_tpu.utils.logging.MetricsLogger` as an
+  ``event="degraded"`` JSON line (so ``--metrics`` streams show the
+  degradation inline with the phase it degraded);
+- stderr, once per ``(kind, once_key)`` — a human skimming a log sees
+  each distinct degradation exactly once, not 400 widening lines.
+
+The module-level ledger is deliberately a process singleton: the sites
+that degrade (``parallel/mesh.py``, ``ops`` dispatch points) have no
+config or logger in scope, and threading one through every kernel-cache
+layer for an observability side channel would be the tail wagging the
+dog.  Tests ``reset()`` around assertions.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+
+class DegradationLedger:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._warned: set = set()
+        self._metrics = None  # active MetricsLogger (latest attach wins)
+
+    def attach_metrics(self, metrics) -> None:
+        """Forward future events to ``metrics.emit("degraded", ...)``."""
+        self._metrics = metrics
+
+    def record(
+        self, kind: str, once_key: Optional[str] = None, **fields: Any
+    ) -> None:
+        event = {"kind": kind, **fields}
+        warn_key = (kind, once_key if once_key is not None else kind)
+        with self._lock:
+            self._events.append(event)
+            first = warn_key not in self._warned
+            if first:
+                self._warned.add(warn_key)
+            metrics = self._metrics
+        if metrics is not None:
+            metrics.emit("degraded", **event)
+        if first:
+            detail = " ".join(f"{k}={v}" for k, v in fields.items())
+            print(
+                f"fastapriori: degraded: {kind}"
+                + (f" ({detail})" if detail else ""),
+                file=sys.stderr,
+            )
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def summary(self) -> Dict[str, int]:
+        """Event counts by kind — the compact form bench records carry."""
+        with self._lock:
+            return dict(Counter(e["kind"] for e in self._events))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._warned.clear()
+
+
+LEDGER = DegradationLedger()
+
+
+def record(kind: str, once_key: Optional[str] = None, **fields: Any) -> None:
+    LEDGER.record(kind, once_key=once_key, **fields)
+
+
+def attach_metrics(metrics) -> None:
+    LEDGER.attach_metrics(metrics)
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    return LEDGER.snapshot()
+
+
+def summary() -> Dict[str, int]:
+    return LEDGER.summary()
+
+
+def reset() -> None:
+    LEDGER.reset()

@@ -1,0 +1,165 @@
+"""Bounded retry with transient/fatal/user error classification.
+
+The reference got retries from Spark's task scheduler (a failed task is
+re-executed up to ``spark.task.maxFailures`` times on another executor);
+the JAX port's device fetches and filesystem ops had no second chance —
+VERDICT r5 measured a device link collapsing to 3.7 MB/s mid-run, the
+kind of flap that surfaces as a one-off ``RESOURCE_EXHAUSTED`` or
+``UNAVAILABLE`` and deserves a retry, not a dead multi-hour mine.
+
+Classification contract:
+
+- **user**: :class:`~fastapriori_tpu.errors.InputError` and
+  FileNotFoundError — the user can fix it; retrying is noise.
+- **transient**: XLA runtime errors whose status says the resource may
+  come back (``RESOURCE_EXHAUSTED``, ``UNAVAILABLE``, ``DEADLINE_EXCEEDED``,
+  ``ABORTED``, ``CANCELLED``, ``INTERNAL``) and the OSError errnos a flaky
+  link/filesystem produces (EIO, EAGAIN, EBUSY, ETIMEDOUT, ECONNRESET).
+- **fatal**: everything else (shape errors, INVALID_ARGUMENT, TypeError)
+  — retrying cannot change the outcome; re-raise immediately.
+
+Backoff is deterministic (exponential, no jitter): reproducibility is
+worth more here than thundering-herd protection — there is exactly one
+host per device link.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import time
+from typing import Callable, Optional, Tuple, TypeVar
+
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.reliability import failpoints
+
+T = TypeVar("T")
+
+# Canonical absl/XLA status codes that justify a retry; matched against
+# the exception MESSAGE because XlaRuntimeError carries its status only
+# as a text prefix ("RESOURCE_EXHAUSTED: ...").
+TRANSIENT_STATUS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "INTERNAL",
+)
+
+_TRANSIENT_ERRNOS = frozenset(
+    {errno.EIO, errno.EAGAIN, errno.EBUSY, errno.ETIMEDOUT, errno.ECONNRESET}
+)
+
+_xla_types: Optional[Tuple[type, ...]] = None
+
+
+def xla_runtime_error_types() -> Tuple[type, ...]:
+    """The concrete error types the XLA runtime (and the fused-OOM probe)
+    can raise — importable lazily so stdlib-only callers never pay a jax
+    import.  Falls back to ``(RuntimeError,)``: XlaRuntimeError subclasses
+    it in every pinned jaxlib."""
+    global _xla_types
+    if _xla_types is None:
+        types: list = []
+        try:
+            from jax.errors import JaxRuntimeError
+
+            types.append(JaxRuntimeError)
+        except (ImportError, AttributeError):
+            pass
+        try:
+            from jax._src.lib import xla_client
+
+            types.append(xla_client.XlaRuntimeError)
+        except (ImportError, AttributeError):
+            pass
+        if not types:
+            types.append(RuntimeError)
+        # Dedup while preserving order (JaxRuntimeError aliases
+        # XlaRuntimeError on some versions).
+        seen: list = []
+        for t in types:
+            if t not in seen:
+                seen.append(t)
+        _xla_types = tuple(seen)
+    return _xla_types
+
+
+def classify(exc: BaseException) -> str:
+    """``"user"`` | ``"transient"`` | ``"fatal"`` (module docstring)."""
+    if isinstance(exc, (InputError, FileNotFoundError)):
+        return "user"
+    if isinstance(exc, OSError):
+        return (
+            "transient" if exc.errno in _TRANSIENT_ERRNOS else "fatal"
+        )
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        if any(code in msg for code in TRANSIENT_STATUS):
+            return "transient"
+    return "fatal"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff.  ``max_attempts`` counts the first
+    try; delays are ``base_delay_s * factor**i`` capped at
+    ``max_delay_s``."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    factor: float = 4.0
+    max_delay_s: float = 2.0
+
+    def delay(self, attempt: int) -> float:
+        return min(
+            self.base_delay_s * (self.factor ** attempt), self.max_delay_s
+        )
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def call_with_retries(
+    thunk: Callable[[], T],
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run ``thunk`` with the site's failpoint armed inside the retried
+    body (so an ``oom*1`` spec is a genuine transient: fails once, passes
+    on retry).  Transient errors back off and retry up to the policy
+    bound, recording each retry in the degradation ledger; user/fatal
+    errors — and exhaustion — re-raise unchanged."""
+    from fastapriori_tpu.reliability import ledger
+
+    policy = policy or DEFAULT_POLICY
+    attempt = 0
+    while True:
+        try:
+            failpoints.fire(site)
+            return thunk()
+        except Exception as exc:
+            kind = classify(exc)
+            if kind != "transient" or attempt >= policy.max_attempts - 1:
+                raise
+            ledger.record(
+                "retry",
+                site=site,
+                attempt=attempt + 1,
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
+            sleep(policy.delay(attempt))
+            attempt += 1
+
+
+def fetch(
+    thunk: Callable[[], T],
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+) -> T:
+    """Audited device->host fetch wrapper: failpoint-instrumented and
+    retry-wrapped under ``fetch.<site>``.  The thunk must be re-runnable
+    (a pure host materialization of an already-computed device array)."""
+    return call_with_retries(thunk, "fetch." + site, policy)

@@ -40,6 +40,9 @@ def enable_compile_cache() -> bool:
         # kernels that dominate a cold mining run's compile budget.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         return primed
-    # lint: waive G006 -- cache priming is purely an optimization
-    except Exception:  # noqa: BLE001 - purely an optimization
+    except (OSError, ImportError, AttributeError, ValueError, RuntimeError):
+        # Cache priming is purely an optimization: an unwritable dir
+        # (OSError), a jax version without these config names
+        # (AttributeError/ValueError), or a config locked after backend
+        # init (RuntimeError) all mean "run uncached", never "fail".
         return False

@@ -34,6 +34,18 @@ class MetricsLogger:
         if self.enabled:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def bind_global_ledger(self) -> "MetricsLogger":
+        """Route degradation-ledger events (reliability/ledger.py) through
+        this logger as ``event="degraded"`` records, so a degraded run is
+        visibly degraded in the metrics stream and in every bench record
+        built from it — not just mysteriously slower.  Latest binding
+        wins (the ledger is a process singleton; the mining sites it
+        instruments have no logger in scope)."""
+        from fastapriori_tpu.reliability import ledger
+
+        ledger.attach_metrics(self)
+        return self
+
     @contextlib.contextmanager
     def timed(self, event: str, **fields: Any):
         t0 = time.perf_counter()

@@ -48,9 +48,17 @@ CASES = [
      "import numpy as np\n"
      "def fetch(arr):\n"
      "    return np.asarray(arr)\n"),
+    ("G001", "flag", "pkg/models/apriori.py",
+     "import numpy as np\n"
+     "def fetch(arr):\n"
+     "    return np.asarray(arr)\n"),  # engine layer is audited too
     ("G001", "pass", "pkg/mod.py",
      "def g(x):\n"
      "    return x.item()\n"),  # not traced, not the mesh layer
+    ("G001", "pass", "pkg/models/recommender.py",
+     "import numpy as np\n"
+     "def fetch(arr):\n"
+     "    return np.asarray(arr)\n"),  # engine audit covers apriori only
     ("G001", "pass", "pkg/parallel/m.py",
      "import numpy as np\n"
      "def host_table():\n"
@@ -228,6 +236,37 @@ CASES = [
     ("G008", "waived", "pkg/mod.py",
      "# TODO make this faster  lint: waive G008\n"
      "X = 1\n"),
+    # -- G009: artifact writes must use the atomic writer --------------
+    ("G009", "flag", "pkg/io/w.py",
+     "def save(path, lines):\n"
+     "    with open(path, 'w') as f:\n"
+     "        f.writelines(lines)\n"),
+    ("G009", "flag", "pkg/io/w.py",
+     "def save(path, lines):\n"
+     "    with open(path, mode='wb') as f:\n"
+     "        f.write(lines)\n"),
+    ("G009", "flag", "pkg/io/w.py",
+     "from fastapriori_tpu.io.writer import open_write\n"
+     "def save(path, lines):\n"
+     "    with open_write(path) as f:\n"
+     "        f.writelines(lines)\n"),
+    ("G009", "pass", "pkg/io/w.py",
+     "def load(path):\n"
+     "    with open(path, 'rb') as f:\n"
+     "        return f.read()\n"),
+    ("G009", "pass", "pkg/io/w.py",
+     "def load(path):\n"
+     "    with open(path) as f:\n"
+     "        return f.read()\n"),
+    ("G009", "pass", "tests/test_w.py",
+     "def fixture(path):\n"
+     "    with open(path, 'w') as f:\n"
+     "        f.write('1 2 3')\n"),  # test fixtures are exempt
+    ("G009", "waived", "pkg/io/w.py",
+     "def save(path, lines):\n"
+     "    # lint: waive G009 -- test waiver\n"
+     "    with open(path, 'w') as f:\n"
+     "        f.writelines(lines)\n"),
 ]
 
 
@@ -261,7 +300,7 @@ def test_every_rule_has_all_three_case_kinds():
 
 def test_all_rules_registered_and_distinct():
     ids = [r.id for r in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 8
+    assert len(ids) == len(set(ids)) == 9
     assert all(hasattr(r, "name") and r.name for r in ALL_RULES)
 
 

@@ -1,0 +1,664 @@
+"""Reliability layer tests: failpoints, retry classification, the
+degradation ledger, atomic writes + manifest validation, and the
+kill→resume round trip (interrupt after level k, resume, bit-exact
+output vs an uninterrupted run).
+
+Every failure here is injected deterministically through
+``fastapriori_tpu.reliability.failpoints`` — no real hardware faults,
+no subprocess kills, CPU-only."""
+
+import errno
+import json
+import os
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.io import checkpoint as ckpt
+from fastapriori_tpu.io import resume as resume_io
+from fastapriori_tpu.io import writer
+from fastapriori_tpu.io.reader import tokenize_line
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.reliability import failpoints, ledger, retry
+from fastapriori_tpu.utils.logging import MetricsLogger
+
+
+@pytest.fixture(autouse=True)
+def _clean_reliability_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+
+
+# ---------------------------------------------------------------------------
+# failpoints
+
+
+def test_failpoint_spec_parsing():
+    specs = failpoints.parse_spec(
+        "fetch.pair:oom*1,write.freqItems:truncate@17,x.y:delay@5"
+    )
+    assert specs["fetch.pair"].kind == "oom"
+    assert specs["fetch.pair"].remaining == 1
+    assert specs["write.freqItems"].arg == 17
+    assert specs["x.y"].kind == "delay"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nocolon",
+        "site:unknownkind",
+        "site:oom*notanint",
+        "site:truncate@NaN",
+        "site:truncate",  # arg required
+        "site:delay",  # arg required
+    ],
+)
+def test_failpoint_malformed_specs_raise(bad):
+    with pytest.raises(InputError):
+        failpoints.parse_spec(bad)
+
+
+def test_failpoint_oom_fires_then_exhausts():
+    failpoints.arm("fetch.test", "oom*2")
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            failpoints.fire("fetch.test")
+    failpoints.fire("fetch.test")  # exhausted: no-op
+
+
+def test_failpoint_kinds():
+    failpoints.arm("a", "io")
+    with pytest.raises(OSError):
+        failpoints.fire("a")
+    failpoints.arm("b", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        failpoints.fire("b")
+    # abort is a BaseException: no `except Exception` path can eat it.
+    assert not issubclass(failpoints.InjectedAbort, Exception)
+    failpoints.fire("unarmed.site")  # no-op
+
+
+def test_failpoint_env_reload(monkeypatch):
+    monkeypatch.setenv("FA_FAILPOINTS", "x.y:io*1")
+    failpoints.reload_from_env()
+    assert failpoints.active() == {"x.y": "io"}
+    monkeypatch.delenv("FA_FAILPOINTS")
+    failpoints.reload_from_env()
+    assert failpoints.active() == {}
+
+
+# ---------------------------------------------------------------------------
+# retry classification + policy
+
+
+def test_classify():
+    assert retry.classify(InputError("x")) == "user"
+    assert retry.classify(FileNotFoundError(2, "x")) == "user"
+    assert retry.classify(OSError(errno.EIO, "flaky")) == "transient"
+    assert retry.classify(OSError(errno.EPERM, "denied")) == "fatal"
+    assert retry.classify(RuntimeError("RESOURCE_EXHAUSTED: oom")) == (
+        "transient"
+    )
+    assert retry.classify(RuntimeError("UNAVAILABLE: link down")) == (
+        "transient"
+    )
+    assert retry.classify(RuntimeError("INVALID_ARGUMENT: shape")) == "fatal"
+    assert retry.classify(ValueError("nope")) == "fatal"
+
+
+def test_retry_absorbs_transient_and_records():
+    failpoints.arm("fetch.t", "oom*1")
+    calls = []
+    out = retry.call_with_retries(
+        lambda: calls.append(1) or 42, "fetch.t", sleep=lambda s: None
+    )
+    assert out == 42 and calls == [1]
+    kinds = [e["kind"] for e in ledger.snapshot()]
+    assert kinds == ["retry"]
+    assert ledger.snapshot()[0]["site"] == "fetch.t"
+
+
+def test_retry_gives_up_after_policy_bound():
+    failpoints.arm("fetch.t", "oom")  # unlimited
+    policy = retry.RetryPolicy(max_attempts=3, base_delay_s=0.0)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        retry.call_with_retries(
+            lambda: 1, "fetch.t", policy=policy, sleep=lambda s: None
+        )
+    assert len(ledger.snapshot()) == 2  # attempts 1 and 2 retried
+
+
+def test_retry_fatal_and_user_not_retried():
+    failpoints.arm("w.t", "io")  # EIO-less OSError -> errno None -> fatal
+    with pytest.raises(OSError):
+        retry.call_with_retries(lambda: 1, "w.t", sleep=lambda s: None)
+    assert ledger.snapshot() == []
+
+    def bad():
+        raise InputError("user problem")
+
+    with pytest.raises(InputError):
+        retry.call_with_retries(bad, "other.site", sleep=lambda s: None)
+    assert ledger.snapshot() == []
+
+
+def test_retry_backoff_is_bounded():
+    p = retry.RetryPolicy(
+        max_attempts=5, base_delay_s=0.1, factor=4.0, max_delay_s=0.5
+    )
+    assert [p.delay(i) for i in range(4)] == [0.1, 0.4, 0.5, 0.5]
+
+
+# ---------------------------------------------------------------------------
+# ledger
+
+
+def test_ledger_records_and_forwards_to_metrics():
+    m = MetricsLogger(enabled=False).bind_global_ledger()
+    ledger.record("pallas_disabled", reason="FA_NO_PALLAS", value="1")
+    ledger.record("pallas_disabled", reason="FA_NO_PALLAS", value="1")
+    assert ledger.summary() == {"pallas_disabled": 2}
+    degraded = [r for r in m.records if r["event"] == "degraded"]
+    assert len(degraded) == 2
+    assert degraded[0]["kind"] == "pallas_disabled"
+
+
+def test_ledger_warns_once_per_key(capsys):
+    ledger.record("int8_widen", once_key="level", k1=130)
+    ledger.record("int8_widen", once_key="level", k1=131)
+    ledger.record("int8_widen", once_key="tail", k0=120, l_max=10)
+    err = capsys.readouterr().err
+    assert err.count("degraded: int8_widen") == 2  # once per key
+
+
+# ---------------------------------------------------------------------------
+# atomic writes + manifest
+
+
+def test_write_artifact_atomic_and_manifest(tmp_path):
+    path = str(tmp_path / "out" / "freqItemset")
+    manifest = {}
+    writer.write_artifact(path, ["a\n", "b\n"], "freqItemset", manifest)
+    assert open(path).read() == "a\nb\n"
+    assert not os.path.exists(path + ".tmp")
+    ent = manifest["freqItemset"]
+    assert ent["bytes"] == 4
+    resume_io.validate_artifact_bytes(
+        str(tmp_path / "out") + "/", "freqItemset", b"a\nb\n", manifest
+    )
+
+
+def test_write_artifact_injected_io_error_leaves_no_torn_file(tmp_path):
+    path = str(tmp_path / "freqItemset")
+    failpoints.arm("write.freqItemset", "io")
+    with pytest.raises(OSError):
+        writer.write_artifact(path, ["a\n"], "freqItemset")
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_truncated_artifact_rejected_by_manifest(tmp_path):
+    prefix = str(tmp_path) + "/"
+    failpoints.arm("write.freqItems", "truncate@7")
+    resume_io.save_phase1(
+        prefix,
+        [(frozenset([0, 1]), 10), (frozenset([1, 2]), 9)],
+        ["a", "b", "c"],
+        {"a": 0, "b": 1, "c": 2},
+    )
+    # Physical file is truncated; the manifest records full content.
+    assert os.path.getsize(prefix + "freqItems") == 7
+    manifest = resume_io.load_manifest(prefix)
+    assert manifest["freqItems"]["bytes"] > 7
+    with pytest.raises(InputError) as ei:
+        resume_io.load_phase1(prefix)
+    assert "freqItems" in str(ei.value)
+    assert "manifest" in str(ei.value).lower()
+
+
+def test_phase1_round_trip_with_manifest(tmp_path):
+    prefix = str(tmp_path) + "/"
+    itemsets = [(frozenset([0, 1]), 7), (frozenset([2]), 5)]
+    items = ["a", "b", "c"]
+    ranks = {"a": 0, "b": 1, "c": 2}
+    resume_io.save_phase1(prefix, itemsets, items, ranks)
+    assert os.path.exists(prefix + "MANIFEST.json")
+    got_sets, got_ranks, got_items = resume_io.load_phase1(prefix)
+    assert sorted(got_sets) == sorted(itemsets)
+    assert got_ranks == ranks and got_items == items
+    # Corrupt one byte -> checksum mismatch names the file.
+    with open(prefix + "FreqItems", "r+b") as f:
+        f.write(b"Z")
+    with pytest.raises(InputError, match="FreqItems"):
+        resume_io.load_phase1(prefix)
+
+
+def test_corrupt_manifest_is_loud(tmp_path):
+    prefix = str(tmp_path) + "/"
+    resume_io.save_phase1(prefix, [], ["a"], {"a": 0})
+    for corrupt in ("{not json", "[]", '"str"', '{"artifacts": 3}'):
+        with open(prefix + "MANIFEST.json", "w") as f:
+            f.write(corrupt)
+        with pytest.raises(InputError, match="MANIFEST"):
+            resume_io.load_phase1(prefix)
+
+
+def test_missing_manifest_skips_validation(tmp_path):
+    prefix = str(tmp_path) + "/"
+    resume_io.save_phase1(prefix, [(frozenset([0]), 3)], ["a"], {"a": 0})
+    os.unlink(prefix + "MANIFEST.json")
+    got_sets, _, _ = resume_io.load_phase1(prefix)
+    assert got_sets == [(frozenset([0]), 3)]
+
+
+# ---------------------------------------------------------------------------
+# checkpoints
+
+
+def _meta(n_raw=100, min_count=5, num_items=7):
+    return {"n_raw": n_raw, "min_count": min_count, "num_items": num_items}
+
+
+def test_checkpoint_round_trip(tmp_path):
+    prefix = str(tmp_path) + "/"
+    levels = [
+        (np.array([[0, 1], [0, 2]], np.int32), np.array([9, 8], np.int64)),
+        (np.array([[0, 1, 2]], np.int32), np.array([7], np.int64)),
+    ]
+    ckpt.save_checkpoint(prefix, levels, _meta())
+    assert ckpt.checkpoint_available(prefix)
+    got, meta = ckpt.load_checkpoint(prefix)
+    assert meta == _meta()
+    for (m0, c0), (m1, c1) in zip(levels, got):
+        np.testing.assert_array_equal(m0, m1)
+        np.testing.assert_array_equal(c0, c1)
+
+
+def test_checkpoint_truncation_rejected(tmp_path):
+    prefix = str(tmp_path) + "/"
+    levels = [
+        (np.array([[0, 1]], np.int32), np.array([9], np.int64)),
+    ]
+    failpoints.arm("write.checkpoint.npz", "truncate@40")
+    ckpt.save_checkpoint(prefix, levels, _meta())
+    with pytest.raises(InputError, match="checkpoint.npz"):
+        ckpt.load_checkpoint(prefix)
+
+
+def test_checkpoint_survives_stale_manifest_crash_window(tmp_path):
+    """A crash between the atomic checkpoint replace and the manifest
+    rewrite leaves level k's npz described by level k-1's manifest
+    entry; resume must shrug (ledger event) and load the structurally
+    valid checkpoint, not wedge the whole mine."""
+    prefix = str(tmp_path) + "/"
+    lv2 = [(np.array([[0, 1]], np.int32), np.array([9], np.int64))]
+    lv3 = lv2 + [
+        (np.array([[0, 1, 2]], np.int32), np.array([7], np.int64))
+    ]
+    ckpt.save_checkpoint(prefix, lv2, _meta())
+    stale_manifest = open(prefix + "MANIFEST.json", "rb").read()
+    ckpt.save_checkpoint(prefix, lv3, _meta())
+    # Simulate the crash window: new checkpoint, old manifest.
+    with open(prefix + "MANIFEST.json", "wb") as f:
+        f.write(stale_manifest)
+    levels, meta = ckpt.load_checkpoint(prefix)
+    assert len(levels) == 2 and meta == _meta()
+    assert any(
+        e["kind"] == "checkpoint_manifest_stale" for e in ledger.snapshot()
+    )
+
+
+def test_write_manifest_merges_on_remote_prefix(tmp_path):
+    fsspec = pytest.importorskip("fsspec")
+    prefix = "memory://fa_manifest_test/"
+    writer.write_manifest(prefix, {"freqItemset": {"bytes": 3, "sha256": "x"}})
+    writer.write_manifest(prefix, {"recommends": {"bytes": 5, "sha256": "y"}})
+    arts = resume_io.load_manifest(prefix)
+    assert set(arts) == {"freqItemset", "recommends"}
+
+
+def test_checkpoint_meta_mismatch_rejected(tmp_path):
+    prefix = str(tmp_path) + "/"
+    levels = [(np.array([[0, 1]], np.int32), np.array([9], np.int64))]
+    ckpt.save_checkpoint(prefix, levels, _meta())
+    _, meta = ckpt.load_checkpoint(prefix)
+    with pytest.raises(InputError, match="different data/support"):
+        ckpt.check_meta(
+            meta, n_raw=101, min_count=5, num_items=7, prefix=prefix
+        )
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+
+
+def _mine_config(**kw):
+    return MinerConfig(min_support=0.08, engine="level", **kw)
+
+
+def _dataset():
+    return [tokenize_line(l) for l in random_dataset(7, n_txns=120)]
+
+
+def test_transient_fetch_failure_is_retried_and_run_succeeds():
+    """Acceptance: an injected transient fetch failure is retried and the
+    mine still succeeds, with the degradation recorded."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.pair", "oom*1")
+    miner = FastApriori(config=_mine_config())
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    retries = [e for e in ledger.snapshot() if e["kind"] == "retry"]
+    assert retries and retries[0]["site"] == "fetch.pair"
+    # The degradation also reached the miner's metrics record stream.
+    assert any(r.get("event") == "degraded" for r in miner.metrics.records)
+
+
+def test_injected_oom_without_retry_budget_still_fails():
+    failpoints.arm("fetch.pair", "oom")  # every attempt
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        FastApriori(config=_mine_config()).run(_dataset())
+
+
+def test_kill_resume_round_trip_bit_exact(tmp_path):
+    """Acceptance: interrupt after a completed level (failpoint abort),
+    resume from the checkpoint, byte-identical freqItems output vs an
+    uninterrupted run."""
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+
+    clean_sets, _, clean_items = FastApriori(config=_mine_config()).run(txns)
+
+    failpoints.arm("level.3", "abort")  # die right after level 3 commits
+    miner = FastApriori(
+        config=_mine_config(checkpoint_prefix=prefix)
+    )
+    with pytest.raises(failpoints.InjectedAbort):
+        miner.run(txns)
+    failpoints.disarm_all()
+
+    levels, meta = ckpt.load_checkpoint(prefix)
+    assert levels[-1][0].shape[1] == 3  # deepest completed level
+    resumed = FastApriori(config=_mine_config())
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    got_sets, _, got_items = resumed.run(txns)
+    assert got_items == clean_items
+    assert sorted(got_sets) == sorted(clean_sets)
+    # The writer output (the real artifact) is byte-identical.
+    out_a, out_b = str(tmp_path / "a_"), str(tmp_path / "b_")
+    writer.save_freq_itemsets(out_a, clean_sets, clean_items)
+    writer.save_freq_itemsets(out_b, got_sets, got_items)
+    assert (
+        open(out_a + "freqItemset", "rb").read()
+        == open(out_b + "freqItemset", "rb").read()
+    )
+
+
+def test_resume_levels_are_one_shot(tmp_path):
+    """A later mine() on the same instance must NOT re-graft the stale
+    checkpoint lattice (check_meta pins only three ints)."""
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+    failpoints.arm("level.3", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        FastApriori(config=_mine_config(checkpoint_prefix=prefix)).run(txns)
+    failpoints.disarm_all()
+    levels, meta = ckpt.load_checkpoint(prefix)
+    resumed = FastApriori(config=_mine_config())
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    first = resumed.run(txns)[0]
+    assert resumed._resume_levels is None  # consumed
+    second = resumed.run(txns)[0]  # a fresh, full mine
+    assert sorted(first) == sorted(second)
+
+
+def test_resume_meta_mismatch_is_input_error(tmp_path):
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+    failpoints.arm("level.2", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        FastApriori(config=_mine_config(checkpoint_prefix=prefix)).run(txns)
+    failpoints.disarm_all()
+    levels, meta = ckpt.load_checkpoint(prefix)
+    resumed = FastApriori(config=_mine_config())
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    with pytest.raises(InputError, match="different data/support"):
+        resumed.run(txns[: len(txns) // 2])  # different dataset
+
+
+def test_checkpoint_written_every_level(tmp_path):
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+    events = FastApriori(
+        config=_mine_config(checkpoint_prefix=prefix)
+    )
+    events.run(txns)
+    recs = [r for r in events.metrics.records if r["event"] == "checkpoint"]
+    assert len(recs) >= 2  # level 2 plus at least one deeper level
+    assert ckpt.checkpoint_available(prefix)
+    levels, _ = ckpt.load_checkpoint(prefix)
+    assert levels[0][0].shape[1] == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI round trip
+
+
+def _write_inputs(tmp_path, d_raw, u_raw):
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "D.dat").write_text(
+        "".join(l + "\n" for l in d_raw)
+    )
+    (tmp_path / "in" / "U.dat").write_text(
+        "".join(l + "\n" for l in u_raw)
+    )
+    return str(tmp_path / "in") + "/"
+
+
+def test_cli_checkpoint_kill_resume_round_trip(tmp_path):
+    from fastapriori_tpu.cli import main
+
+    d_raw = random_dataset(7, n_txns=120)
+    u_raw = random_dataset(13, n_txns=20)
+    inp = _write_inputs(tmp_path, d_raw, u_raw)
+    out_clean = str(tmp_path / "clean") + "/"
+    out_ckpt = str(tmp_path / "ckpt") + "/"
+    os.makedirs(out_clean)
+    os.makedirs(out_ckpt)
+
+    assert main([inp, out_clean, "--min-support", "0.08"]) == 0
+
+    failpoints.arm("level.3", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        main(
+            [inp, out_ckpt, "--min-support", "0.08",
+             "--checkpoint-every-level"]
+        )
+    failpoints.disarm_all()
+    assert os.path.exists(out_ckpt + "checkpoint.npz")
+    assert not os.path.exists(out_ckpt + "freqItemset")
+
+    rc = main(
+        [inp, out_ckpt, "--min-support", "0.08", "--resume-from", out_ckpt]
+    )
+    assert rc == 0
+    for name in ("freqItemset", "recommends"):
+        assert (
+            open(out_ckpt + name, "rb").read()
+            == open(out_clean + name, "rb").read()
+        )
+    manifest = json.load(open(out_ckpt + "MANIFEST.json"))
+    assert "freqItemset" in manifest["artifacts"]
+
+
+def test_cli_truncated_resume_artifact_rejected(tmp_path, capsys):
+    from fastapriori_tpu.cli import main
+
+    d_raw = random_dataset(4)
+    u_raw = random_dataset(14, n_txns=15)
+    inp = _write_inputs(tmp_path, d_raw, u_raw)
+    outp = str(tmp_path / "out") + "/"
+    os.makedirs(outp)
+    failpoints.arm("write.freqItems", "truncate@25")
+    assert main([inp, outp, "--min-support", "0.08", "--save-counts"]) == 0
+    failpoints.disarm_all()
+
+    rc = main([inp, outp, "--resume-from", outp])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "freqItems" in err and "manifest" in err.lower()
+
+
+def test_cli_torn_phase1_falls_back_to_checkpoint(tmp_path):
+    """A crash window between the freqItems write and its aux artifacts
+    must not wedge --resume-from when a valid checkpoint exists."""
+    from fastapriori_tpu.cli import main
+
+    d_raw = random_dataset(7, n_txns=120)
+    inp = _write_inputs(tmp_path, d_raw, random_dataset(17, n_txns=15))
+    out_clean = str(tmp_path / "clean") + "/"
+    outp = str(tmp_path / "out") + "/"
+    os.makedirs(out_clean)
+    os.makedirs(outp)
+    assert main([inp, out_clean, "--min-support", "0.08"]) == 0
+
+    failpoints.arm("level.3", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        main([inp, outp, "--min-support", "0.08",
+              "--checkpoint-every-level"])
+    failpoints.disarm_all()
+    # Simulate the torn phase-1 set: freqItems exists, aux files don't.
+    with open(outp + "freqItems", "w") as f:
+        f.write("a[1]\n")
+    rc = main([inp, outp, "--min-support", "0.08", "--resume-from", outp])
+    assert rc == 0
+    assert (
+        open(outp + "freqItemset", "rb").read()
+        == open(out_clean + "freqItemset", "rb").read()
+    )
+
+
+def test_cli_resume_from_nothing_is_input_error(tmp_path, capsys):
+    from fastapriori_tpu.cli import main
+
+    inp = _write_inputs(tmp_path, random_dataset(5), ["1 2"])
+    outp = str(tmp_path / "out") + "/"
+    os.makedirs(outp)
+    rc = main([inp, outp, "--resume-from", str(tmp_path / "empty") + "/"])
+    assert rc == 2
+    assert "neither" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# FA_NO_PALLAS strict parsing
+
+
+def test_fa_no_pallas_strict_values(monkeypatch):
+    from fastapriori_tpu.parallel.mesh import pallas_disabled_by_env
+
+    for v in ("", "0", "false", "no"):
+        monkeypatch.setenv("FA_NO_PALLAS", v)
+        assert pallas_disabled_by_env() is False
+    for v in ("1", "true", "yes", "on", " ON "):
+        monkeypatch.setenv("FA_NO_PALLAS", v)
+        assert pallas_disabled_by_env() is True
+    for v in ("of", "fasle", "2", "disable"):
+        monkeypatch.setenv("FA_NO_PALLAS", v)
+        with pytest.raises(InputError, match="FA_NO_PALLAS"):
+            pallas_disabled_by_env()
+
+
+def test_fa_no_pallas_typo_fails_the_dispatch(monkeypatch):
+    monkeypatch.setenv("FA_NO_PALLAS", "fasle")
+    with pytest.raises(InputError, match="FA_NO_PALLAS"):
+        FastApriori(config=_mine_config()).run(_dataset())
+
+
+# ---------------------------------------------------------------------------
+# int8 -> int32 membership widening
+
+
+def test_wide_member_guard_records_and_counts_exactly():
+    """k1 >= 128 levels must dispatch the int32 membership path (int8
+    would wrap at 128 and silently miscount) and leave a ledger event."""
+    import jax.numpy as jnp
+
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    ctx = DeviceContext(num_devices=1)
+    f_pad = 256
+    k1 = 130
+    t = 8
+    # One basket containing items 0..k1 (plus padding rows of zeros).
+    bitmap_np = np.zeros((t, f_pad), np.int8)
+    bitmap_np[0, :k1] = 1
+    bitmap_np[1, :k1] = 1
+    bitmap = ctx.shard_bitmap(bitmap_np)
+    w = ctx.shard_weight_digits(np.ones((1, t), np.int8))
+    # One prefix block: the k1-item prefix, one candidate extension
+    # (item k1, absent) and one flat index pointing at item 0 (present).
+    zcol = f_pad - 1
+    prefix = np.full((1, 8, 136), zcol, np.int16)
+    prefix[0, 0, :k1] = np.arange(k1, dtype=np.int16)
+    cand = np.zeros((1, 16), np.int32)
+    cand[0, 0] = 0 * f_pad + k1  # extension beyond the basket: count 0
+    bits, counts = ctx.level_gather_batch(
+        bitmap, w, (1,), prefix, k1, 1, cand, 1
+    )
+    counts_np = np.asarray(counts)
+    # Both rows contain the 130-item prefix; extension k1 is in neither.
+    assert counts_np[0, 0] == 0
+    widen = [e for e in ledger.snapshot() if e["kind"] == "int8_widen"]
+    assert widen and widen[0]["k1"] == k1
+
+
+# ---------------------------------------------------------------------------
+# native loader hardening
+
+
+def test_native_arena_view_is_read_only():
+    from fastapriori_tpu.native.loader import (
+        has_preprocess_buffer_blocks,
+        preprocess_buffer_blocks,
+    )
+
+    if not has_preprocess_buffer_blocks():
+        pytest.skip("native library unavailable")
+    data = b"1 2 3\n2 3\n1 2 3\n"
+    seen = []
+
+    def on_block(f, offsets, items, weights):
+        seen.append(items.flags.writeable)
+        with pytest.raises((ValueError, RuntimeError)):
+            items[0] = 99
+
+    preprocess_buffer_blocks(data, 0.5, 1, on_block, copy_items=False)
+    assert seen and not any(seen)
+
+
+def test_native_load_failpoint_degrades_to_python_path():
+    import fastapriori_tpu.native.loader as loader
+
+    if not os.path.exists(loader._SO):
+        pytest.skip("native library not built")
+    old = loader._lib
+    loader._lib = None
+    try:
+        failpoints.arm("native.load", "io*1")
+        assert loader.get_lib() is None
+        assert any(
+            e["kind"] == "native_unavailable" for e in ledger.snapshot()
+        )
+        # Next call (failpoint exhausted) loads normally.
+        assert loader.get_lib() is not None
+    finally:
+        loader._lib = old

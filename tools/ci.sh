@@ -1,10 +1,14 @@
 #!/bin/sh
 # Tier-1 gate: graftlint first (fast, no JAX import), then the test
-# suite.  Usage: tools/ci.sh [extra pytest args].
+# suite, then the failpoint smoke pass (injected transient fetch /
+# kill-resume / truncated artifact against the full CLI pipeline).
+# Usage: tools/ci.sh [extra pytest args].
 set -e
 cd "$(dirname "$0")/.."
 
 python -m tools.lint fastapriori_tpu tests --baseline tools/lint/baseline.json
 
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"
+
+env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py

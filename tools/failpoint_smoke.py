@@ -1,0 +1,145 @@
+"""Failpoint smoke pass for CI (tools/ci.sh / `make ci`).
+
+Drives the full CLI pipeline through the three headline reliability
+scenarios on a tiny synthetic dataset, entirely on CPU:
+
+1. **transient fetch**: an injected one-shot RESOURCE_EXHAUSTED on the
+   pair fetch is retried, the run succeeds, and the retry is recorded in
+   the degradation ledger;
+2. **kill → resume**: a run with --checkpoint-every-level is aborted
+   right after a completed level, then --resume-from restarts it
+   mid-mine and the outputs are byte-identical to an uninterrupted run;
+3. **truncated artifact**: an injected truncation of the freqItems
+   resume artifact is rejected by MANIFEST.json validation with exit
+   code 2 naming the file.
+
+Exits non-zero on the first violated expectation.  Deliberately a plain
+script (no pytest): this is the "does the shipped wiring actually hold
+under injected failure" gate, one process, ~seconds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import random
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # `python tools/failpoint_smoke.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+from fastapriori_tpu.cli import main  # noqa: E402
+from fastapriori_tpu.reliability import failpoints, ledger  # noqa: E402
+
+
+def die(msg: str) -> None:
+    print(f"failpoint_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def make_inputs(root: str) -> str:
+    rng = random.Random(11)
+    items = [str(i) for i in range(1, 13)]
+    weights = [1.0 / (i + 1) for i in range(12)]
+    lines = [
+        " ".join(rng.choices(items, weights=weights, k=rng.randint(1, 6)))
+        for _ in range(150)
+    ]
+    inp = os.path.join(root, "in") + os.sep
+    os.makedirs(inp)
+    with open(os.path.join(inp, "D.dat"), "w") as f:
+        f.writelines(l + "\n" for l in lines)
+    with open(os.path.join(inp, "U.dat"), "w") as f:
+        f.writelines(l + "\n" for l in lines[:25])
+    return inp
+
+
+def run(argv: list) -> int:
+    return main(argv)
+
+
+def read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def main_smoke() -> None:
+    root = tempfile.mkdtemp(prefix="fa_failpoint_smoke_")
+    try:
+        inp = make_inputs(root)
+        out_clean = os.path.join(root, "clean") + os.sep
+        os.makedirs(out_clean)
+        base = [inp, "--min-support", "0.08"]
+        if run([inp, out_clean] + base[1:]) != 0:
+            die("clean run failed")
+
+        # 1. transient fetch failure: retried, run succeeds, recorded.
+        out_flaky = os.path.join(root, "flaky") + os.sep
+        os.makedirs(out_flaky)
+        ledger.reset()
+        failpoints.arm("fetch.pair", "oom*1")
+        failpoints.arm("fetch.counts", "delay@5")
+        if run([inp, out_flaky, "--min-support", "0.08",
+                "--engine", "level"]) != 0:
+            die("run with injected transient fetch failure did not succeed")
+        failpoints.disarm_all()
+        if not any(e["kind"] == "retry" for e in ledger.snapshot()):
+            die("injected transient fetch failure was not recorded as a retry")
+        if read(out_flaky + "freqItemset") != read(out_clean + "freqItemset"):
+            die("flaky-fetch run output differs from clean run")
+
+        # 2. kill -> resume: abort after a completed level, resume, compare.
+        out_ckpt = os.path.join(root, "ckpt") + os.sep
+        os.makedirs(out_ckpt)
+        failpoints.arm("level.3", "abort")
+        aborted = False
+        try:
+            run([inp, out_ckpt, "--min-support", "0.08",
+                 "--checkpoint-every-level"])
+        except failpoints.InjectedAbort:
+            aborted = True
+        failpoints.disarm_all()
+        if not aborted:
+            die("level.3 abort failpoint did not interrupt the mine")
+        if os.path.exists(out_ckpt + "freqItemset"):
+            die("aborted run left a final artifact behind")
+        if not os.path.exists(out_ckpt + "checkpoint.npz"):
+            die("aborted run left no checkpoint")
+        if run([inp, out_ckpt, "--min-support", "0.08",
+                "--resume-from", out_ckpt]) != 0:
+            die("mid-mine resume failed")
+        for name in ("freqItemset", "recommends"):
+            if read(out_ckpt + name) != read(out_clean + name):
+                die(f"resumed run {name} differs from uninterrupted run")
+
+        # 3. truncated artifact: rejected by manifest validation.
+        out_trunc = os.path.join(root, "trunc") + os.sep
+        os.makedirs(out_trunc)
+        failpoints.arm("write.freqItems", "truncate@30")
+        if run([inp, out_trunc, "--min-support", "0.08",
+                "--save-counts"]) != 0:
+            die("truncating writer run failed outright")
+        failpoints.disarm_all()
+        err = io.StringIO()
+        with contextlib.redirect_stderr(err):
+            rc = run([inp, out_trunc, "--min-support", "0.08",
+                      "--resume-from", out_trunc])
+        if rc != 2:
+            die(f"truncated artifact resume returned {rc}, expected 2")
+        if "freqItems" not in err.getvalue():
+            die("truncated-artifact error does not name the file")
+
+        print("failpoint_smoke: OK (transient-retry, kill-resume, "
+              "truncated-artifact)")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main_smoke()

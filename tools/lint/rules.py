@@ -118,9 +118,12 @@ class HostSyncRule(Rule):
     # host-side data (e.g. a Python list of Device handles), not a device
     # array — a false-positive suppression, not a fetch audit.
     aliases = ("fetch-site", "host-data")
-    # Directories (path substrings) where ALL host fetches need an audit
-    # waiver, not just those inside traced functions.
-    fetch_audit_dirs: Tuple[str, ...] = ("parallel/",)
+    # Path substrings where ALL host fetches need an audit waiver, not
+    # just those inside traced functions: the mesh layer, and the engine
+    # layer's level loop (its np.asarray sites are the mining phase's
+    # biggest link payloads — ROADMAP open item, extended from parallel/
+    # in the reliability PR).
+    fetch_audit_dirs: Tuple[str, ...] = ("parallel/", "models/apriori")
 
     _SYNC_ATTRS = {"item", "block_until_ready", "tolist", "copy_to_host_async"}
 
@@ -674,6 +677,66 @@ class TodoIssueRule(Rule):
                 )
 
 
+class ArtifactWriteRule(Rule):
+    """G009 — artifact writes must go through the atomic writer.
+
+    ``io/writer.py write_artifact`` is the run's output committer: tmp +
+    fsync + atomic rename, a manifest entry, and the ``write.<name>``
+    failpoint.  A raw open-for-write anywhere in the package bypasses
+    all three — a crash mid-write can leave a torn file under the final
+    name that later *parses cleanly* (the bug class ``MANIFEST.json``
+    exists to catch).  Flags ``open()``/``fsspec.open()`` with a writing
+    mode and any ``open_write()`` call; the committer's own internals
+    carry waivers, which is the point — every bypass is an audited
+    decision.  Test code is exempt (fixtures write files legitimately).
+    """
+
+    id = "G009"
+    name = "artifact-write"
+    aliases = ("atomic-write",)
+
+    _WRITE_CHARS = frozenset("wax+")
+
+    def _mode_of(self, node: ast.Call) -> Optional[str]:
+        mode: Optional[ast.AST] = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+        return None
+
+    def check(self, ctx, pkg):
+        parts = ctx.path.split("/")
+        if "tests" in parts:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = terminal_name(node.func)
+            if t == "open_write":
+                yield self.finding(
+                    ctx,
+                    node,
+                    "open_write() bypasses the atomic writer "
+                    "(io/writer.py write_artifact): no tmp+fsync+rename, "
+                    "no manifest entry, no write.<name> failpoint",
+                )
+            elif t == "open":
+                mode = self._mode_of(node)
+                if mode and (set(mode) & self._WRITE_CHARS):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"open(..., {mode!r}) writes without the atomic "
+                        "writer (io/writer.py write_artifact); route "
+                        "artifacts through it, or waive stating why a "
+                        "torn write is acceptable here",
+                    )
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncRule(),
     CollectiveAxisRule(),
@@ -683,6 +746,7 @@ ALL_RULES: Sequence[Rule] = (
     SilentExceptRule(),
     HazardousDefaultsRule(),
     TodoIssueRule(),
+    ArtifactWriteRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
