@@ -62,6 +62,26 @@ class MinerConfig:
     # next-pow2 budget, so a large-pair dataset pays one extra dispatch
     # rather than every dataset paying the fat payload.
     pair_cap: int = 1 << 14
+    # Ingest-overlapped pair program: ALSO count level 3 inside the same
+    # dispatch (ops/count.py l3_threshold_pack — the pair mask already
+    # encodes the full k=3 candidate set), so level 3 costs the mining
+    # loop no dispatch and rides the one pair fetch.  pair_l3_rows is
+    # the static pair-prefix budget (n2 above it invalidates the
+    # section; the host falls back to the classic level-3 dispatch and
+    # records the grown budget for repeat runs), pair_l3_cap the
+    # level-3 survivor budget (2·cap·4 bytes of extra fetch payload).
+    # 0 rows disables the fold.
+    pair_l3_rows: int = 1 << 13
+    pair_l3_cap: int = 1 << 14
+    # Deferred-count HBM retention budget (ADVICE r5 #2): the level loop
+    # keeps each level's [NB, C] int32 count tensor device-resident for
+    # the single end-of-mine gather; once their summed bytes exceed this
+    # budget the loop DRAINS them early — one gather dispatch compacts
+    # the survivors, the big tensors free, and the (async) fetch is
+    # consumed at end-of-mine.  Deep lattices therefore hold O(budget)
+    # extra HBM instead of O(levels); each drain costs one dispatch,
+    # so the common shallow case (under budget) still pays exactly one.
+    pending_fetch_budget_bytes: int = 256 << 20
     # Level engine, single-process local-file ingest: split D.dat into
     # this many line-aligned blocks, compress each natively and start its
     # (async) device upload immediately — block i+1's host compression

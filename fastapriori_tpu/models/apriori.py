@@ -391,24 +391,25 @@ class FastApriori:
 
         cfg = self.config
         ctx = self.context
-        import os
         from concurrent.futures import ThreadPoolExecutor
 
-        n_threads = cfg.ingest_threads or os.cpu_count() or 1
-        if n_threads == 1:
-            from fastapriori_tpu.native.loader import (
-                has_preprocess_buffer_blocks,
-            )
+        from fastapriori_tpu.preprocess import ingest_thread_count
 
-            if has_preprocess_buffer_blocks():
-                # Single-threaded hosts take the capture-replay form: ONE
-                # native call does pass 1 (recording parsed token ids),
-                # rank assignment, and per-block pass-2 id replay — the
-                # raw bytes are tokenized exactly once (the threaded path
-                # below re-tokenizes each block in exchange for real
-                # multi-core parallelism, a good trade only when cores
-                # exist).
-                return self._run_file_pipelined_capture(d_path)
+        n_threads = ingest_thread_count(cfg.ingest_threads)
+        from fastapriori_tpu.native.loader import (
+            has_preprocess_buffer_blocks,
+        )
+
+        if has_preprocess_buffer_blocks():
+            # Capture-replay form for EVERY thread count: pass 1's scan
+            # runs as n_threads parallel line-aligned segments and pass
+            # 2's replay as n_threads native block workers (both inside
+            # the one native call — the raw bytes are tokenized exactly
+            # once), with replay overlapping the main thread's per-block
+            # packing + upload.  The re-tokenizing ThreadPool path below
+            # survives only as the fallback for a stale .so without the
+            # blocks entry point.
+            return self._run_file_pipelined_capture(d_path, n_threads)
         with self.metrics.timed("preprocess", path=d_path) as m:
             with open(d_path, "rb") as fh:
                 buf = fh.read()
@@ -718,15 +719,16 @@ class FastApriori:
         return self.context._unpack_fn()(jnp.concatenate(parts, axis=0))
 
     def _run_file_pipelined_capture(
-        self, d_path: str
+        self, d_path: str, n_threads: int = 1
     ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], CompressedData]:
         """Capture-replay pipelined ingest: one native call runs pass 1
-        (capturing parsed token ids), rank assignment, and per-block
-        pass-2 replay (native/preprocess.cc fa_preprocess_buffer_blocks
-        — the raw bytes are tokenized exactly ONCE); each block's CSR
-        arrives through a callback mid-call and its packed bitmap is
-        submitted to the upload worker immediately, so transfers ride
-        the link while the native side compresses the next block."""
+        (capturing parsed token ids — ``n_threads`` parallel segment
+        scans), rank assignment, and per-block pass-2 replay
+        (native/preprocess.cc fa_preprocess_buffer_blocks — the raw
+        bytes are tokenized exactly ONCE); each block's CSR arrives
+        through a callback mid-call and its packed bitmap is submitted
+        to the upload worker immediately, so transfers ride the link
+        while the native side compresses the next block."""
         from concurrent.futures import ThreadPoolExecutor
 
         from fastapriori_tpu.native.loader import preprocess_buffer_blocks
@@ -829,6 +831,7 @@ class FastApriori:
                         cfg.min_support,
                         max(cfg.ingest_pipeline_blocks, 1),
                         on_block,
+                        n_threads=n_threads,
                         copy_items=cfg.retain_csr,
                     )
                 )
@@ -838,7 +841,7 @@ class FastApriori:
                 t_first = state.get("t_first_block", t_ingest1)
                 m.update(
                     n_raw=n_raw, min_count=min_count, num_items=f,
-                    pipelined=True, capture=True,
+                    pipelined=True, capture=True, threads=n_threads,
                     pass1_s=round(t_first - t_ingest0, 3),
                     pass2_s=round(t_ingest1 - t_first, 3),
                     pack_s=round(state.get("pack_s", 0.0), 3),
@@ -882,26 +885,51 @@ class FastApriori:
                     cap = max(
                         cfg.pair_cap, ctx.pair_cap_hint(cap_key) or 0
                     )
+                    # Level 3 folded into the same dispatch (VERDICT r5
+                    # next #2): valid only when the true pair count fits
+                    # the static prefix budget and the level-3 survivors
+                    # fit cap3 — the host checks both at fetch time and
+                    # falls back to the classic level-3 dispatch,
+                    # recording the grown budgets for repeat runs.
+                    census = f_pad <= TRI_F_CAP
+                    l3_keys = (
+                        ("pair_l3p", t_pad_pre, f, min_count),
+                        ("pair_l3c", t_pad_pre, f, min_count),
+                    )
+                    l3 = None
+                    if census and cfg.pair_l3_rows > 0:
+                        p3 = min(
+                            max(
+                                cfg.pair_l3_rows,
+                                ctx.pair_cap_hint(l3_keys[0]) or 0,
+                            ),
+                            cap,
+                        )
+                        cap3 = max(
+                            cfg.pair_l3_cap,
+                            ctx.pair_cap_hint(l3_keys[1]) or 0,
+                        )
+                        l3 = (p3, cap3, n_chunks)
                     dev_blocks = [fu.result() for fu in dev_futures]
                     dev_ws = [fu.result() for fu in w_futures]
                     fn = ctx.ingest_pair_miner(
                         tuple(b.shape[0] for b in dev_blocks),
-                        t_pad_pre, cap, f_pad <= TRI_F_CAP,
+                        t_pad_pre, cap, census, l3=l3,
                     )
                     bitmap, pair_packed, counts_dev = fn(
                         tuple(dev_blocks), tuple(dev_ws),
                         jnp.int32(min_count), jnp.int32(f),
                     )
-                    try:
-                        # lint: fetch-site -- non-blocking prefetch of the audited pair fetch below
-                        pair_packed.copy_to_host_async()
-                    except (AttributeError, NotImplementedError):
-                        pass
                     pair_pre = {
-                        "packed": pair_packed,
+                        # Non-blocking audited fetch, consumed one host
+                        # phase later (the transfer rides the link while
+                        # the host assembles weights/CSR below).
+                        "fetch": retry.fetch_async(pair_packed, "pair_pre"),
                         "counts_dev": counts_dev,
                         "cap": cap,
                         "cap_key": cap_key,
+                        "l3": l3,
+                        "l3_keys": l3_keys,
                     }
                 asm = self._assemble_blocks(
                     blocks, txn_multiple, f,
@@ -1606,12 +1634,10 @@ class FastApriori:
 
         def pair_fetch():
             """Host values from the overlapped pair program (memoized —
-            the fused auto-choice and level 2 share one fetch)."""
+            the fused auto-choice, level 2, and level 3 share one
+            fetch, issued async at dispatch time)."""
             if "host" not in pair_pre:
-                out = retry.fetch(
-                    # lint: fetch-site -- the overlapped pair program's ONE audited fetch, retry-wrapped
-                    lambda: np.asarray(pair_pre["packed"]), "pair_pre"
-                )
+                out = pair_pre.pop("fetch").result()
                 cap = pair_pre["cap"]
                 pair_pre["host"] = (
                     out[:cap],
@@ -1619,6 +1645,16 @@ class FastApriori:
                     int(out[2 * cap]),
                     int(out[2 * cap + 1]),
                 )
+                if pair_pre.get("l3") is not None:
+                    p3, cap3, _nc = pair_pre["l3"]
+                    base = 2 * cap + 2
+                    pair_pre["l3_host"] = (
+                        out[base : base + cap3],
+                        out[base + cap3 : base + 2 * cap3],
+                        int(out[base + 2 * cap3]),
+                        p3,
+                        cap3,
+                    )
             return pair_pre["host"]
 
         fused_ok = (
@@ -1668,6 +1704,10 @@ class FastApriori:
                 if pair_pre is not None:
                     idx, cnt, n2, tri = pair_fetch()
                     cap = pair_pre["cap"]
+                    # The pair dispatch rode the ingest shadow: the
+                    # mining loop pays zero dispatches here (the ingest
+                    # accounting carries it) unless the cap overflowed.
+                    d_disp = 0
                     if n2 > cap:
                         ledger.record(
                             "pair_cap_overflow", n2=int(n2), cap=cap
@@ -1677,9 +1717,10 @@ class FastApriori:
                             pair_pre["counts_dev"], min_count, f, cap
                         )
                         ctx.record_pair_cap(pair_pre["cap_key"], cap)
+                        d_disp = 1
                     pair_pre["counts_dev"] = None  # free [F, F] promptly
                     d_eff = 1  # one exact f32 Gram inside the mega dispatch
-                    m.update(overlapped=True)
+                    m.update(overlapped=True, dispatches=d_disp)
                 else:
                     # Start from the recorded budget when this profile
                     # overflowed before, so repeat runs never re-pay the
@@ -1693,6 +1734,7 @@ class FastApriori:
                         bitmap, w_digits, scales, min_count, f, cap,
                         heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
                     )
+                    d_disp = 1
                     if n2 > cap:
                         # Overflow: re-extract at the exact budget over
                         # the RESIDENT count matrix — no Gram re-run, no
@@ -1705,8 +1747,10 @@ class FastApriori:
                             counts_dev, min_count, f, cap
                         )
                         ctx.record_pair_cap(cap_key, cap)
+                        d_disp = 2
                     del counts_dev  # free the [F, F] matrix promptly
                     d_eff = 1 if fast_f32 else len(scales)
+                    m.update(dispatches=d_disp)
                 f_pad = bitmap.shape[1]
                 idx, cnt = idx[:n2], cnt[:n2]
                 cur = np.stack([idx // f_pad, idx % f_pad], axis=1).astype(
@@ -1738,18 +1782,81 @@ class FastApriori:
                     levels[:] = partial
                     cur = partial[-1][0]
             self._checkpoint_levels(levels, data)
+            # Level 3 from the SAME overlapped dispatch + fetch (the
+            # dispatch fold): valid only when the true pair count fit
+            # the static prefix budget and the survivors fit cap3 —
+            # otherwise fall back to the classic level-3 dispatch below,
+            # growing the recorded budgets so repeat runs fold.  Skipped
+            # when a fused salvage already advanced past level 2.
+            l3h = (
+                pair_pre.get("l3_host") if pair_pre is not None else None
+            )
+            if (
+                l3h is not None
+                and len(levels) == 1
+                and cur.shape[1] == 2
+                and cur.shape[0] >= 3
+            ):
+                idx3, cnt3, n3, p3, cap3 = l3h
+                n2_now = cur.shape[0]
+                if n2_now <= p3 and n3 <= cap3:
+                    with self.metrics.timed("level", k=3) as m:
+                        f_pad3 = bitmap.shape[1]
+                        idx3, cnt3 = idx3[:n3], cnt3[:n3]
+                        # Row-major (pair_slot, z) extraction over a
+                        # lex-sorted pair level => already lex-sorted.
+                        nxt3 = np.concatenate(
+                            [cur[idx3 // f_pad3], (idx3 % f_pad3)[:, None]],
+                            axis=1,
+                        ).astype(np.int32)
+                        levels.append((nxt3, cnt3.astype(np.int64)))
+                        cur = nxt3
+                        m.update(
+                            candidates=int(tri) if tri >= 0 else -1,
+                            frequent=int(n3),
+                            overlapped=True,
+                            dispatches=0,
+                            macs=0,  # counted under the ingest dispatch
+                            psum_bytes=0,
+                        )
+                    self._checkpoint_levels(levels, data)
+                else:
+                    l3p_key, l3c_key = pair_pre["l3_keys"]
+                    if n2_now > p3:
+                        ctx.record_pair_cap(l3p_key, _next_pow2(n2_now))
+                    if n3 > cap3:
+                        ctx.record_pair_cap(l3c_key, _next_pow2(n3))
+                    ledger.record(
+                        "pair_l3_overflow",
+                        n2=int(n2_now), p3=int(p3),
+                        n3=int(n3), cap3=int(cap3),
+                    )
 
         # Deferred count resolution (single-process): per-level fetches
-        # carry only survivor bitmasks; counts resolve here in ONE
-        # dispatch + fetch after the loop.  Checkpointing forces eager
-        # counts — a durable level must carry its counts, and deferring
-        # them would leave every checkpoint one crash away from useless.
+        # carry only survivor bitmasks; counts resolve in ONE dispatch +
+        # fetch after the loop — unless the retained [NB, C] tensors
+        # outgrow the byte budget, in which case they DRAIN mid-mine
+        # (one gather dispatch compacts the survivors and frees the big
+        # tensors; the async fetch is consumed at end-of-mine — ADVICE
+        # r5 #2).  Checkpointing forces eager counts — a durable level
+        # must carry its counts, and deferring them would leave every
+        # checkpoint one crash away from useless.
         pending_map: Dict[int, list] = {}
+        drained: list = []  # [(per-level segment sizes, AsyncFetch, u24)]
+        pending_bytes = [0]
         defer = jax.process_count() == 1 and not cfg.checkpoint_prefix
+
+        def note_pending(nxt_counts):
+            pending_bytes[0] += sum(
+                int(np.prod(c.shape)) * 4 for c, _ in nxt_counts
+            )
+            if pending_bytes[0] > cfg.pending_fetch_budget_bytes:
+                self._drain_pending(pending_map, drained, data.n_raw)
+                pending_bytes[0] = 0
 
         def finish(lvls):
             return self._resolve_pending_counts(
-                lvls, pending_map, n_raw=data.n_raw
+                lvls, pending_map, drained, n_raw=data.n_raw
             )
 
         # Levels >=3 (C7 + C8), reference termination rule
@@ -1778,34 +1885,41 @@ class FastApriori:
         )
         k = cur.shape[1] + 1
         prev_rows = None  # previous level's row count (shrink signal)
+        fold_attempts = 2  # an early incomplete fold keeps one retry
+        last_fold_seed = None  # strict seed shrink between attempts
         while cur.shape[0] >= k:
             # k > 3: never fold straight off the pair level — small
             # lattices that fit a whole-loop program are the fused
             # engine's job (the auto choice), and the fold's seed should
             # be a level the per-level engine already counted.
-            shrink_ok = (
-                not auto_tail
-                or cur.shape[0] <= 16384
-                or (prev_rows is not None and cur.shape[0] < prev_rows)
-            )
             if (
                 tail_ok
+                and fold_attempts > 0
                 and k > 3
                 and cur.shape[0] <= tail_rows
-                and shrink_ok
+                and self._tail_entry_ok(auto_tail, cur.shape[0], prev_rows)
+                and (
+                    last_fold_seed is None
+                    or cur.shape[0] < last_fold_seed
+                )
             ):
-                tail, complete = self._mine_tail(
+                tail, complete, dispatched = self._mine_tail(
                     data, bitmap, w_digits, scales, cur, n_chunks, heavy
                 )
-                tail_ok = False  # one fold per run (re-trigger can't help)
-                if tail:
-                    levels.extend(tail)
-                    cur = tail[-1][0]
-                    k = cur.shape[1] + 1
-                    self._checkpoint_levels(levels, data)
-                if complete:
-                    return finish(levels)
-                continue  # incomplete: per-level from the last good level
+                if dispatched:
+                    fold_attempts -= 1
+                    last_fold_seed = cur.shape[0]
+                    if tail:
+                        levels.extend(tail)
+                        cur = tail[-1][0]
+                        k = cur.shape[1] + 1
+                        self._checkpoint_levels(levels, data)
+                    if complete:
+                        return finish(levels)
+                    continue  # incomplete: per-level from last good level
+                # Not dispatched (memory model rejected this seed): fall
+                # through to the per-level dispatch — a later, smaller
+                # seed may fit where this one didn't.
             with self.metrics.timed("level", k=k) as m:
                 nxt, nxt_counts, lvl_stats = self._count_level(
                     ctx,
@@ -1823,6 +1937,7 @@ class FastApriori:
                 m.update(frequent=nxt.shape[0], **lvl_stats)
             if isinstance(nxt_counts, list):  # deferred (pending runs)
                 pending_map[len(levels)] = nxt_counts
+                note_pending(nxt_counts)
                 nxt_counts = None
             elif nxt_counts is None:  # empty level
                 nxt_counts = np.empty(0, dtype=np.int64)
@@ -1834,14 +1949,78 @@ class FastApriori:
             k += 1
         return finish(levels)
 
-    def _resolve_pending_counts(self, levels, pending_map, n_raw=None):
-        """ONE dispatch + ONE fetch for every deferred level's survivor
-        counts (the per-level transfers used to cross the slow tunnel
-        down-link padded ~4 bytes/candidate; this crosses exactly
-        4 bytes/SURVIVOR once).  ``pending_map``: level index ->
+    @staticmethod
+    def _tail_entry_ok(
+        auto_tail: bool, n0: int, prev_rows: Optional[int]
+    ) -> bool:
+        """AUTO-mode entry heuristic for the shallow-tail fold (explicit
+        ``tail_fuse_rows`` always enters).  Seeds past the legacy 16K bar
+        need evidence the fold won't immediately overflow its
+        ``next_pow2(n0)`` row budget: SHRINKING rows, or (VERDICT r5
+        next #2's lowered entry) NEAR-PEAK growth — a level grown <= 20%
+        over its predecessor is at or next to the lattice peak, so the
+        pow2 headroom covers the next level and k=8-9-class levels ride
+        the fold instead of costing one dispatch each.  A still-doubling
+        mid-lattice stays out (a doomed fold dispatch is pure waste)."""
+        if not auto_tail or n0 <= 16384:
+            return True
+        if prev_rows is None:
+            return False
+        return n0 < prev_rows or n0 * 5 <= prev_rows * 6
+
+    def _drain_pending(self, pending_map, drained, n_raw) -> None:
+        """Byte-budgeted mid-mine drain of the deferred count tensors
+        (ADVICE r5 #2): one gather dispatch compacts every pending
+        level's survivors into a small device array, the [NB, C] int32
+        tensors free (pending_map is cleared — the gather output is the
+        only remaining reference), and the device→host copy is issued
+        ASYNC — consumed at end-of-mine, so the transfer hides under the
+        remaining levels' compute.  Deep lattices hold O(budget) extra
+        HBM instead of O(levels)."""
+        flat = []
+        for idx in sorted(pending_map):
+            for counts_dev, pos in pending_map[idx]:
+                if pos.size:
+                    flat.append((idx, counts_dev, pos))
+        pending_map.clear()
+        if not flat:
+            return
+        failpoints.fire("drain.counts")
+        u24 = n_raw is not None and n_raw < 2**24
+        n_out = sum(p.size for _, _, p in flat)
+        with self.metrics.timed("counts_drain") as m:
+            handle = self.context.gather_level_counts_start(
+                [(c, p) for _, c, p in flat],
+                u24=u24,
+                site="counts_drain",
+            )
+            m.update(
+                levels=len({i for i, _, _ in flat}),
+                dispatches=1,
+                fetch_bytes=(3 if u24 else 4) * n_out,
+            )
+        drained.append(([(i, p.size) for i, _, p in flat], handle, u24))
+
+    def _resolve_pending_counts(
+        self, levels, pending_map, drained=None, n_raw=None
+    ):
+        """ONE dispatch + ONE fetch for every still-deferred level's
+        survivor counts (the per-level transfers used to cross the slow
+        tunnel down-link padded ~4 bytes/candidate; this crosses exactly
+        4 bytes/SURVIVOR once), plus consumption of any mid-mine drains'
+        in-flight async fetches (:meth:`_drain_pending`) — drains land
+        first, in launch order, so each level's count segments
+        concatenate in block order.  ``pending_map``: level index ->
         [(counts_dev, flat positions)] in row order."""
-        if not pending_map:
+        if not pending_map and not drained:
             return levels
+        per_level: Dict[int, list] = {}
+        for seg_sizes, handle, u24 in drained or ():
+            out = self.context.finish_level_counts(handle, u24=u24)
+            off = 0
+            for idx, size in seg_sizes:
+                per_level.setdefault(idx, []).append(out[off : off + size])
+                off += size
         flat = []  # (level idx, counts_dev, pos) in level-major order
         for idx in sorted(pending_map):
             for counts_dev, pos in pending_map[idx]:
@@ -1861,9 +2040,15 @@ class FastApriori:
             )
             m.update(
                 levels=len(pending_map),
+                drains=len(drained or ()),
+                # One real gather dispatch when anything was still
+                # pending (bench reports it as resolve_dispatches,
+                # SEPARATE from the mining-loop series — the r5 baseline
+                # of 9 was measured without it, and folding it in would
+                # reset the round-over-round comparison).
+                dispatches=1 if flat else 0,
                 fetch_bytes=(3 if u24 else 4) * int(out.size),
             )
-        per_level: Dict[int, list] = {}
         off = 0
         for idx, _c, p in flat:
             per_level.setdefault(idx, []).append(out[off : off + p.size])
@@ -1884,13 +2069,14 @@ class FastApriori:
     def _mine_tail(
         self, data, bitmap, w_digits, scales, cur: np.ndarray,
         n_chunks: int, heavy: Optional[tuple],
-    ) -> Tuple[list, bool]:
+    ) -> Tuple[list, bool, bool]:
         """Shallow-tail fold: mine every remaining level in ONE dispatch
         seeded from the current level matrix (ops/fused.py
         _tail_mine_local — the inverse of the fused→level salvage).
-        Returns ``(complete tail levels, loop_finished)``; on overflow
-        or depth bound the caller resumes per-level counting from the
-        last complete level."""
+        Returns ``(complete tail levels, loop_finished, dispatched)``;
+        ``dispatched=False`` means the memory model rejected the seed
+        before any device work.  On overflow or depth bound the caller
+        resumes per-level counting from the last complete level."""
         from fastapriori_tpu.ops import fused
 
         cfg = self.config
@@ -1917,7 +2103,7 @@ class FastApriori:
             cfg, ctx, t_pad, f_pad, n_chunks, unpacked_resident=True,
             cap=m_cap, tail_chunked=True,
         ):
-            return [], False
+            return [], False, False
         # Prefix budget scales with LARGE seeds: a 64K-row fold's first
         # level can have ~10K prefixes with extensions — the configured
         # cap (tuned for the legacy 16K regime) would trip the in-kernel
@@ -1971,6 +2157,7 @@ class FastApriori:
             d_eff = len(scales)
             met.update(
                 levels=int(np.count_nonzero(n_lvl)),
+                dispatches=1,
                 incomplete=bool(incomplete),
                 macs=n_iters
                 * (
@@ -1985,7 +2172,7 @@ class FastApriori:
             max_rows=fused.tail_slot_caps(m_cap, cfg.tail_fuse_l_max),
             prev=cur,
         )
-        return lvls, not bool(incomplete)
+        return lvls, not bool(incomplete), True
 
     def _count_level(
         self,
@@ -2170,12 +2357,15 @@ class FastApriori:
                 heavy_w=hw,
                 fast_f32=fast_f32,
             )
-            try:
-                # lint: fetch-site -- non-blocking prefetch of the audited bitmask fetch below
-                bits.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass
-            inflight.append((placed_all, bits, counts_out))
+            # Audited fetch issued NON-BLOCKING at dispatch time
+            # (reliability/retry.py fetch_async): the ~C/8-byte survivor
+            # mask crosses the link while the host preps the next block
+            # (and, for the last block, while it runs the collect loop
+            # below) — a congested link stalls the copy, not the host.
+            inflight.append(
+                (placed_all, retry.fetch_async(bits, "level_bits"),
+                 counts_out)
+            )
             # Per-launch cost model (metrics/MFU): membership matmul
             # [T, P_cap] + counting matmuls [P_cap, F] over padded
             # global shapes per scanned chunk — including the padding
@@ -2199,9 +2389,8 @@ class FastApriori:
         # flat positions are recorded for the ONE end-of-mine gather
         # (_resolve_pending_counts).
         pending = []  # (counts_dev [NB, C], flat positions int64[n])
-        for (placed_all, bits, counts_out), blk in zip(inflight, blocks):
-            # lint: fetch-site -- the per-level survivor-bitmask fetch (C/8 bytes), retry-wrapped
-            mask = retry.fetch(lambda b=bits: np.asarray(b), "level_bits")
+        for (placed_all, bits_fu, counts_out), blk in zip(inflight, blocks):
+            mask = bits_fu.result()  # consume the async fetch (retried)
             arr = np.unpackbits(mask, axis=1)  # [NB, C]
             c_tot = arr.shape[1]
             keep_blk = blk[2]

@@ -207,6 +207,23 @@ def heavy_level_correction(
     return _heavy_gate(corr, axis_name)
 
 
+def frequent_pair_mask(
+    counts: jnp.ndarray,  # [F, F] int32 — psum'd pair-count matrix
+    min_count: jnp.ndarray,
+    num_items: jnp.ndarray,
+) -> jnp.ndarray:
+    """The ONE definition of the frequent-pair mask (upper triangle,
+    real-item columns, count threshold) — shared by the pair packing,
+    the overflow regather, and the level-3 fold's candidate prune
+    (parallel/mesh.py ingest_pair_miner), which indexes pair survivor
+    SLOTS extracted from this same mask: a second inline copy could
+    silently desynchronize the level-3 candidate set from the slots it
+    is keyed to."""
+    iu = jnp.arange(counts.shape[0])
+    upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
+    return upper & (counts >= min_count)
+
+
 def pair_threshold_pack(
     counts: jnp.ndarray,  # [F, F] int32 — psum'd pair-count matrix
     min_count: jnp.ndarray,
@@ -222,10 +239,7 @@ def pair_threshold_pack(
     Returns the packed host-bound array
     ``[flat_idx[cap] | counts[cap] | n2 | tri]`` (tri = -1 when the
     census is skipped)."""
-    f = counts.shape[0]
-    iu = jnp.arange(f)
-    upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
-    mask = upper & (counts >= min_count)
+    mask = frequent_pair_mask(counts, min_count, num_items)
     n2 = jnp.sum(mask, dtype=jnp.int32)
     tri = _pair_triangles(mask) if census else jnp.int32(-1)
     (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
@@ -233,6 +247,88 @@ def pair_threshold_pack(
     return jnp.concatenate(
         [flat_idx, jnp.take(counts.reshape(-1), flat_idx),
          jnp.stack([n2, tri])]
+    )
+
+
+def l3_threshold_pack(
+    bitmap: jnp.ndarray,  # [T, F] int8 — resident unpacked bitmap
+    w_f: jnp.ndarray,  # [T] float32 raw weights (exact: counts < 2^24)
+    mask: jnp.ndarray,  # [F, F] bool — frequent-pair upper-triangle mask
+    flat_idx: jnp.ndarray,  # [cap] int32 pair survivors, row-major order
+    n2: jnp.ndarray,  # () int32 true survivor count
+    min_count: jnp.ndarray,
+    num_items: jnp.ndarray,
+    p3: int,  # static prefix-row budget (pairs counted for extensions)
+    cap3: int,  # static level-3 survivor budget
+    n_chunks: int,
+) -> jnp.ndarray:
+    """Level 3 counted INSIDE the pair dispatch (VERDICT r5 next #2): the
+    pair mask already encodes the full k=3 Apriori candidate set — the
+    triangles :func:`_pair_triangles` censuses — so counting them here
+    removes one mining-loop dispatch and one fetch.  For each surviving
+    pair (x, y) (one prefix row, same row-major order as ``flat_idx``)
+    the chunked membership+count matmuls produce weighted supports of
+    (x, y, z) for every extension z at once; candidates require z > y
+    and both (x, z), (y, z) frequent — exactly the prefix join + subset
+    prune.  Row-major ``(pair_slot, z)`` extraction keeps the output in
+    lex (x, y, z) order, the invariant the k=4 join needs.
+
+    f32 throughout (one BLAS/MXU-fast matmul per chunk); exact under the
+    caller's ``n_raw < 2^24`` gate (membership values are bounded by 2).
+    Returns ``[flat3[cap3] | counts3[cap3] | n3]`` where
+    ``flat3 = pair_slot * F + z``; the section is only valid when
+    ``n2 <= p3`` and ``n3 <= cap3`` — the HOST checks both and falls
+    back to the classic level-3 dispatch otherwise (exact either way)."""
+    t, f = bitmap.shape
+    tc = t // n_chunks
+    idx = flat_idx[:p3]
+    x, y = idx // f, idx % f
+    slot_valid = jnp.arange(p3, dtype=jnp.int32) < n2
+    # Pair one-hot [p3, F]: padded slots (>= n2) zero out, so their
+    # membership count never reaches 2 and they survive nothing.
+    s2 = (
+        (jax.nn.one_hot(x, f, dtype=jnp.float32)
+         + jax.nn.one_hot(y, f, dtype=jnp.float32))
+        * slot_valid[:, None].astype(jnp.float32)
+    )
+    bm = bitmap.reshape(n_chunks, tc, f)
+    wc = w_f.reshape(n_chunks, tc)
+
+    def step(acc, xs):
+        b_chunk, w_chunk = xs
+        b_f = b_chunk.astype(jnp.float32)
+        # lint: f32-gate -- membership values bounded by 2; counts < 2^24 (caller's n_raw gate)
+        member = lax.dot_general(
+            b_f, s2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [tc, p3]
+        common = (member == 2.0).astype(jnp.float32)
+        # lint: f32-gate -- weighted counts bounded by n_raw < 2^24 (caller's gate)
+        part = lax.dot_general(
+            common, b_f * w_chunk[:, None],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [p3, F]
+        return acc + part, None
+
+    counts3_f, _ = lax.scan(
+        step, jnp.zeros((p3, f), jnp.float32), (bm, wc)
+    )
+    counts3 = counts3_f.astype(jnp.int32)
+    col = jnp.arange(f, dtype=jnp.int32)
+    cand = (
+        jnp.take(mask, x, axis=0)  # (x, z) frequent — x < y < z
+        & jnp.take(mask, y, axis=0)  # (y, z) frequent
+        & (col[None, :] > y[:, None])
+        & (col[None, :] < num_items)
+        & slot_valid[:, None]
+    )
+    surv = cand & (counts3 >= min_count)
+    n3 = jnp.sum(surv, dtype=jnp.int32)
+    (flat3,) = jnp.nonzero(surv.reshape(-1), size=cap3, fill_value=0)
+    flat3 = flat3.astype(jnp.int32)
+    return jnp.concatenate(
+        [flat3, jnp.take(counts3.reshape(-1), flat3), n3[None]]
     )
 
 
@@ -299,10 +395,7 @@ def local_pair_regather(
     since this kernel has no matmul — its one-off XLA compile is cheap
     too (re-compiling the full gather at a new static cap cost seconds,
     to save a one-time payload).  Returns ``(flat_idx, counts, n2)``."""
-    f = counts.shape[0]
-    iu = jnp.arange(f)
-    upper = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
-    mask = upper & (counts >= min_count)
+    mask = frequent_pair_mask(counts, min_count, num_items)
     n2 = jnp.sum(mask, dtype=jnp.int32)
     (flat_idx,) = jnp.nonzero(mask.reshape(-1), size=cap, fill_value=0)
     flat_idx = flat_idx.astype(jnp.int32)

@@ -568,7 +568,7 @@ class DeviceContext:
         )
 
     def ingest_pair_miner(self, block_rows, t_pad: int, cap: int,
-                          census: bool):
+                          census: bool, l3: Optional[Tuple[int, int, int]] = None):
         """ONE dispatch from the per-block packed uploads straight to
         (resident unpacked bitmap, packed pair-survivor output, resident
         [F, F] count matrix) — the pipelined ingest submits it the moment
@@ -584,8 +584,15 @@ class DeviceContext:
         Single-device-mesh only (the pipelined capture ingest's
         precondition).  ``block_rows`` keys the compile on the per-block
         shapes; ``census`` adds the level-3 triangle count
-        (ops/count.py _pair_triangles) for the engine auto-choice."""
-        key = ("ingest_pair", tuple(block_rows), t_pad, cap, census)
+        (ops/count.py _pair_triangles) for the engine auto-choice.
+
+        ``l3=(p3, cap3, n_chunks)`` appends the level-3 counts to the
+        same packed output (ops/count.py l3_threshold_pack — the
+        dispatch-fold of VERDICT r5 next #2): level 3 then costs the
+        mining loop NO dispatch and rides the one pair fetch.  The
+        section is valid only when n2 <= p3 and n3 <= cap3; the host
+        checks both and falls back to the classic level-3 dispatch."""
+        key = ("ingest_pair", tuple(block_rows), t_pad, cap, census, l3)
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import _unpack
 
@@ -613,7 +620,8 @@ class DeviceContext:
                         [w, jnp.zeros(t_pad - total, jnp.int32)]
                     )
                 b_f = bitmap.astype(jnp.float32)
-                scaled = b_f * w.astype(jnp.float32)[:, None]
+                w_f = w.astype(jnp.float32)
+                scaled = b_f * w_f[:, None]
                 # lint: f32-gate -- caller gates on n_raw < 2^24 (docstring)
                 counts = lax.dot_general(
                     scaled,
@@ -624,6 +632,25 @@ class DeviceContext:
                 packed = count_ops.pair_threshold_pack(
                     counts, min_count, num_items, cap, census
                 )
+                if l3 is not None:
+                    # The SAME mask definition the pair packing used to
+                    # extract the survivor slots (ops/count.py
+                    # frequent_pair_mask) — the l3 candidate prune is
+                    # keyed to those slots and must never drift.
+                    mask = count_ops.frequent_pair_mask(
+                        counts, min_count, num_items
+                    )
+                    p3, cap3, n_chunks = l3
+                    packed = jnp.concatenate(
+                        [
+                            packed,
+                            count_ops.l3_threshold_pack(
+                                bitmap, w_f, mask, packed[:cap],
+                                packed[2 * cap], min_count, num_items,
+                                p3, cap3, n_chunks,
+                            ),
+                        ]
+                    )
                 return bitmap, packed, counts
 
             self._fns[key] = jax.jit(_fn)
@@ -774,6 +801,38 @@ class DeviceContext:
             args += [heavy_b, heavy_w]
         return self._fns[key](*args)
 
+    def gather_level_counts_start(
+        self, pending, u24: bool = False, site: str = "counts"
+    ):
+        """Launch the survivor-count gather dispatch and its NON-BLOCKING
+        device→host copy (``pending`` as in :meth:`gather_level_counts`);
+        returns an :class:`~fastapriori_tpu.reliability.retry.AsyncFetch`
+        whose ``result()`` is decoded by :meth:`finish_level_counts`.
+        The caller drops its ``counts_dev`` references the moment this
+        returns — the gather's compact output is the only thing still
+        resident, which is what lets the level loop's byte-budgeted
+        drain free each level's [NB, C] tensor mid-mine instead of
+        retaining it to end-of-mine (ADVICE r5 #2)."""
+        args = (
+            tuple(c for c, _ in pending),
+            tuple(jnp.asarray(p.astype(np.int32)) for _, p in pending),
+        )
+        fn = _gather_counts_u24_jit if u24 else _gather_counts_jit
+        return retry.fetch_async(fn(*args), site)
+
+    @staticmethod
+    def finish_level_counts(handle, u24: bool = False) -> np.ndarray:
+        """Consume a :meth:`gather_level_counts_start` handle into host
+        int64 counts (blocks; retry-wrapped inside the handle)."""
+        out = handle.result()
+        if u24:
+            return (
+                out[0].astype(np.int64)
+                | (out[1].astype(np.int64) << 8)
+                | (out[2].astype(np.int64) << 16)
+            )
+        return out.astype(np.int64)
+
     def gather_level_counts(self, pending, u24: bool = False):
         """End-of-mine survivor-count resolution in ONE dispatch + ONE
         fetch: ``pending`` is ``[(counts_dev [NB, C] int32, flat
@@ -786,24 +845,9 @@ class DeviceContext:
         cast could overflow).  ``u24``: counts provably < 2^24 (the
         caller's n_raw gate) cross the link as 3 bytes each.  Returns
         concatenated int64 counts (host)."""
-        args = (
-            tuple(c for c, _ in pending),
-            tuple(jnp.asarray(p.astype(np.int32)) for _, p in pending),
+        return self.finish_level_counts(
+            self.gather_level_counts_start(pending, u24=u24), u24=u24
         )
-        if u24:
-            planes = retry.fetch(
-                # lint: fetch-site -- audited end-of-mine fetch, 3-byte planes (u24 gate), retry-wrapped
-                lambda: np.asarray(_gather_counts_u24_jit(*args)), "counts"
-            )
-            return (
-                planes[0].astype(np.int64)
-                | (planes[1].astype(np.int64) << 8)
-                | (planes[2].astype(np.int64) << 16)
-            )
-        return retry.fetch(
-            # lint: fetch-site -- audited end-of-mine fetch of survivor counts, retry-wrapped
-            lambda: np.asarray(_gather_counts_jit(*args)), "counts"
-        ).astype(np.int64)
 
     def pair_counts(self, bitmap, w_digits, scales) -> jax.Array:
         pair, _, _ = self._get_fns(tuple(scales))

@@ -210,6 +210,33 @@ def _use_native(native: Optional[bool], size_hint: int) -> bool:
     return available and size_hint >= 50_000
 
 
+def ingest_thread_count(configured: Optional[int]) -> int:
+    """Host threads for the pipelined ingest (pass-1 segmented scan +
+    pass-2 block replay, native/preprocess.cc): the ``FA_INGEST_THREADS``
+    env knob overrides the config, which overrides one-per-core.
+    Strictly parsed like FA_NO_PALLAS — a typo'd value is an InputError,
+    not a silent serial ingest."""
+    import os
+
+    raw = os.environ.get("FA_INGEST_THREADS", "").strip()
+    if raw:
+        try:
+            n = int(raw)
+        except ValueError:
+            n = 0
+        if n < 1:
+            from fastapriori_tpu.errors import InputError
+
+            raise InputError(
+                f"unrecognized FA_INGEST_THREADS value {raw!r}: expected "
+                "a positive integer (unset = one thread per core)"
+            )
+        return n
+    if configured:
+        return configured
+    return os.cpu_count() or 1
+
+
 def preprocess(
     transactions: Sequence[Sequence[str]],
     min_support: float,

@@ -163,3 +163,53 @@ def fetch(
     retry-wrapped under ``fetch.<site>``.  The thunk must be re-runnable
     (a pure host materialization of an already-computed device array)."""
     return call_with_retries(thunk, "fetch." + site, policy)
+
+
+class AsyncFetch:
+    """An in-flight audited device→host fetch (the latency-hiding form of
+    :func:`fetch`): construction starts the non-blocking copy, so the
+    transfer rides the link while the host does other work; ``result()``
+    blocks, with the same ``fetch.<site>`` failpoint + retry discipline
+    as the synchronous wrapper.  graftlint's G001 audit recognizes
+    :func:`fetch_async` calls as audited fetch sites, so call sites need
+    no inline waiver."""
+
+    def __init__(self, arr, site: str, policy: Optional[RetryPolicy] = None):
+        self._arr = arr
+        self._site = site
+        self._policy = policy
+        self._result = None
+        self._done = False
+        try:
+            arr.copy_to_host_async()
+        # The copy is a HINT: result() re-materializes through the
+        # retried np.asarray, so any real failure (including a transient
+        # link error at issue time) surfaces there, classified.
+        # lint: waive G006 -- hint only; result() re-raises real failures
+        except Exception:
+            pass
+
+    def result(self):
+        """Host numpy array (blocks until the copy lands; memoized)."""
+        if not self._done:
+            import numpy as np
+
+            self._result = call_with_retries(
+                lambda: np.asarray(self._arr),
+                "fetch." + self._site,
+                self._policy,
+            )
+            self._done = True
+            self._arr = None  # drop the device reference promptly
+        return self._result
+
+
+def fetch_async(
+    arr, site: str, policy: Optional[RetryPolicy] = None
+) -> AsyncFetch:
+    """Issue an audited device→host fetch WITHOUT blocking: returns an
+    :class:`AsyncFetch` whose ``result()`` is consumed one host phase
+    later (models/apriori.py's per-level survivor fetches and the
+    pending-count drain — VERDICT r5 next #6: the work was hidden, the
+    fetch was not)."""
+    return AsyncFetch(arr, site, policy)

@@ -63,6 +63,21 @@ CASES = [
      "import numpy as np\n"
      "def host_table():\n"
      "    return np.array([1, 2, 3])\n"),  # literal arg: host data
+    ("G001", "pass", "pkg/parallel/m.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "def pull(arr):\n"
+     "    return retry.fetch(lambda: np.asarray(arr), 'pair')\n"),
+    # the audited helper IS the audit: no inline waiver needed
+    ("G001", "pass", "pkg/models/apriori.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability.retry import fetch_async\n"
+     "def pull(arr):\n"
+     "    return fetch_async(np.asarray(arr), 'level_bits')\n"),
+    ("G001", "flag", "pkg/parallel/m.py",
+     "import numpy as np\n"
+     "def pull(arr, fetch):\n"
+     "    return fetch(np.asarray(arr))\n"),  # no site label: not audited
     ("G001", "waived", "pkg/mod.py",
      "import jax\n"
      "@jax.jit\n"

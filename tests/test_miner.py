@@ -384,3 +384,187 @@ def test_pair_cap_overflow_regather_and_hint():
     # ...and a repeat run is still exact (single dispatch path).
     got2, _, _ = miner.run(lines)
     assert dict(got2) == dict(expected)
+
+
+# ---------------------------------------------------------------------------
+# r6 latency-hiding pipeline: dispatch budget, level-3 fold, threaded ingest
+
+
+def _mine_loop_dispatches(records):
+    """The dispatch-accounting trace's mining-loop total — the same
+    aggregation bench.py's _phase_summary reports as ``dispatches``
+    (per-event counts; ingest-overlapped level 2/3 fetches carry 0)."""
+    return sum(
+        int(r.get("dispatches", 1))
+        for r in records
+        if r.get("event")
+        in ("level", "tail_fuse", "fused_mine", "pair_prepass",
+            "counts_drain")
+    )
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="native extension not built"
+)
+def test_webdocs_shaped_mine_dispatch_budget(tmp_path):
+    """Regression pin for the r6 dispatch fold (ISSUE 3 acceptance): a
+    webdocs-shaped mine — deep lattice, pipelined capture ingest,
+    shallow-tail fold — runs in <= 5 mining-loop device dispatches.
+    Levels 2 AND 3 ride the ONE ingest-overlapped dispatch (their level
+    events are pure fetches, dispatches=0), the tail fold absorbs the
+    deep levels, and the output stays byte-identical vs the oracle."""
+    from conftest import tokenized
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    deep = ["0 1 2 3 4 5 6 7 8 9 10 11"] * 40  # 12-deep closed lattice
+    noise = random_dataset(17, n_txns=300, n_items=30, max_len=6)
+    d_raw = deep + noise
+    path = tmp_path / "D.dat"
+    path.write_text("".join(l + "\n" for l in d_raw))
+    ms = 30.0 / len(d_raw)
+
+    cfg = MinerConfig(
+        min_support=ms, engine="level", ingest_pipeline_blocks=4,
+        ingest_threads=2, tail_fuse_rows=65536,
+        # Row-budget floor so the fold's pow2 budget covers this
+        # lattice's mid-peak (924 rows at k=6) from the k=3 seed — the
+        # webdocs-shaped analog of folding near the peak.
+        min_prefix_bucket=2048,
+    )
+    miner = FastApriori(config=cfg, context=DeviceContext(num_devices=1))
+    lv, d = miner.run_file_raw(str(path))
+    assert len(lv) >= 8, "not webdocs-shaped: lattice too shallow"
+
+    lev = {
+        r.get("k"): r
+        for r in miner.metrics.records
+        if r.get("event") == "level"
+    }
+    assert lev[2].get("overlapped") and lev[2].get("dispatches") == 0
+    assert lev[3].get("overlapped") and lev[3].get("dispatches") == 0
+    disp = _mine_loop_dispatches(miner.metrics.records)
+    assert disp <= 5, f"mining loop used {disp} dispatches (budget 5)"
+
+    expected, _, _ = oracle.mine(tokenized(d_raw), ms)
+    got = miner._decode_levels(lv, d)
+    assert dict(got) == dict(expected)
+    assert len(got) == len(expected)
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="native extension not built"
+)
+def test_pair_l3_overflow_falls_back_and_records_budget(tmp_path):
+    """A pair_l3 budget below the true level-3 survivor count must fall
+    back to the classic level-3 dispatch (exact results) and record the
+    grown budgets so a repeat run folds."""
+    d_raw = random_dataset(23, n_txns=400, n_items=16, max_len=10)
+    path = tmp_path / "D.dat"
+    path.write_text("".join(l + "\n" for l in d_raw))
+
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    ctx = DeviceContext(num_devices=1)
+    cfg = MinerConfig(
+        min_support=0.03, engine="level", ingest_pipeline_blocks=2,
+        ingest_threads=1, pair_l3_cap=4,  # far below the survivor count
+    )
+    miner = FastApriori(config=cfg, context=ctx)
+    lv, d = miner.run_file_raw(str(path))
+    lev3 = [
+        r for r in miner.metrics.records
+        if r.get("event") == "level" and r.get("k") == 3
+    ]
+    assert lev3 and not lev3[0].get("overlapped")  # classic dispatch ran
+
+    lv2, _ = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", ingest_pipeline_blocks=1
+        ),
+        context=DeviceContext(num_devices=1),
+    ).run_file_raw(str(path))
+    assert len(lv) == len(lv2)
+    for (a, ca), (b, cb) in zip(lv, lv2):
+        assert (a == b).all() and (ca == cb).all()
+
+    # The grown cap3 was recorded: the repeat run folds level 3.
+    miner2 = FastApriori(config=cfg, context=ctx)
+    lv3_run, _ = miner2.run_file_raw(str(path))
+    lev3b = [
+        r for r in miner2.metrics.records
+        if r.get("event") == "level" and r.get("k") == 3
+    ]
+    assert lev3b and lev3b[0].get("overlapped")
+    for (a, ca), (b, cb) in zip(lv3_run, lv2):
+        assert (a == b).all() and (ca == cb).all()
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="native extension not built"
+)
+@pytest.mark.parametrize("n_threads", [2, 3])
+def test_capture_ingest_threaded_matches_serial(tmp_path, n_threads):
+    """The parallel segmented pass-1 capture + threaded pass-2 replay
+    (native/preprocess.cc, VERDICT r5 next #3) must mine byte-identically
+    to the serial capture path: same global tables, same levels (weighted
+    counts are block-structure-invariant)."""
+    d_raw = (
+        ["4 7 9 11"] * 140  # heavy rows cross the w>=128 split
+        + random_dataset(37, n_txns=500, n_items=20, max_len=9)
+        + ["", "  "] * 10  # edge lines
+    )
+    path = tmp_path / "D.dat"
+    path.write_text("".join(l + "\n" for l in d_raw))
+
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    def mine(threads):
+        cfg = MinerConfig(
+            min_support=0.03, engine="level", ingest_pipeline_blocks=4,
+            ingest_threads=threads,
+        )
+        m = FastApriori(config=cfg, context=DeviceContext(num_devices=1))
+        return m.run_file_raw(str(path)), m
+
+    (lv1, d1), _ = mine(1)
+    (lvN, dN), miner = mine(n_threads)
+    pre = [
+        r for r in miner.metrics.records if r.get("event") == "preprocess"
+    ]
+    assert pre and pre[0].get("threads") == n_threads
+    assert d1.n_raw == dN.n_raw and d1.min_count == dN.min_count
+    assert d1.freq_items == dN.freq_items
+    assert (d1.item_counts == dN.item_counts).all()
+    assert d1.weights.sum() == dN.weights.sum()
+    assert len(lv1) == len(lvN)
+    for (a, ca), (b, cb) in zip(lv1, lvN):
+        assert (a == b).all() and (ca == cb).all()
+
+
+def test_ingest_threads_env_override(monkeypatch):
+    """FA_INGEST_THREADS overrides the config; typos are InputError
+    (strict parse, like FA_NO_PALLAS)."""
+    from fastapriori_tpu.errors import InputError
+    from fastapriori_tpu.preprocess import ingest_thread_count
+
+    monkeypatch.delenv("FA_INGEST_THREADS", raising=False)
+    assert ingest_thread_count(3) == 3
+    assert ingest_thread_count(None) >= 1
+    monkeypatch.setenv("FA_INGEST_THREADS", "5")
+    assert ingest_thread_count(3) == 5
+    for bad in ("zero", "0", "-2", "1.5"):
+        monkeypatch.setenv("FA_INGEST_THREADS", bad)
+        with pytest.raises(InputError, match="FA_INGEST_THREADS"):
+            ingest_thread_count(None)
+
+
+def test_tail_entry_near_peak_gate():
+    """The lowered tail-fold entry (ISSUE 3): shrinking or near-peak
+    (<= 20% growth) seeds enter; a still-doubling mid-lattice does not."""
+    ok = FastApriori._tail_entry_ok
+    assert ok(False, 50_000, None)  # explicit rows: always
+    assert ok(True, 16_384, None)  # legacy small-seed bar
+    assert not ok(True, 20_000, None)  # big seed, no evidence
+    assert ok(True, 20_000, 25_000)  # shrinking
+    assert ok(True, 24_000, 20_000)  # near-peak: +20%
+    assert not ok(True, 30_000, 20_000)  # still growing fast

@@ -662,3 +662,96 @@ def test_native_load_failpoint_degrades_to_python_path():
         assert loader.get_lib() is not None
     finally:
         loader._lib = old
+
+
+# ---------------------------------------------------------------------------
+# r6 async-fetch drain path (ISSUE 3): FA_FAILPOINTS through drain.counts
+
+
+def test_drain_bounded_pending_and_bit_exact():
+    """A tiny pending-byte budget forces mid-mine drains (counts_drain
+    events, one dispatch each); the resolved counts must stay bit-exact
+    vs the unbounded run."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    miner = FastApriori(
+        config=_mine_config(pending_fetch_budget_bytes=1)
+    )
+    got = miner.run(txns)[0]
+    drains = [
+        r for r in miner.metrics.records if r.get("event") == "counts_drain"
+    ]
+    assert drains, "tiny budget fired no drain"
+    assert all(r.get("dispatches") == 1 for r in drains)
+    assert sorted(got) == sorted(clean)
+
+
+def test_drain_async_fetch_transient_retried():
+    """A transient failure on the drain's async fetch consume
+    (fetch.counts_drain) is absorbed by the retry policy and recorded."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.counts_drain", "oom*1")
+    miner = FastApriori(
+        config=_mine_config(pending_fetch_budget_bytes=1)
+    )
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    retries = [e for e in ledger.snapshot() if e["kind"] == "retry"]
+    assert retries and retries[0]["site"] == "fetch.counts_drain"
+
+
+def test_drain_delay_failpoint_only_slows():
+    """FA_FAILPOINTS delay@MS at the drain site is a pure slow-link
+    simulation: results stay exact."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    failpoints.arm("drain.counts", "delay@30")
+    got = FastApriori(
+        config=_mine_config(pending_fetch_budget_bytes=1)
+    ).run(txns)[0]
+    assert sorted(got) == sorted(clean)
+
+
+def test_kill_mid_drain_then_resume_bit_exact(tmp_path):
+    """Acceptance (ISSUE 3 satellite): kill mid-drain → --resume-from a
+    checkpoint still produces byte-identical output, with the drain path
+    active during the resumed mine too."""
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+    clean_sets, _, clean_items = FastApriori(config=_mine_config()).run(txns)
+
+    # The durable lattice comes from a checkpointed twin killed after
+    # level 3 (checkpointing forces eager counts, so the drain path is
+    # deferred-only by design)...
+    failpoints.arm("level.3", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        FastApriori(config=_mine_config(checkpoint_prefix=prefix)).run(txns)
+    failpoints.disarm_all()
+
+    # ...a plain (deferred) run dies mid-drain...
+    failpoints.arm("drain.counts", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        FastApriori(
+            config=_mine_config(pending_fetch_budget_bytes=1)
+        ).run(txns)
+    failpoints.disarm_all()
+
+    # ...and the --resume-from restart (drains still armed by the tiny
+    # budget) is byte-identical.
+    levels, meta = ckpt.load_checkpoint(prefix)
+    resumed = FastApriori(
+        config=_mine_config(pending_fetch_budget_bytes=1)
+    )
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    got_sets, _, got_items = resumed.run(txns)
+    assert got_items == clean_items
+    assert sorted(got_sets) == sorted(clean_sets)
+    out_a, out_b = str(tmp_path / "a_"), str(tmp_path / "b_")
+    writer.save_freq_itemsets(out_a, clean_sets, clean_items)
+    writer.save_freq_itemsets(out_b, got_sets, got_items)
+    assert (
+        open(out_a + "freqItemset", "rb").read()
+        == open(out_b + "freqItemset", "rb").read()
+    )

@@ -87,8 +87,12 @@ def render(parsed: dict) -> str:
             ("pair_ms", "pair fetch (level 2; Gram rode the ingest)"),
             ("levels_total_ms", "levels 3+ total"),
             ("tail_fuse_ms", "tail fold"),
+            ("counts_resolve_ms", "end-of-mine count resolve"),
+            ("drain_ms", "mid-mine pending drains"),
             ("cold_s", "cold (compile cache state disclosed in record)"),
-            ("dispatches", "device phases per mine"),
+            ("dispatches", "mining-loop device dispatches"),
+            ("ingest_dispatches", "ingest-overlapped dispatches (pair+L3)"),
+            ("threads", "ingest threads"),
         )
         for key, label in keys:
             if key in ph:
@@ -130,9 +134,29 @@ def render(parsed: dict) -> str:
 
 
 def main() -> int:
-    with open(sys.argv[1]) as fh:
+    import os
+
+    path = sys.argv[1]
+    with open(path) as fh:
         rec = json.load(fh)
-    parsed = rec.get("parsed", rec)
+    parsed = rec.get("parsed") or rec
+    # Since r6 the driver-parsed line is COMPACT and points at the full
+    # record via record_file (relative to the repo root) — follow it so
+    # `baseline_from_record.py BENCH_r06.json` still renders the full
+    # table mechanically.
+    rf = parsed.get("record_file")
+    if rf:
+        full = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, rf)
+        if os.path.exists(full):
+            with open(full) as fh:
+                parsed = json.load(fh)
+        else:
+            print(
+                f"note: record_file {rf!r} not found next to the repo; "
+                "rendering the compact fields only",
+                file=sys.stderr,
+            )
     print(render(parsed))
     return 0
 

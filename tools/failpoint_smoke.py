@@ -83,7 +83,12 @@ def main_smoke() -> None:
         out_flaky = os.path.join(root, "flaky") + os.sep
         os.makedirs(out_flaky)
         ledger.reset()
+        # The pair fetch's site depends on the ingest flavor: the
+        # pipelined capture ingest (native, any thread count since r6)
+        # fetches the overlapped pair program at fetch.pair_pre; the
+        # classic flow at fetch.pair.  Arm both — exactly one fires.
         failpoints.arm("fetch.pair", "oom*1")
+        failpoints.arm("fetch.pair_pre", "oom*1")
         failpoints.arm("fetch.counts", "delay@5")
         if run([inp, out_flaky, "--min-support", "0.08",
                 "--engine", "level"]) != 0:

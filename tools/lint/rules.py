@@ -118,6 +118,16 @@ class HostSyncRule(Rule):
     # host-side data (e.g. a Python list of Device handles), not a device
     # array — a false-positive suppression, not a fetch audit.
     aliases = ("fetch-site", "host-data")
+
+    # The reliability layer's audited fetch helpers
+    # (fastapriori_tpu/reliability/retry.py): a sync call nested inside
+    # their arguments IS the audited site — the helper failpoint-
+    # instruments and retry-wraps it under the string label it takes —
+    # so it needs no inline `# lint: fetch-site` waiver.  Recognized by
+    # terminal name + a string site-label argument, so `retry.fetch`,
+    # `fetch`, and `fetch_async` spellings all count while an unrelated
+    # local `fetch()` without a label does not.
+    _FETCH_HELPERS = {"fetch", "fetch_async"}
     # Path substrings where ALL host fetches need an audit waiver, not
     # just those inside traced functions: the mesh layer, and the engine
     # layer's level loop (its np.asarray sites are the mining phase's
@@ -179,19 +189,84 @@ class HostSyncRule(Rule):
                     )
         if not any(d in ctx.path for d in self.fetch_audit_dirs):
             return
+        audited = self._helper_audited_calls(ctx)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
             if node.lineno in traced_lines:
                 continue  # already reported above
+            if id(node) in audited:
+                continue  # inside retry.fetch/fetch_async: audited there
             reason = self._sync_call_reason(node)
             if reason is not None:
                 yield self.finding(
                     ctx,
                     node,
                     f"device fetch in the mesh layer ({reason}); annotate "
-                    "the audited site with `# lint: fetch-site -- why`",
+                    "the audited site with `# lint: fetch-site -- why` or "
+                    "route it through retry.fetch/fetch_async",
                 )
+
+    _RETRY_MODULE = "fastapriori_tpu.reliability.retry"
+
+    def _retry_helper_names(self, ctx) -> Set[str]:
+        """Spellings of the audited helpers that provably resolve to the
+        reliability module IN THIS FILE: bare names imported from it
+        (``from ...retry import fetch_async``) plus the dotted
+        ``retry.fetch`` / ``retry.fetch_async`` forms when ``retry`` is
+        imported from the reliability package.  An unrelated local
+        ``fetch(...)`` (a cache API, a kwarg) must NOT exempt the device
+        sync nested in its arguments."""
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module == self._RETRY_MODULE:
+                    for a in node.names:
+                        if a.name in self._FETCH_HELPERS:
+                            names.add(a.asname or a.name)
+                elif node.module == "fastapriori_tpu.reliability":
+                    for a in node.names:
+                        if a.name == "retry":
+                            ref = a.asname or a.name
+                            names.update(
+                                f"{ref}.{h}" for h in self._FETCH_HELPERS
+                            )
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == self._RETRY_MODULE:
+                        ref = a.asname or a.name
+                        names.update(
+                            f"{ref}.{h}" for h in self._FETCH_HELPERS
+                        )
+        return names
+
+    def _helper_audited_calls(self, ctx) -> Set[int]:
+        """``id()``s of Call nodes nested inside an argument of an
+        audited-fetch-helper call (``retry.fetch(lambda: np.asarray(x),
+        "site")`` / ``retry.fetch_async(arr, "site")``) — helpers are
+        matched by their RESOLVED reliability-module spelling
+        (:meth:`_retry_helper_names`), with a string site label."""
+        helper_names = self._retry_helper_names(ctx)
+        if not helper_names:
+            return set()
+        out: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d not in helper_names:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if not any(
+                isinstance(a, ast.Constant) and isinstance(a.value, str)
+                for a in args
+            ):
+                continue  # no site label: not the audited helper shape
+            for a in args:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Call):
+                        out.add(id(sub))
+        return out
 
 
 class CollectiveAxisRule(Rule):
