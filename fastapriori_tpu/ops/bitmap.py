@@ -28,6 +28,16 @@ def pad_axis(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the ONE definition of the
+    shape-bucketing helper (compiled shapes round to pow2 buckets
+    across the engines; four private copies had grown by PR 4)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 def build_bitmap(
     baskets: Sequence[np.ndarray],
     num_items: int,

@@ -176,3 +176,230 @@ def make_sharded_first_match_scan(mesh: Mesh, chunk: int):
             out_specs=(P(AXIS), P()),
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Device-resident rule generation (reference C11's level-wise subset joins,
+# AssociationRules.scala:122-188, reformulated as packed-key layouts and
+# batched sorted-key gathers — the transposition "A New Data Layout For Set
+# Intersection on GPUs" applies to set containment, PAPERS.md).
+#
+# The host formulation (rules/gen.py) joins each k-itemset's k deleted-column
+# antecedents against the sorted (k-1)-itemset key table with numpy
+# searchsorted — 13.6-19.3 s of host wall for 16.34M rules at webdocs scale
+# (VERDICT r5 weak #8).  Here the same join runs on device: row keys pack
+# into uint32 LANES (no 64-bit device dtypes — jax_enable_x64 stays off, the
+# repo-wide G004 contract), the parent table is sorted once per level with
+# `lax.sort` (multi-operand lexicographic), all k column deletions of a level
+# batch into ONE dispatch, and the dominance prune's confidence comparisons
+# run as exact 48-bit rational compares (see `frac_less24`).
+
+
+def rule_key_bits(f: int) -> int:
+    """Bits per item rank in the packed row keys (rules/gen.py `_row_keys`
+    uses the same widths for its uint64 host keys)."""
+    return 8 if f <= 256 else (16 if f <= 65536 else 32)
+
+
+def pack_rank_keys(mat: jnp.ndarray, bits: int) -> list:
+    """Pack int32 [N, w] sorted-row ranks into ``ceil(w*bits/32)`` uint32
+    key columns, left-aligned so lexicographic order over the column tuple
+    equals lexicographic row order (the host packs the same fields into
+    one uint64; the device splits them across 32-bit lanes because 64-bit
+    dtypes silently downcast while jax_enable_x64 is off)."""
+    n, w = mat.shape
+    per = 32 // bits
+    m = mat.astype(jnp.uint32)
+    cols = []
+    for ci in range(-(-w // per)):
+        acc = None
+        for j in range(per):
+            pos = ci * per + j
+            if pos >= w:
+                break
+            part = m[:, pos] << ((per - 1 - j) * bits)
+            acc = part if acc is None else acc | part
+        cols.append(acc)
+    return cols
+
+
+def lex_searchsorted(
+    sorted_cols, n_real: jnp.ndarray, query_cols, n_iters: int
+) -> jnp.ndarray:
+    """Left insertion point of each query row in a lexicographically
+    sorted multi-column uint32 key table — a vectorized binary search
+    (``n_iters`` static gather/compare rounds over all queries at once),
+    bounded by the TRACED real row count so pow2-padded tables need no
+    sentinel discipline."""
+    m = query_cols[0].shape[0]
+    lo0 = jnp.zeros(m, jnp.int32)
+    hi0 = jnp.broadcast_to(n_real.astype(jnp.int32), (m,))
+
+    def body(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        lt = jnp.zeros(m, bool)
+        eq = jnp.ones(m, bool)
+        for sc, qc in zip(sorted_cols, query_cols):
+            v = jnp.take(sc, mid)
+            lt = lt | (eq & (v < qc))
+            eq = eq & (v == qc)
+        active = lo < hi
+        lo = jnp.where(active & lt, mid + 1, lo)
+        hi = jnp.where(active & ~lt, mid, hi)
+        return lo, hi
+
+    lo, _ = lax.fori_loop(0, n_iters, body, (lo0, hi0))
+    return lo
+
+
+def _mul24_wide(a: jnp.ndarray, b: jnp.ndarray):
+    """Exact 48-bit product of two uint32 values < 2^24 as a (hi, lo)
+    uint32 pair — 16-bit-limb schoolbook multiply (no 64-bit dtypes on
+    device).  Bounds: a0,b0 < 2^16 and a1,b1 < 2^8, so every partial
+    product and the limb sum fit uint32 exactly; only the final lo add
+    can wrap, and its carry is recovered by comparison."""
+    a0, a1 = a & 0xFFFF, a >> 16
+    b0, b1 = b & 0xFFFF, b >> 16
+    p00 = a0 * b0
+    mid = a0 * b1 + a1 * b0  # < 2^25: no wrap
+    t = (mid & 0xFFFF) << 16
+    lo = p00 + t
+    carry = (lo < p00).astype(jnp.uint32)
+    hi = a1 * b1 + (mid >> 16) + carry
+    return hi, lo
+
+
+def frac_less24(pn, pd, cn, cd) -> jnp.ndarray:
+    """``pn/pd < cn/cd`` for positive int counts < 2^24, EXACTLY matching
+    the host's IEEE-double comparison (rules/gen.py compares f64
+    confidences, like the reference's JVM doubles).  Equivalence: with
+    denominators < 2^24 two distinct rationals in (0, 1] differ by at
+    least 1/(pd·cd) > 2^-48, while doubles at or below 1.0 are spaced at
+    most 2^-53 — distinct rationals therefore round to distinct doubles
+    and the double order IS the rational order, so the exact cross
+    product compare (48-bit, `_mul24_wide`) reproduces it bit-for-bit.
+    Callers gate the device path on counts < 2^24 (rules/gen.py)."""
+    h1, l1 = _mul24_wide(pn.astype(jnp.uint32), cd.astype(jnp.uint32))
+    h2, l2 = _mul24_wide(cn.astype(jnp.uint32), pd.astype(jnp.uint32))
+    return (h1 < h2) | ((h1 == h2) & (l1 < l2))
+
+
+def rule_level_kernel(
+    mat: jnp.ndarray,  # [N_pad, k] int32 lex-sorted k-itemset rows
+    cnts: jnp.ndarray,  # [N_pad] int32 itemset counts (< 2^24, gated)
+    n_real: jnp.ndarray,  # () int32 — real row count (pow2 row padding)
+    psorted,  # tuple of [Np_pad] uint32 — parent sorted key columns
+    porder: jnp.ndarray,  # [Np_pad] int32 — parent sort order (row ids)
+    pcnts: jnp.ndarray,  # [Np_pad] int32 — (k-1)-itemset counts
+    np_real: jnp.ndarray,  # () int32 — real parent rows
+    prev_surv: jnp.ndarray,  # [(k-1)*Np_pad] bool — parent-RULE survival
+    prev_d: jnp.ndarray,  # [(k-1)*Np_pad] int32 — parent-rule denominators
+    *,
+    k: int,
+    bits: int,
+    first: bool,
+):
+    """One level's raw rule generation + dominance prune in ONE dispatch
+    (all k column deletions batched): the k→(k-1) antecedent lookups as
+    packed-key binary searches over the resident sorted parent table,
+    then the reference's "cut leaves" prune (AssociationRules.scala:
+    147-182) as flat gathers into the previous level's device-resident
+    survival/denominator arrays — rule (S-{e}→S[j]) survives iff each
+    parent rule (S-{e,x}→S[j]) survived with strictly lower confidence,
+    compared exactly (`frac_less24`).
+
+    ``first`` statically marks the k=2 base level: its parents are the
+    1-itemsets (an identity table — the deleted single-column rows ARE
+    the parent row indexes, no search), and every found rule survives
+    (the reference's base case, :173).
+
+    Returns ``(packed, skeys, order, d_flat, surv_flat)``: ``packed`` is
+    the ONE host-bound array — the j-major survivor bitmask plus a
+    4-byte little-endian count of unmatched antecedents (downward-
+    closure violations; the host raises InputError) — while ``skeys``/
+    ``order`` (this table's sorted keys, the next level's parent) and
+    ``d_flat``/``surv_flat`` (this level's rule denominators/survival,
+    the next level's prune inputs) stay device-resident."""
+    from fastapriori_tpu.ops.count import pack_bits_msb
+
+    n_pad = mat.shape[0]
+    valid = jnp.arange(n_pad, dtype=jnp.int32) < n_real.astype(jnp.int32)
+    if first:
+        # k == 2: parent table is the 1-itemset arange — delete column j
+        # and the remaining rank IS the parent row index.
+        rows = jnp.stack([mat[:, 1], mat[:, 0]])
+        found = jnp.broadcast_to(valid[None, :], (k, n_pad))
+    else:
+        np_pad = porder.shape[0]
+        dels = [
+            jnp.concatenate([mat[:, :j], mat[:, j + 1 :]], axis=1)
+            for j in range(k)
+        ]
+        packed_q = [pack_rank_keys(d, bits) for d in dels]
+        n_cols = len(packed_q[0])
+        flat_q = [
+            jnp.stack([packed_q[j][ci] for j in range(k)]).reshape(-1)
+            for ci in range(n_cols)
+        ]
+        # np_pad is a static Python shape int, so the iteration count is
+        # compile-time constant.
+        pos = lex_searchsorted(
+            psorted, np_real, flat_q, np_pad.bit_length() + 1
+        )
+        safe = jnp.clip(pos, 0, jnp.maximum(np_real - 1, 0))
+        eq = pos < np_real
+        for sc, qc in zip(psorted, flat_q):
+            eq = eq & (jnp.take(sc, safe) == qc)
+        found = eq.reshape(k, n_pad) & valid[None, :]
+        rows = jnp.take(porder, safe).reshape(k, n_pad)
+    # Denominators: count(S - {e}) per deleted column — ALSO each parent
+    # rule's numerator (the prune below reuses the same gather).
+    d = jnp.take(pcnts, rows.reshape(-1)).reshape(k, n_pad)
+    miss = jnp.sum(valid[None, :] & ~found, dtype=jnp.int32)
+    if first:
+        ok = found  # base case: every min-size rule survives (:173)
+    else:
+        np_pad = porder.shape[0]
+        oks = []
+        for j in range(k):
+            ok_j = found[j]
+            for e in range(k):
+                if e == j:
+                    continue
+                # Parent rule (S-{e}) -> S[j]: the consequent position
+                # shifts down when the deleted column precedes it
+                # (rules/gen.py uses the same flat addressing).
+                jp = j - (e < j)
+                pidx = jp * np_pad + rows[e]
+                ok_j = (
+                    ok_j
+                    & jnp.take(prev_surv, pidx)
+                    & frac_less24(d[e], jnp.take(prev_d, pidx), cnts, d[j])
+                )
+            oks.append(ok_j)
+        ok = jnp.stack(oks)
+    surv_flat = ok.reshape(-1)
+    d_flat = d.reshape(-1)
+    miss_u = miss.astype(jnp.uint32)
+    packed = jnp.concatenate(
+        [
+            pack_bits_msb(surv_flat),
+            jnp.stack(
+                [(miss_u >> (8 * i)) & 0xFF for i in range(4)]
+            ).astype(jnp.uint8),
+        ]
+    )
+    # This table's sorted keys feed the NEXT level's search; pow2 row
+    # padding sorts to the tail via the all-ones sentinel (real keys can
+    # never be all-ones: ranks within a row strictly increase, and
+    # left-aligned packing zero-fills any unused low bits).
+    scols = [
+        jnp.where(valid, c, jnp.uint32(0xFFFFFFFF))
+        for c in pack_rank_keys(mat, bits)
+    ]
+    srt = lax.sort(
+        tuple(scols) + (jnp.arange(n_pad, dtype=jnp.int32),),
+        num_keys=len(scols),
+    )
+    return packed, tuple(srt[:-1]), srt[-1], d_flat, surv_flat

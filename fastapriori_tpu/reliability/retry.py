@@ -21,12 +21,17 @@ Classification contract:
 Backoff is deterministic (exponential, no jitter): reproducibility is
 worth more here than thundering-herd protection — there is exactly one
 host per device link.
+
+The policy is tunable per process via ``FA_RETRY_MAX`` /
+``FA_RETRY_BACKOFF_MS`` (strictly parsed — :func:`policy_from_env`);
+explicit ``policy=`` arguments still win at individual call sites.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import errno
+import os
 import time
 from typing import Callable, Optional, Tuple, TypeVar
 
@@ -120,6 +125,64 @@ class RetryPolicy:
 
 DEFAULT_POLICY = RetryPolicy()
 
+_env_policy: Optional[RetryPolicy] = None
+
+
+def policy_from_env() -> RetryPolicy:
+    """The process-wide retry policy, with the ops knobs applied:
+    ``FA_RETRY_MAX`` (attempt bound incl. the first try, >= 1) and
+    ``FA_RETRY_BACKOFF_MS`` (base backoff in milliseconds, >= 0) —
+    surfaced as environment variables instead of module constants
+    (ROADMAP reliability follow-up) and STRICTLY parsed like
+    ``FA_NO_PALLAS``: a typo'd value silently running the default policy
+    on a flaky link is exactly the invisible-degradation class the
+    ledger exists to kill, so malformed values raise
+    :class:`~fastapriori_tpu.errors.InputError` at the first retryable
+    call.  Parsed once per process; tests use
+    :func:`reload_policy_from_env`."""
+    global _env_policy
+    if _env_policy is not None:
+        return _env_policy
+    kw = {}
+    raw = os.environ.get("FA_RETRY_MAX", "").strip()
+    if raw:
+        try:
+            val = int(raw)
+        except ValueError:
+            raise InputError(
+                f"unrecognized FA_RETRY_MAX value {raw!r}: expected an "
+                "integer >= 1 (attempts including the first try)"
+            ) from None
+        if val < 1:
+            raise InputError(
+                f"FA_RETRY_MAX={val} is out of range: at least 1 attempt "
+                "(the first try) is required"
+            )
+        kw["max_attempts"] = val
+    raw = os.environ.get("FA_RETRY_BACKOFF_MS", "").strip()
+    if raw:
+        try:
+            ms = float(raw)
+        except ValueError:
+            raise InputError(
+                f"unrecognized FA_RETRY_BACKOFF_MS value {raw!r}: "
+                "expected a number of milliseconds >= 0"
+            ) from None
+        if ms < 0:
+            raise InputError(
+                f"FA_RETRY_BACKOFF_MS={ms} is out of range: backoff "
+                "cannot be negative"
+            )
+        kw["base_delay_s"] = ms / 1e3
+    _env_policy = RetryPolicy(**kw) if kw else DEFAULT_POLICY
+    return _env_policy
+
+
+def reload_policy_from_env() -> None:
+    """Re-read the FA_RETRY_* knobs (tests; otherwise read once)."""
+    global _env_policy
+    _env_policy = None
+
 
 def call_with_retries(
     thunk: Callable[[], T],
@@ -134,7 +197,7 @@ def call_with_retries(
     errors — and exhaustion — re-raise unchanged."""
     from fastapriori_tpu.reliability import ledger
 
-    policy = policy or DEFAULT_POLICY
+    policy = policy or policy_from_env()
     attempt = 0
     while True:
         try:

@@ -23,13 +23,107 @@ on the JVM, so the >=-comparisons agree bit-for-bit with the reference.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.ops.bitmap import next_pow2 as _next_pow2
+from fastapriori_tpu.reliability import ledger, retry
 
 Rule = Tuple[FrozenSet[int], int, float]  # (antecedent, consequent, confidence)
+
+_RULE_ENGINES = ("auto", "host", "device")
+
+
+def rule_engine_from_env() -> Optional[str]:
+    """Strictly parsed ``FA_RULE_ENGINE`` override (host/device/auto) —
+    a typo'd value silently running the wrong engine would be invisible
+    in a record, so unknown spellings raise
+    :class:`~fastapriori_tpu.errors.InputError` (the FA_NO_PALLAS
+    contract).  None = unset, use the config."""
+    raw = os.environ.get("FA_RULE_ENGINE", "")
+    val = raw.strip().lower()
+    if not val:
+        return None
+    if val in _RULE_ENGINES:
+        return val
+    raise InputError(
+        f"unrecognized FA_RULE_ENGINE value {raw!r}: use one of "
+        f"{'/'.join(_RULE_ENGINES)} (or unset to follow "
+        "MinerConfig.rule_engine)"
+    )
+
+
+# Exact-compare gate for the device path: the dominance prune compares
+# IEEE-double confidences on the host; the device reproduces that order
+# with exact 48-bit rational compares, which is bit-equivalent ONLY while
+# every count is < 2^24 (ops/contain.py frac_less24's spacing argument).
+_DEVICE_COUNT_CAP = 1 << 24
+
+
+def _raw_rule_count(mats: Dict[int, Tuple[np.ndarray, np.ndarray]]) -> int:
+    """Raw (pre-prune) rule count: every k-itemset emits k rules."""
+    return sum(
+        k * mat.shape[0] for k, (mat, _) in mats.items() if k >= 2
+    )
+
+
+def _max_count(mats: Dict[int, Tuple[np.ndarray, np.ndarray]]) -> int:
+    return max(
+        (int(c.max()) for _, c in mats.values() if c.size), default=0
+    )
+
+
+def _pick_rule_engine(mats, context, config) -> str:
+    """Resolve the phase-2 engine (config.rule_engine / FA_RULE_ENGINE):
+    the device path needs a context and exact-compare-safe counts, and
+    under "auto" must also clear the size floor (device wins only on big
+    levels — per-level dispatches and table uploads carry fixed cost)
+    and a real accelerator.  The choice — and every forced-device
+    fallback — is recorded in the degradation ledger so a record shows
+    WHICH engine produced its rules (ISSUE 4)."""
+    engine = rule_engine_from_env()
+    if engine is None:
+        engine = getattr(config, "rule_engine", "auto") if config else "auto"
+        if engine not in _RULE_ENGINES:
+            # The config field gets the same strictness as the env var —
+            # a typo silently forcing the device engine is the exact
+            # failure mode the FA_NO_PALLAS contract exists to kill.
+            raise InputError(
+                f"unrecognized MinerConfig.rule_engine value {engine!r}: "
+                f"use one of {'/'.join(_RULE_ENGINES)}"
+            )
+    if engine == "host":
+        return "host"
+    raw = _raw_rule_count(mats)
+    if context is None:
+        if engine == "device":
+            ledger.record(
+                "rule_gen_fallback", reason="no_device_context", raw_rules=raw
+            )
+        return "host"
+    if _max_count(mats) >= _DEVICE_COUNT_CAP:
+        if engine == "device":
+            ledger.record(
+                "rule_gen_fallback",
+                reason="counts_exceed_2^24",
+                raw_rules=raw,
+            )
+        return "host"
+    if engine == "auto":
+        floor = (
+            getattr(config, "rule_device_min_rules", 1 << 21)
+            if config
+            else 1 << 21
+        )
+        if raw < floor or context.platform == "cpu":
+            return "host"
+    ledger.record(
+        "rule_gen_engine", once_key="device", engine="device", raw_rules=raw
+    )
+    return "device"
 
 
 def _rows_view(m: np.ndarray) -> np.ndarray:
@@ -138,11 +232,13 @@ def _level_tables(
     mats: Dict[int, Tuple[np.ndarray, np.ndarray]] = {
         1: (
             np.arange(len(item_counts), dtype=np.int32)[:, None],
+            # lint: host-data -- item counts are host numpy/lists
             np.asarray(item_counts, dtype=np.int64),
         )
     }
     for mat, cnts in levels:
         if mat.shape[0]:
+            # lint: host-data -- level matrices are host numpy by here
             mats[mat.shape[1]] = (mat, np.asarray(cnts, dtype=np.int64))
     return mats
 
@@ -160,13 +256,34 @@ RuleArrays = Tuple[np.ndarray, np.ndarray, np.ndarray]  # ant [N,w], cons, conf
 
 
 def rule_arrays_from_tables(
-    mats: Dict[int, Tuple[np.ndarray, np.ndarray]]
+    mats: Dict[int, Tuple[np.ndarray, np.ndarray]],
+    context=None,
+    config=None,
+    metrics=None,
 ) -> List[RuleArrays]:
     """Matrix-form rule generation + dominance prune: surviving rules as
     ``(antecedent int32 [N, w], consequent int32 [N], confidence f64
     [N])`` per antecedent size, in the same order the object form emits
     — NO per-rule Python objects (materializing 16M frozensets at
-    webdocs/minSupport=0.092 scale cost ~140 s by itself)."""
+    webdocs/minSupport=0.092 scale cost ~140 s by itself).
+
+    ``context``/``config`` opt the call into the DEVICE engine
+    (:func:`_pick_rule_engine`; config.rule_engine / FA_RULE_ENGINE):
+    the level-wise subset joins and the dominance prune run as packed-
+    key sorted gathers on the accelerator (ops/contain.py
+    rule_level_kernel, one dispatch per level), bit-identical to this
+    host path — which remains the differential oracle and the automatic
+    fallback below the size threshold."""
+    engine = _pick_rule_engine(mats, context, config)
+    if engine == "device":
+        return _rule_arrays_device(mats, context, metrics)
+    return _rule_arrays_host(mats)
+
+
+def _rule_arrays_host(
+    mats: Dict[int, Tuple[np.ndarray, np.ndarray]]
+) -> List[RuleArrays]:
+    """The numpy engine (see :func:`rule_arrays_from_tables`)."""
     # Raw rules (S - {i}) -> i with confidence count(S)/count(S - {i})
     # (:129-145); the size-1 denominator is the raw occurrence count, via
     # the 1-itemset table.  Downward closure guarantees every antecedent
@@ -213,6 +330,7 @@ def rule_arrays_from_tables(
             keys = dk[:, j] if dk is not None else _row_keys(ant, f)
             idx, found = _lookup_rows(psorted, porder, keys)
             if not found.all():
+                # lint: host-data -- host numpy row in the error message
                 bad = frozenset(ant[int(np.argmin(found))].tolist())
                 raise InputError(
                     f"itemset table is not downward-closed: antecedent "
@@ -294,6 +412,183 @@ def rule_arrays_from_tables(
     return out
 
 
+def _closure_error(k: int) -> InputError:
+    return InputError(
+        f"itemset table is not downward-closed: {k}-itemsets are "
+        f"present but no {k - 1}-itemsets exist to serve as rule "
+        "antecedents — the mining output (or --resume-from "
+        "artifact) is incomplete; re-mine or re-save it"
+    )
+
+
+def _rule_arrays_device(
+    mats: Dict[int, Tuple[np.ndarray, np.ndarray]], ctx, metrics=None
+) -> List[RuleArrays]:
+    """Device engine for :func:`rule_arrays_from_tables` (ISSUE 4
+    tentpole): upload each level's itemset table ONCE, run the k→(k-1)
+    antecedent joins + dominance prune as one dispatch per level
+    (ops/contain.py rule_level_kernel — all k column deletions batched,
+    prune state device-resident between levels), fetch only the packed
+    survivor bitmasks (async, overlapping later dispatches) and the
+    surviving rules' denominators through the audited pow2-padded gather
+    path (parallel/mesh.py gather_level_counts_start).  Confidences are
+    then the SAME host f64 divisions of the same ints the host engine
+    performs — bit-identical output, pinned by the differential suite
+    (tests/test_rules_device.py)."""
+    import time
+
+    import jax.numpy as jnp
+
+    from fastapriori_tpu.ops.contain import rule_key_bits
+
+    t0 = time.perf_counter()
+    f = 1 + max(
+        (int(mat.max()) for mat, _ in mats.values() if mat.size), default=0
+    )
+    bits = rule_key_bits(f)
+    ks = sorted(k for k in mats if k >= 2)
+    if not ks:
+        return []
+    per_level: List[dict] = []
+    prev_keys = None  # (skeys tuple, order) — previous table, sorted
+    prev_cnts_dev = None  # previous level's padded counts (= pcnts)
+    prev_rules = None  # (surv_flat, d_flat) — previous RULE level
+    prev_n = 0
+    for k in ks:
+        if k - 1 not in mats:
+            raise _closure_error(k)
+        mat, cnts = mats[k]
+        n = mat.shape[0]
+        n_pad = max(8, _next_pow2(n))
+        mat_p = np.zeros((n_pad, k), np.int32)
+        mat_p[:n] = mat
+        cnts_p = np.ones(n_pad, np.int32)
+        cnts_p[:n] = cnts
+        mat_dev = ctx.device0_put(mat_p)
+        cnts_dev = ctx.device0_put(cnts_p)
+        first = k == 2
+        if first:
+            # Parents are the 1-itemsets: an identity table — the kernel
+            # skips the search, so only the counts upload is real.
+            pcnts_dev = ctx.device0_put(
+                # lint: host-data -- 1-itemset counts are host numpy
+                np.asarray(mats[1][1], dtype=np.int32)
+            )
+            dummy_u32 = jnp.zeros(1, jnp.uint32)
+            psorted = (dummy_u32,)
+            porder = jnp.zeros(1, jnp.int32)
+            prev_surv = jnp.zeros(1, bool)
+            prev_d = jnp.zeros(1, jnp.int32)
+            np_real = 0
+        else:
+            psorted, porder = prev_keys
+            pcnts_dev = prev_cnts_dev
+            prev_surv, prev_d = prev_rules
+            np_real = prev_n
+        fn = ctx.rule_level_join(k, bits, first)
+        packed, skeys, order, d_flat, surv_flat = fn(
+            mat_dev,
+            cnts_dev,
+            jnp.int32(n),
+            psorted,
+            porder,
+            pcnts_dev,
+            jnp.int32(np_real),
+            prev_surv,
+            prev_d,
+        )
+        per_level.append(
+            {
+                "k": k,
+                "n": n,
+                "n_pad": n_pad,
+                "mat": mat,
+                "cnts": cnts,
+                "d_dev": d_flat,
+                # Non-blocking audited fetch: the j-major survivor
+                # bitmask (+ 4-byte miss count) crosses the link while
+                # the next levels dispatch.
+                "fetch": retry.fetch_async(packed, "rule_mask"),
+            }
+        )
+        prev_keys = (skeys, order)
+        prev_cnts_dev = cnts_dev
+        prev_rules = (surv_flat, d_flat)
+        prev_n = n
+    dispatch_ms = (time.perf_counter() - t0) * 1e3
+
+    # Consume the masks (fetches overlapped the dispatch loop above) and
+    # collect each survivor's flat position for the ONE denominator
+    # gather dispatch + fetch (u24: counts < 2^24 by the engine gate).
+    pend = []
+    for lv in per_level:
+        out_b = lv["fetch"].result()
+        miss = int.from_bytes(out_b[-4:].tobytes(), "little")
+        if miss:
+            raise InputError(
+                f"itemset table is not downward-closed: {miss} "
+                f"antecedent(s) of the {lv['k']}-itemsets are missing "
+                "from the table — the mining output (or --resume-from "
+                "artifact) is incomplete; re-mine or re-save it"
+            )
+        surv = (
+            np.unpackbits(out_b[:-4])
+            .reshape(lv["k"], lv["n_pad"])[:, : lv["n"]]
+            .astype(bool)
+        )
+        lv["surv"] = surv
+        rows = [np.flatnonzero(surv[j]) for j in range(lv["k"])]
+        lv["rows"] = rows
+        pos = np.concatenate(
+            [j * lv["n_pad"] + r for j, r in enumerate(rows)]
+        ) if any(r.size for r in rows) else np.empty(0, np.int64)
+        pend.append((lv["d_dev"], pos))
+    have = [(d, p) for d, p in pend if p.size]
+    den = (
+        ctx.gather_level_counts_start(have, u24=True, site="rule_counts")
+        .result()
+        if have
+        else np.empty(0, np.int64)
+    )
+
+    out: List[RuleArrays] = []
+    off = 0
+    for lv in per_level:
+        k = lv["k"]
+        mat, cnts = lv["mat"], lv["cnts"]
+        cols = np.arange(k)
+        ants, conss, confs = [], [], []
+        for j in range(k):
+            rows_j = lv["rows"][j]
+            d_j = den[off : off + rows_j.size].astype(np.float64)
+            off += rows_j.size
+            ants.append(mat[np.ix_(rows_j, np.delete(cols, j))])
+            conss.append(mat[rows_j, j])
+            # The SAME f64 int division the host engine performs — the
+            # device only located the denominators.
+            confs.append(cnts[rows_j] / d_j)
+        out.append(
+            (
+                np.concatenate(ants)
+                if ants
+                else np.zeros((0, k - 1), np.int32),
+                np.concatenate(conss) if conss else np.zeros(0, np.int32),
+                np.concatenate(confs) if confs else np.zeros(0),
+            )
+        )
+    if metrics is not None:
+        metrics.emit(
+            "rule_gen_device",
+            levels=len(per_level),
+            dispatches=len(per_level) + (1 if have else 0),
+            raw_rules=_raw_rule_count(mats),
+            survivors=sum(int(c.size) for _, c, _ in out),
+            dispatch_ms=round(dispatch_ms, 1),
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 1),
+        )
+    return out
+
+
 def _rules_from_tables(
     mats: Dict[int, Tuple[np.ndarray, np.ndarray]]
 ) -> List[Rule]:
@@ -301,16 +596,26 @@ def _rules_from_tables(
     for ant, cons, conf in rule_arrays_from_tables(mats):
         out.extend(
             (frozenset(a), int(c), float(cf))
+            # lint: host-data -- survivor arrays are host numpy
             for a, c, cf in zip(ant.tolist(), cons.tolist(), conf.tolist())
         )
     return out
 
 
-def gen_rule_arrays_levels(levels, item_counts) -> List[RuleArrays]:
+def gen_rule_arrays_levels(
+    levels, item_counts, context=None, config=None, metrics=None
+) -> List[RuleArrays]:
     """Matrix-form twin of :func:`gen_rules_levels` returning survivor
     ARRAYS (see rule_arrays_from_tables) — the production recommender
-    path never builds per-rule Python objects."""
-    return rule_arrays_from_tables(_level_tables(levels, item_counts))
+    path never builds per-rule Python objects.  ``context``/``config``
+    opt into the device join engine (bit-identical; host stays the
+    oracle and the small-input fallback)."""
+    return rule_arrays_from_tables(
+        _level_tables(levels, item_counts),
+        context=context,
+        config=config,
+        metrics=metrics,
+    )
 
 
 def _consequent_priority(freq_items: Sequence[str]) -> np.ndarray:
@@ -372,6 +677,7 @@ def rule_objects_from_arrays(
     return [
         (frozenset(a[:n]), int(c), float(cf))
         for a, n, c, cf in zip(
+            # lint: host-data -- sorted rule arrays are host numpy
             ant.tolist(), lens.tolist(), cons.tolist(), conf.tolist()
         )
     ]

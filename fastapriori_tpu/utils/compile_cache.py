@@ -9,8 +9,11 @@ at a different layer: its Spark executors are long-lived JVMs that keep
 their JITted code across jobs (README.md:22-35 cluster setup).
 
 Opt-out with FA_NO_COMPILE_CACHE=1; relocate with FA_COMPILE_CACHE.
-Library imports never touch this — only the CLI/bench entry points call
-it, so embedding applications keep full control of JAX global config.
+Compile-shape logging (one stderr line per traced compile — the
+cache-miss shape signatures) is on by default here; FA_NO_COMPILE_LOG=1
+silences it.  Library imports never touch this — only the CLI/bench
+entry points call it, so embedding applications keep full control of
+JAX global config.
 """
 
 from __future__ import annotations
@@ -39,6 +42,17 @@ def enable_compile_cache() -> bool:
         # Default threshold (1 s) would skip the many ~0.5-1 s level
         # kernels that dominate a cold mining run's compile budget.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # Shape-signature logging on every compile-cache miss (VERDICT
+        # r5 next #5: 14 misses on a PRIMED cache meant data-dependent
+        # shapes were escaping the pow2-bucket discipline, invisibly):
+        # jax_log_compiles emits one stderr line per traced compile with
+        # the jaxpr's global shapes — exactly the signature needed to
+        # pin the escapee.  Entry points only (this function), opt out
+        # with FA_NO_COMPILE_LOG=1.
+        if os.environ.get("FA_NO_COMPILE_LOG", "").lower() not in (
+            "1", "true", "yes",
+        ):
+            jax.config.update("jax_log_compiles", True)
         return primed
     except (OSError, ImportError, AttributeError, ValueError, RuntimeError):
         # Cache priming is purely an optimization: an unwritable dir

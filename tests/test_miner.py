@@ -558,6 +558,46 @@ def test_ingest_threads_env_override(monkeypatch):
             ingest_thread_count(None)
 
 
+def test_tail_fold_carries_counts_resolve():
+    """ISSUE 4 satellite (ROADMAP pipeline follow-up): when the tail
+    fold finishes a mine with deferred counts pending, the end-of-mine
+    counts_resolve gather rides the SAME dispatch — the resolve event
+    still reports its own (now zero) dispatch count, and the output is
+    bit-exact vs the unfolded path."""
+    from conftest import random_dataset, tokenized
+
+    lines = tokenized(
+        ["1 2 3 4 5 6"] * 50
+        + ["1 2 3 4 5"] * 30
+        + ["2 3 4 5 6"] * 20
+        + random_dataset(5, n_txns=60, max_len=6)
+    )
+    folded = FastApriori(
+        config=MinerConfig(
+            min_support=0.05, engine="level", num_devices=1,
+            tail_fuse_rows=64,
+        )
+    )
+    got = folded.run(lines)[0]
+    tails = [
+        r for r in folded.metrics.records if r.get("event") == "tail_fuse"
+    ]
+    assert tails and tails[0].get("resolve_folded") is True
+    res = [
+        r
+        for r in folded.metrics.records
+        if r.get("event") == "counts_resolve"
+    ]
+    assert res and res[0]["dispatches"] == 0 and res[0]["drains"] == 1
+    plain = FastApriori(
+        config=MinerConfig(
+            min_support=0.05, engine="level", num_devices=1,
+            tail_fuse_rows=0,
+        )
+    )
+    assert sorted(got) == sorted(plain.run(lines)[0])
+
+
 def test_tail_entry_near_peak_gate():
     """The lowered tail-fold entry (ISSUE 3): shrinking or near-peak
     (<= 20% growth) seeds enter; a still-doubling mid-lattice does not."""

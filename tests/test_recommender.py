@@ -32,6 +32,39 @@ def test_recommender_empty_rules(tiny_u_lines):
     ) == sorted((i, "0") for i in range(len(tiny_u_lines)))
 
 
+def test_host_scan_matches_scalar_reference():
+    """The vectorized host first-match (the bench baseline since ISSUE 4
+    gave it the full user population) must equal the reference's scalar
+    per-rule scan (AssociationRules.scala:88-102) rule for rule."""
+    import numpy as np
+
+    d_lines = tokenized(random_dataset(11, n_txns=300, max_len=8))
+    u_lines = tokenized(random_dataset(77, n_txns=120))
+    itemsets, item_to_rank, freq_items = oracle.mine(d_lines, 0.04)
+    rec = AssociationRules(itemsets, freq_items, item_to_rank)
+    from fastapriori_tpu.preprocess import dedup_user_baskets
+
+    baskets, _, _ = dedup_user_baskets(u_lines, item_to_rank)
+    rec._ensure_rules()
+    got = rec._host_first_match(baskets)
+
+    prepared = [
+        (frozenset(a), c, len(a)) for a, c, _ in rec._rule_objects()
+    ]
+    for b, g in zip(baskets, got):
+        basket = frozenset(int(x) for x in b)
+        want = -1
+        for ant, cons, size in prepared:
+            if (
+                size <= len(basket)
+                and cons not in basket
+                and ant <= basket
+            ):
+                want = cons
+                break
+        assert g == want
+
+
 def test_recommender_no_users():
     itemsets = [
         (frozenset((0,)), 5),

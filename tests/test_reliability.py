@@ -369,6 +369,60 @@ def test_injected_oom_without_retry_budget_still_fails():
         FastApriori(config=_mine_config()).run(_dataset())
 
 
+# ---------------------------------------------------------------------------
+# retry policy env knobs (FA_RETRY_MAX / FA_RETRY_BACKOFF_MS)
+
+
+@pytest.fixture
+def _fresh_retry_env(monkeypatch):
+    monkeypatch.delenv("FA_RETRY_MAX", raising=False)
+    monkeypatch.delenv("FA_RETRY_BACKOFF_MS", raising=False)
+    retry.reload_policy_from_env()
+    yield monkeypatch
+    retry.reload_policy_from_env()
+
+
+def test_retry_policy_env_defaults(_fresh_retry_env):
+    assert retry.policy_from_env() is retry.DEFAULT_POLICY
+
+
+def test_retry_policy_env_knobs_apply(_fresh_retry_env):
+    _fresh_retry_env.setenv("FA_RETRY_MAX", "5")
+    _fresh_retry_env.setenv("FA_RETRY_BACKOFF_MS", "12.5")
+    retry.reload_policy_from_env()
+    pol = retry.policy_from_env()
+    assert pol.max_attempts == 5
+    assert pol.base_delay_s == 0.0125
+    # The knob actually governs call_with_retries: 4 transient failures
+    # succeed on the 5th attempt under FA_RETRY_MAX=5 (the default
+    # policy of 3 would have re-raised).
+    failpoints.arm("knob.site", "oom*4")
+    sleeps = []
+    out = retry.call_with_retries(
+        lambda: "ok", "knob.site", sleep=sleeps.append
+    )
+    assert out == "ok" and len(sleeps) == 4
+    assert sleeps[0] == pytest.approx(0.0125)
+
+
+@pytest.mark.parametrize(
+    "var,val",
+    [
+        ("FA_RETRY_MAX", "three"),
+        ("FA_RETRY_MAX", "0"),
+        ("FA_RETRY_BACKOFF_MS", "fast"),
+        ("FA_RETRY_BACKOFF_MS", "-1"),
+    ],
+)
+def test_retry_policy_env_strictly_parsed(_fresh_retry_env, var, val):
+    """The FA_NO_PALLAS contract: a typo'd ops knob must fail loudly,
+    not silently run the default policy on a flaky link."""
+    _fresh_retry_env.setenv(var, val)
+    retry.reload_policy_from_env()
+    with pytest.raises(InputError, match=var):
+        retry.policy_from_env()
+
+
 def test_kill_resume_round_trip_bit_exact(tmp_path):
     """Acceptance: interrupt after a completed level (failpoint abort),
     resume from the checkpoint, byte-identical freqItems output vs an

@@ -1,0 +1,339 @@
+"""Device-vs-host rule-generation differential suite (ISSUE 4 tentpole).
+
+The device engine (rules/gen.py `_rule_arrays_device` + ops/contain.py
+`rule_level_kernel`) must be BIT-IDENTICAL to the host engine — same
+antecedent/consequent arrays, byte-identical f64 confidences, same
+order — on every corpus, including the no-rules datasets; plus the
+engine-selection contract (config.rule_engine / FA_RULE_ENGINE, the
+count gate, the size floor) and the failpoint sites on the new
+upload/fetch path with a kill-and-resume case.  CPU-only."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.parallel.mesh import DeviceContext
+from fastapriori_tpu.preprocess import preprocess
+from fastapriori_tpu.reliability import failpoints, ledger
+from fastapriori_tpu.rules.gen import (
+    _level_tables,
+    _rule_arrays_device,
+    _rule_arrays_host,
+    rule_arrays_from_tables,
+    rule_engine_from_env,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return DeviceContext(num_devices=1)
+
+
+def _mined_tables(seed, min_support, n_txns=250, max_len=8, lines=None):
+    lines = lines if lines is not None else tokenized(
+        random_dataset(seed, n_txns=n_txns, max_len=max_len)
+    )
+    data = preprocess(lines, min_support)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=min_support, engine="level", num_devices=1
+        )
+    )
+    levels = miner.mine_levels_raw(data)
+    return _level_tables(levels, data.item_counts)
+
+
+def _assert_bit_identical(host, dev):
+    assert len(host) == len(dev)
+    for (ha, hc, hf), (da, dc, df) in zip(host, dev):
+        assert np.array_equal(ha, da)
+        assert np.array_equal(hc, dc)
+        # Confidences must agree BITWISE (both sides divide the same
+        # ints in f64; the device only located the denominators).
+        assert hf.tobytes() == df.tobytes()
+
+
+@pytest.mark.parametrize(
+    "seed,min_support",
+    [(0, 0.05), (1, 0.1), (2, 0.05), (3, 0.08), (4, 0.02), (5, 0.15)],
+)
+def test_device_matches_host_bit_exact(ctx, seed, min_support):
+    mats = _mined_tables(seed, min_support)
+    _assert_bit_identical(
+        _rule_arrays_host(mats), _rule_arrays_device(mats, ctx)
+    )
+
+
+def test_device_matches_host_deep_lattice(ctx):
+    """A dense corpus driving the lattice to k >= 5 (incl. an empty top
+    rule level), the multi-column-key path for 8-bit ranks."""
+    lines = tokenized(
+        ["1 2 3 4 5 6"] * 50
+        + ["1 2 3 4 5"] * 30
+        + ["2 3 4 5 6"] * 20
+        + random_dataset(5, n_txns=60, max_len=6)
+    )
+    mats = _mined_tables(0, 0.05, lines=lines)
+    assert max(mats) >= 5  # the corpus must actually reach depth
+    _assert_bit_identical(
+        _rule_arrays_host(mats), _rule_arrays_device(mats, ctx)
+    )
+
+
+def _remap_ranks(mats, mult, off, f_big):
+    """Widen the item space (rank -> rank*mult + off) without changing
+    the lattice — exercises the 16/32-bit key packing and multi-column
+    lexicographic search paths."""
+    out = {}
+    for k, (mat, cnts) in mats.items():
+        if k == 1:
+            m = np.arange(f_big, dtype=np.int32)[:, None]
+            c = np.ones(f_big, dtype=np.int64)
+            c[mats[1][0][:, 0] * mult + off] = mats[1][1]
+            out[1] = (m, c)
+        else:
+            out[k] = ((mat * mult + off).astype(np.int32), cnts)
+    return out
+
+
+@pytest.mark.parametrize(
+    "mult,off,f_big",
+    [
+        (600, 3, 600 * 20 + 10),  # f > 256: 16-bit ranks, 2 per lane
+        (9000, 7, 9000 * 20 + 10),  # f > 65536: 32-bit ranks, 1 per lane
+    ],
+)
+def test_device_matches_host_wide_keys(ctx, mult, off, f_big):
+    mats = _remap_ranks(_mined_tables(2, 0.05), mult, off, f_big)
+    _assert_bit_identical(
+        _rule_arrays_host(mats), _rule_arrays_device(mats, ctx)
+    )
+
+
+def test_device_no_rules_corpora(ctx):
+    """The no-rules datasets: empty tables, singletons only, and a
+    corpus whose frequent itemsets stop at size 1."""
+    assert _rule_arrays_device({}, ctx) == []
+    singles = {
+        1: (
+            np.arange(3, dtype=np.int32)[:, None],
+            np.array([5, 4, 3], dtype=np.int64),
+        )
+    }
+    assert _rule_arrays_device(singles, ctx) == []
+    # Real corpus with support too high for any pair to survive.
+    lines = tokenized(random_dataset(9, n_txns=60, max_len=3))
+    mats = _mined_tables(9, 0.9, lines=lines)
+    assert max(mats) == 1
+    assert _rule_arrays_host(mats) == []
+    assert _rule_arrays_device(mats, ctx) == []
+
+
+def test_device_downward_closure_errors(ctx):
+    mats = _mined_tables(0, 0.05)
+    assert max(mats) >= 3
+    missing_level = dict(mats)
+    missing_level.pop(2)
+    with pytest.raises(InputError, match="downward-closed"):
+        _rule_arrays_device(missing_level, ctx)
+    # Drop one 2-itemset row that a 3-itemset references: the device
+    # join's miss counter must surface as the same InputError class.
+    torn = {k: (m.copy(), c.copy()) for k, (m, c) in mats.items()}
+    m2, c2 = torn[2]
+    torn[2] = (m2[1:], c2[1:])
+    with pytest.raises(InputError, match="downward-closed"):
+        _rule_arrays_device(torn, ctx)
+    with pytest.raises(InputError, match="downward-closed"):
+        _rule_arrays_host(torn)
+
+
+# ---------------------------------------------------------------------------
+# engine selection
+
+
+def test_auto_stays_on_host_below_floor_and_on_cpu(ctx):
+    """Small corpora (and cpu platforms generally) keep the host engine
+    under "auto" — no device events, no ledger entries."""
+    mats = _mined_tables(1, 0.05)
+    cfg = MinerConfig(rule_engine="auto")
+    out = rule_arrays_from_tables(mats, context=ctx, config=cfg)
+    _assert_bit_identical(_rule_arrays_host(mats), out)
+    assert not [
+        e for e in ledger.snapshot() if e["kind"] == "rule_gen_engine"
+    ]
+
+
+def test_forced_device_records_engine_choice(ctx):
+    mats = _mined_tables(1, 0.05)
+    cfg = MinerConfig(rule_engine="device")
+    out = rule_arrays_from_tables(mats, context=ctx, config=cfg)
+    _assert_bit_identical(_rule_arrays_host(mats), out)
+    evs = [e for e in ledger.snapshot() if e["kind"] == "rule_gen_engine"]
+    assert evs and evs[0]["engine"] == "device"
+
+
+def test_count_gate_falls_back_to_host_with_ledger(ctx):
+    """Counts >= 2^24 break the exact-compare equivalence — the device
+    path must REFUSE (host fallback + ledger event), not miscompare."""
+    mats = {
+        k: (m.copy(), c.copy())
+        for k, (m, c) in _mined_tables(1, 0.05).items()
+    }
+    mats[1][1][0] = 1 << 24  # push one count past the gate
+    cfg = MinerConfig(rule_engine="device")
+    out = rule_arrays_from_tables(mats, context=ctx, config=cfg)
+    _assert_bit_identical(_rule_arrays_host(mats), out)
+    evs = [e for e in ledger.snapshot() if e["kind"] == "rule_gen_fallback"]
+    assert evs and evs[0]["reason"] == "counts_exceed_2^24"
+
+
+def test_forced_device_without_context_falls_back(ctx):
+    mats = _mined_tables(1, 0.05)
+    cfg = MinerConfig(rule_engine="device")
+    out = rule_arrays_from_tables(mats, context=None, config=cfg)
+    _assert_bit_identical(_rule_arrays_host(mats), out)
+    evs = [e for e in ledger.snapshot() if e["kind"] == "rule_gen_fallback"]
+    assert evs and evs[0]["reason"] == "no_device_context"
+
+
+def test_rule_engine_config_strictly_parsed(ctx):
+    mats = _mined_tables(1, 0.05)
+    cfg = MinerConfig(rule_engine="devcie")  # the typo class
+    with pytest.raises(InputError, match="rule_engine"):
+        rule_arrays_from_tables(mats, context=ctx, config=cfg)
+
+
+def test_rule_engine_env_strictly_parsed(monkeypatch):
+    monkeypatch.setenv("FA_RULE_ENGINE", "device")
+    assert rule_engine_from_env() == "device"
+    monkeypatch.setenv("FA_RULE_ENGINE", "  HOST ")
+    assert rule_engine_from_env() == "host"
+    monkeypatch.delenv("FA_RULE_ENGINE")
+    assert rule_engine_from_env() is None
+    monkeypatch.setenv("FA_RULE_ENGINE", "devcie")  # the typo class
+    with pytest.raises(InputError, match="FA_RULE_ENGINE"):
+        rule_engine_from_env()
+
+
+def test_env_overrides_config(ctx, monkeypatch):
+    monkeypatch.setenv("FA_RULE_ENGINE", "device")
+    mats = _mined_tables(3, 0.08)
+    cfg = MinerConfig(rule_engine="host")  # env wins
+    rule_arrays_from_tables(mats, context=ctx, config=cfg)
+    assert [e for e in ledger.snapshot() if e["kind"] == "rule_gen_engine"]
+
+
+# ---------------------------------------------------------------------------
+# failpoints on the upload/fetch path + kill-and-resume
+
+
+def test_upload_failpoint_fires(ctx):
+    mats = _mined_tables(0, 0.05)
+    failpoints.arm("rules.upload", "io*1")
+    with pytest.raises(OSError, match="injected"):
+        _rule_arrays_device(mats, ctx)
+
+
+@pytest.mark.parametrize("site", ["fetch.rule_mask", "fetch.rule_counts"])
+def test_transient_fetch_fault_is_absorbed(ctx, site):
+    """A one-shot RESOURCE_EXHAUSTED on the mask or denominator fetch is
+    a transient: the audited retry path absorbs it and the output stays
+    bit-identical (with the retry on the ledger)."""
+    mats = _mined_tables(0, 0.05)
+    clean = _rule_arrays_host(mats)
+    failpoints.arm(site, "oom*1")
+    _assert_bit_identical(clean, _rule_arrays_device(mats, ctx))
+    retries = [e for e in ledger.snapshot() if e["kind"] == "retry"]
+    assert retries and retries[0]["site"] == site
+
+
+def test_kill_and_resume_bit_exact(ctx, tmp_path):
+    """Kill-and-resume on the rule path: a hard abort mid-phase-2 (the
+    mask fetch) leaves the phase-1 mining artifacts intact; the resumed
+    run regenerates the rules from them bit-identically — the CLI's
+    --resume-from phase-1 restart shape, driven in-process."""
+    from fastapriori_tpu.io import checkpoint as ckpt
+
+    lines = tokenized(random_dataset(4, n_txns=250, max_len=8))
+    data = preprocess(lines, 0.05)
+    miner = FastApriori(
+        config=MinerConfig(min_support=0.05, engine="level", num_devices=1)
+    )
+    levels = miner.mine_levels_raw(data)
+    # Persist the mining result the way a checkpointing run would.
+    prefix = str(tmp_path) + "/"
+    ckpt.save_checkpoint(
+        prefix,
+        levels,
+        {
+            "n_raw": data.n_raw,
+            "min_count": data.min_count,
+            "num_items": data.num_items,
+        },
+    )
+    mats = _level_tables(levels, data.item_counts)
+    clean = _rule_arrays_device(mats, ctx)
+
+    failpoints.arm("fetch.rule_mask", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        _rule_arrays_device(mats, ctx)
+    failpoints.disarm_all()
+
+    # Resume: reload the checkpointed levels (what --resume-from does)
+    # and regenerate — bit-identical to the uninterrupted run.
+    got_levels, meta = ckpt.load_checkpoint(prefix)
+    ckpt.check_meta(
+        meta,
+        n_raw=data.n_raw,
+        min_count=data.min_count,
+        num_items=data.num_items,
+        prefix=prefix,
+    )
+    resumed = _rule_arrays_device(
+        _level_tables(got_levels, data.item_counts), ctx
+    )
+    _assert_bit_identical(clean, resumed)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the recommender pipeline over the device engine
+
+
+def test_recommender_device_rule_engine_matches_host_engine():
+    """AssociationRules with rule_engine="device" must recommend exactly
+    what the host engine recommends (same rules, same priority order,
+    same first match)."""
+    from fastapriori_tpu.models.recommender import AssociationRules
+
+    d_lines = tokenized(random_dataset(6, n_txns=250, max_len=8))
+    u_lines = tokenized(random_dataset(60, n_txns=50))
+    data = preprocess(d_lines, 0.05)
+    outs = {}
+    for engine in ("host", "device"):
+        cfg = MinerConfig(
+            min_support=0.05, engine="level", num_devices=1,
+            rule_engine=engine,
+        )
+        miner = FastApriori(config=cfg)
+        levels = miner.mine_levels_raw(data)
+        rec = AssociationRules(
+            [], data.freq_items, data.item_to_rank, config=cfg,
+            context=miner.context, levels=levels,
+            item_counts=data.item_counts,
+        )
+        outs[engine] = rec.run(u_lines)
+        if engine == "device":
+            assert rec._rule_arrays is not None
+    assert outs["host"] == outs["device"]

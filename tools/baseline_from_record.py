@@ -67,11 +67,20 @@ def render(parsed: dict) -> str:
         )
     rf = parsed.get("rules_full_scale")
     if rf:
+        eng = (
+            f", engine {rf['engine']}" if rf.get("engine") else ""
+        )
+        split = (
+            f" = join {rf.get('join_s')} + sort {rf.get('sort_s')}"
+            if rf.get("join_s") is not None
+            else ""
+        )
         out.append(
             f"| phase 2 full scale (webdocs @ 0.092) | 0.092 | "
             f"**{rf.get('value')}** rules/sec ({rf.get('n_rules')} rules "
-            f"from {rf.get('n_itemsets')} itemsets) | — | "
-            f"gen_rules {rf.get('gen_rules_s')} s (mine {rf.get('mine_s')} s) |"
+            f"from {rf.get('n_itemsets')} itemsets{eng}) | — | "
+            f"gen_rules {rf.get('gen_rules_s')} s{split} "
+            f"(mine {rf.get('mine_s')} s) |"
         )
     ph = parsed.get("webdocs_phases")
     if ph:
@@ -122,14 +131,30 @@ def render(parsed: dict) -> str:
     sc = parsed.get("scaling", {})
     if sc:
         ov = sc.get("sharding_overhead_8dev")
-        tp = sc.get("two_process") or {}
         out.append("")
-        out.append(
-            f"Scaling: 8-virtual-device sharding overhead "
-            f"{ov}; 2-process jax.distributed wall "
-            f"{tp.get('wall_s')} s (ingest {tp.get('ingest_s')} s, "
-            f"mine {tp.get('mine_s')} s, both processes on one core)."
-        )
+        line = f"Scaling: 8-virtual-device sharding overhead {ov}"
+        for key, label in (
+            ("two_process", "2-process"),
+            ("four_process", "4-process"),
+        ):
+            mp = sc.get(key) or {}
+            if not mp:
+                continue
+            ph = mp.get("phases") or {}
+            phs = (
+                f"; phases ingest {ph.get('ingest_s')} / pair "
+                f"{ph.get('pair_s')} / levels {ph.get('levels_s')} / "
+                f"fetch {ph.get('fetch_s')}"
+                if ph
+                else f" (ingest {mp.get('ingest_s')} s, "
+                f"mine {mp.get('mine_s')} s)"
+            )
+            line += (
+                f"; {label} jax.distributed wall {mp.get('wall_s')} s"
+                f"{phs}"
+            )
+        line += " — all processes share this host's core(s)."
+        out.append(line)
     return "\n".join(out)
 
 

@@ -11,4 +11,10 @@ python -m tools.lint fastapriori_tpu tests --baseline tools/lint/baseline.json
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"
 
+# Device-vs-host rule-generation differential suite (ISSUE 4): explicit
+# gate on the bit-exactness contract even when callers trim the pytest
+# args above.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_device.py -q \
+    -p no:cacheprovider
+
 env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py

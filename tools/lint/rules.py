@@ -129,11 +129,15 @@ class HostSyncRule(Rule):
     # local `fetch()` without a label does not.
     _FETCH_HELPERS = {"fetch", "fetch_async"}
     # Path substrings where ALL host fetches need an audit waiver, not
-    # just those inside traced functions: the mesh layer, and the engine
+    # just those inside traced functions: the mesh layer, the engine
     # layer's level loop (its np.asarray sites are the mining phase's
     # biggest link payloads — ROADMAP open item, extended from parallel/
-    # in the reliability PR).
-    fetch_audit_dirs: Tuple[str, ...] = ("parallel/", "models/apriori")
+    # in the reliability PR), and the rule generator since its device
+    # engine landed (ISSUE 4: mask/denominator fetches must stay on the
+    # audited retry.fetch_async / gather path).
+    fetch_audit_dirs: Tuple[str, ...] = (
+        "parallel/", "models/apriori", "rules/gen",
+    )
 
     _SYNC_ATTRS = {"item", "block_until_ready", "tolist", "copy_to_host_async"}
 
