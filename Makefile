@@ -1,11 +1,14 @@
-# Developer entry points.  `make ci` is the tier-1 flow: lint, tests,
-# then the failpoint smoke pass (reliability wiring under injected
-# failure — see tools/failpoint_smoke.py).
+# Developer entry points.  `make ci` is the tier-1 flow: lint (full
+# surface + inventory drift check, wall-time budgeted), tests, then the
+# failpoint smoke pass (reliability wiring under injected failure — see
+# tools/failpoint_smoke.py).
 
-.PHONY: lint test smoke ci baseline native
+.PHONY: lint test smoke ci baseline inventory native
 
+# Default paths cover the whole tree: fastapriori_tpu tests bench.py
+# __graft_entry__.py tools (tools/lint/cli.py DEFAULT_PATHS).
 lint:
-	python -m tools.lint fastapriori_tpu tests --baseline tools/lint/baseline.json
+	python -m tools.lint --baseline tools/lint/baseline.json --check-inventory
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
@@ -18,8 +21,13 @@ ci: lint test smoke
 
 # Ratchet reset — only alongside the change that justifies it.
 baseline:
-	python -m tools.lint fastapriori_tpu tests \
+	python -m tools.lint \
 	    --baseline tools/lint/baseline.json --write-baseline
+
+# Regenerate tools/lint/inventory.json + env_registry.json + the README
+# knob table; commit the churn in the PR that caused it.
+inventory:
+	python -m tools.lint --write-inventory
 
 native:
 	$(MAKE) -C fastapriori_tpu/native

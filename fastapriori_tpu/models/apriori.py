@@ -2192,7 +2192,7 @@ class FastApriori:
                     resolve_levels=len({i for i, _, _ in resolve_flat}),
                     resolve_folded=True,
                 )
-                # lint: fetch-site -- the tail fold's single audited fetch, retry-wrapped
+                # lint: fetch-site -- the tail fold's single audited fetch, retry-wrapped; lint: waive G013 -- same logical site as the no-resolve branch below: exactly one of the two exclusive dispatch shapes runs per mine
                 packed_out = retry.fetch(
                     lambda: np.asarray(packed_dev), "tail"
                 )
@@ -2201,7 +2201,7 @@ class FastApriori:
                     scales, k0, m_cap, p_cap, cfg.tail_fuse_l_max,
                     tail_chunks, heavy is not None,
                 )
-                # lint: fetch-site -- the tail fold's single audited fetch, retry-wrapped
+                # lint: fetch-site -- the tail fold's single audited fetch, retry-wrapped; lint: waive G013 -- same logical site as the resolve-fold branch above: exactly one of the two exclusive dispatch shapes runs per mine
                 packed_out = retry.fetch(
                     lambda: np.asarray(fn(*args)), "tail"
                 )

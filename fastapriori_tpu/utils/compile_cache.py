@@ -25,11 +25,17 @@ def enable_compile_cache() -> bool:
     """Best-effort (a cache failure must never fail the run); returns
     True when the cache directory already held entries — callers that
     report cold-start times disclose it, since a primed cache makes
-    "cold" a machine-state-dependent figure."""
-    if os.environ.get("FA_NO_COMPILE_CACHE", "").lower() in (
-        "1", "true", "yes",
-    ):
+    "cold" a machine-state-dependent figure.
+
+    The opt-out knobs are STRICTLY parsed (utils/env.py, the
+    FA_NO_PALLAS contract) and parsed BEFORE the best-effort block: a
+    typo'd knob is an InputError, never a silently-on cache."""
+    from fastapriori_tpu.utils.env import env_flag
+
+    if env_flag("FA_NO_COMPILE_CACHE"):
         return False
+    log_compiles = not env_flag("FA_NO_COMPILE_LOG")
+    # lint: env-ok -- free-form path knob: every string is a valid directory
     path = os.environ.get("FA_COMPILE_CACHE") or os.path.join(
         os.path.expanduser("~"), ".cache", "fastapriori_tpu", "jax"
     )
@@ -48,10 +54,9 @@ def enable_compile_cache() -> bool:
         # jax_log_compiles emits one stderr line per traced compile with
         # the jaxpr's global shapes — exactly the signature needed to
         # pin the escapee.  Entry points only (this function), opt out
-        # with FA_NO_COMPILE_LOG=1.
-        if os.environ.get("FA_NO_COMPILE_LOG", "").lower() not in (
-            "1", "true", "yes",
-        ):
+        # with FA_NO_COMPILE_LOG=1 (parsed strictly above, outside this
+        # best-effort block).
+        if log_compiles:
             jax.config.update("jax_log_compiles", True)
         return primed
     except (OSError, ImportError, AttributeError, ValueError, RuntimeError):

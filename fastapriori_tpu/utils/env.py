@@ -1,0 +1,83 @@
+"""Strict FA_* environment-knob parsers (stdlib-only).
+
+Every ops knob in this codebase follows the FA_NO_PALLAS contract
+(ADVICE r5 #4): a typo'd value must raise
+:class:`~fastapriori_tpu.errors.InputError` at first use, never silently
+run the default — an invisible degradation on a production mine is
+exactly the bug class the degradation ledger exists to kill.  graftlint
+G012 enforces the contract statically: every ``FA_*`` read must route
+through a parser that raises ``InputError``, and every knob must be
+registered in ``tools/lint/env_registry.json`` (rendered into README's
+knob table, so the docs cannot drift from the checked artifact).
+
+Free-form knobs (paths like ``FA_COMPILE_CACHE``, where every string is
+valid) are the one legitimate exception; their read sites carry an
+``env-ok`` waiver naming that reason.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from fastapriori_tpu.errors import InputError
+
+_FALSY = ("", "0", "false", "no")
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Strict boolean knob: unset/``0``/``false``/``no`` -> False,
+    ``1``/``true``/``yes``/``on`` -> True, anything else ->
+    ``InputError``."""
+    raw = os.environ.get(name, "")
+    val = raw.strip().lower()
+    if val in _FALSY:
+        return default if raw == "" else False
+    if val in _TRUTHY:
+        return True
+    raise InputError(
+        f"unrecognized {name} value {raw!r}: use one of "
+        f"{'/'.join(_TRUTHY)} to enable, "
+        f"{'/'.join(v for v in _FALSY if v)} (or unset) to disable"
+    )
+
+
+def env_int(
+    name: str, default: int, minimum: Optional[int] = None
+) -> int:
+    """Strict integer knob; ``minimum`` bounds the valid range."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise InputError(
+            f"unrecognized {name} value {raw!r}: expected an integer"
+        ) from None
+    if minimum is not None and val < minimum:
+        raise InputError(
+            f"{name}={val} is out of range: must be >= {minimum}"
+        )
+    return val
+
+
+def env_float(
+    name: str, default: float, minimum: Optional[float] = None
+) -> float:
+    """Strict float knob; ``minimum`` bounds the valid range."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        raise InputError(
+            f"unrecognized {name} value {raw!r}: expected a number"
+        ) from None
+    if minimum is not None and val < minimum:
+        raise InputError(
+            f"{name}={val} is out of range: must be >= {minimum}"
+        )
+    return val

@@ -282,6 +282,210 @@ CASES = [
      "    # lint: waive G009 -- test waiver\n"
      "    with open(path, 'w') as f:\n"
      "        f.writelines(lines)\n"),
+    # -- G002 satellite: shard_map kwarg + P(...) spec axis sources ----
+    ("G002", "pass", "pkg/mod.py",
+     "import jax\n"
+     "from jax import lax\n"
+     "from jax.experimental.shard_map import shard_map\n"
+     "def build(f, mesh):\n"
+     "    return shard_map(f, mesh=mesh, axis_names=('tx2',))\n"
+     "def g(x):\n"
+     "    return lax.psum(x, 'tx2')\n"),  # axis declared by the kwarg
+    ("G002", "pass", "pkg/mod.py",
+     "from jax import lax\n"
+     "from jax.sharding import PartitionSpec as P\n"
+     "SPEC = P('tx3', None)\n"
+     "def g(x):\n"
+     "    return lax.psum(x, 'tx3')\n"),  # axis declared by the spec
+    ("G002", "flag", "pkg/mod.py",
+     "from jax import lax\n"
+     "from jax.experimental.shard_map import shard_map\n"
+     "def build(f, mesh):\n"
+     "    return shard_map(f, mesh=mesh, axis_name='tx4')\n"
+     "def g(x):\n"
+     "    return lax.psum(x, 'tx9')\n"),  # tx4 declared, tx9 is a typo
+    # -- G010: donated buffer referenced after the jitted call ---------
+    ("G010", "flag", "pkg/mod.py",
+     "import jax\n"
+     "def run(x):\n"
+     "    f = jax.jit(lambda a: a * 2, donate_argnums=0)\n"
+     "    y = f(x)\n"
+     "    return y + x\n"),  # x's buffer was freed at dispatch
+    ("G010", "flag", "pkg/mod.py",
+     "import jax\n"
+     "def run(x):\n"
+     "    y = jax.jit(lambda a: a, donate_argnums=(0,))(x)\n"
+     "    z = x.sum()\n"
+     "    return y, z\n"),  # direct-call donation form
+    ("G010", "flag", "pkg/mod.py",
+     "import jax\n"
+     "_inner = jax.jit(lambda a: a, donate_argnums=0)\n"
+     "def consume(buf):\n"
+     "    return _inner(buf)\n"
+     "def run(x):\n"
+     "    y = consume(x)\n"
+     "    return y + x\n"),  # one-level cross-function propagation
+    ("G010", "pass", "pkg/mod.py",
+     "import jax\n"
+     "def run(x):\n"
+     "    f = jax.jit(lambda a: a * 2, donate_argnums=0)\n"
+     "    y = f(x)\n"
+     "    return y\n"),  # no use after donation
+    ("G010", "pass", "pkg/mod.py",
+     "import jax\n"
+     "def run(x):\n"
+     "    f = jax.jit(lambda a: a * 2, donate_argnums=0)\n"
+     "    x = f(x)\n"
+     "    return x + 1\n"),  # rebinding makes the name live again
+    ("G010", "waived", "pkg/mod.py",
+     "import jax\n"
+     "def run(x):\n"
+     "    f = jax.jit(lambda a: a * 2, donate_argnums=0)\n"
+     "    y = f(x)\n"
+     "    return y + x  # lint: donate-ok -- test waiver\n"),
+    # -- G011: dynamic ints must bucket before becoming shapes ---------
+    ("G011", "flag", "pkg/parallel/m.py",
+     "import jax.numpy as jnp\n"
+     "def up(xs):\n"
+     "    n = len(xs)\n"
+     "    return jnp.zeros((n, 8), jnp.int32)\n"),
+    ("G011", "flag", "pkg/parallel/m.py",
+     "import jax\n"
+     "import jax.numpy as jnp\n"
+     "def sig(x):\n"
+     "    m = x.shape[0] * 2\n"
+     "    return jax.ShapeDtypeStruct((m, 8), jnp.int32)\n"),
+    ("G011", "pass", "pkg/parallel/m.py",
+     "import jax.numpy as jnp\n"
+     "from fastapriori_tpu.ops.bitmap import next_pow2\n"
+     "def up(xs):\n"
+     "    n = next_pow2(len(xs))\n"
+     "    return jnp.zeros((n, 8), jnp.int32)\n"),  # bucketed: fine
+    ("G011", "pass", "pkg/parallel/m.py",
+     "import numpy as np\n"
+     "def host_scratch(xs):\n"
+     "    return np.zeros((len(xs), 8), np.int32)\n"),  # host numpy: free
+    ("G011", "pass", "pkg/ops/m.py",
+     "import jax.numpy as jnp\n"
+     "def up(xs):\n"
+     "    return jnp.zeros((len(xs), 8), jnp.int32)\n"),  # out of scope
+    ("G011", "waived", "pkg/parallel/m.py",
+     "import jax.numpy as jnp\n"
+     "def up(xs):\n"
+     "    n = len(xs)\n"
+     "    # lint: bucket-ok -- test waiver\n"
+     "    return jnp.zeros((n, 8), jnp.int32)\n"),
+    # -- G012: FA_* env knobs are a strict, registered contract --------
+    ("G012", "flag", "pkg/mod.py",
+     "import os\n"
+     "def knob():\n"
+     "    return os.environ.get('FA_TEST_KNOB', '') == '1'\n"),
+    ("G012", "flag", "pkg/mod.py",
+     "import os\n"
+     "FLAG = os.environ.get('FA_TEST_KNOB', '')\n"),  # module level
+    ("G012", "pass", "pkg/mod.py",
+     "import os\n"
+     "from fastapriori_tpu.errors import InputError\n"
+     "def knob():\n"
+     "    raw = os.environ.get('FA_TEST_KNOB', '')\n"
+     "    if raw not in ('', '0', '1'):\n"
+     "        raise InputError(f'unrecognized FA_TEST_KNOB {raw!r}')\n"
+     "    return raw == '1'\n"),
+    ("G012", "pass", "pkg/mod.py",
+     "import os\n"
+     "from fastapriori_tpu.errors import InputError\n"
+     "def parse(raw):\n"
+     "    if raw not in ('', '0', '1'):\n"
+     "        raise InputError(f'bad value {raw!r}')\n"
+     "    return raw == '1'\n"
+     "def knob():\n"
+     "    return parse(os.environ.get('FA_TEST_KNOB', ''))\n"),
+    # the read routes through a strict parser: one-level propagation
+    ("G012", "waived", "pkg/mod.py",
+     "import os\n"
+     "def knob():\n"
+     "    # lint: env-ok -- free-form test knob\n"
+     "    return os.environ.get('FA_TEST_KNOB', '')\n"),
+    # -- G013: audited-site census (uniqueness + failpoint coverage) ---
+    ("G013", "flag", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "COVER = ('fetch.a', 'fetch.b')\n"
+     "def pull(x, y):\n"
+     "    u = retry.fetch(lambda: np.asarray(x), 'a')\n"
+     "    v = retry.fetch(lambda: np.asarray(y), 'a')\n"
+     "    return u, v\n"),  # duplicated label 'a'
+    ("G013", "flag", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "def pull(x):\n"
+     "    return retry.fetch(lambda: np.asarray(x), 'uncovered')\n"),
+    # no literal 'fetch.uncovered' armed anywhere: no coverage
+    ("G013", "pass", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "COVERAGE_SITES = ('fetch.a', 'fetch.b')\n"
+     "def pull(x, y):\n"
+     "    u = retry.fetch(lambda: np.asarray(x), 'a')\n"
+     "    v = retry.fetch(lambda: np.asarray(y), 'b')\n"
+     "    return u, v\n"),  # unique labels, both covered
+    ("G013", "waived", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "def pull(x, y):\n"
+     "    u = retry.fetch(lambda: np.asarray(x), 'a')"
+     "  # lint: waive G013 -- test waiver\n"
+     "    v = retry.fetch(lambda: np.asarray(y), 'a')"
+     "  # lint: waive G013 -- test waiver\n"
+     "    return u, v\n"),
+    # -- waiver-grammar edge cases (engine, pinned by ISSUE 5) ---------
+    # (a) a waiver above a decorator attaches to the decorated line
+    ("G003", "waived", "pkg/mod.py",
+     "import jax\n"
+     "def run(fs, xs):\n"
+     "    for f in fs:\n"
+     "        # lint: waive G003 -- test waiver above the decorator\n"
+     "        @jax.jit\n"
+     "        def step(x):\n"
+     "            return x\n"
+     "        xs = step(xs)\n"
+     "    return xs\n"),
+    ("G003", "flag", "pkg/mod.py",
+     "import jax\n"
+     "def run(fs, xs):\n"
+     "    # lint: waive G003 -- too far: not adjacent to the decorator\n"
+     "    fs = list(fs)\n"
+     "    for f in fs:\n"
+     "        @jax.jit\n"
+     "        def step(x):\n"
+     "            return x\n"
+     "        xs = step(xs)\n"
+     "    return xs\n"),
+    # (b) stacked waivers in one comment must all match
+    ("G001", "waived", "pkg/parallel/m.py",
+     "import numpy as np\n"
+     "def fetch(arr):\n"
+     "    return np.asarray(arr)"
+     "  # lint: waive G008 -- unrelated; lint: fetch-site -- stacked\n"),
+    ("G001", "flag", "pkg/parallel/m.py",
+     "import numpy as np\n"
+     "def fetch(arr):\n"
+     "    return np.asarray(arr)"
+     "  # lint: waive G008 -- wrong; lint: waive G006 -- also wrong\n"),
+    # (c) a waiver inside a multi-line call binds to the call's span
+    ("G001", "waived", "pkg/parallel/m.py",
+     "import numpy as np\n"
+     "def fetch(arr):\n"
+     "    return np.asarray(\n"
+     "        arr,  # lint: fetch-site -- inner line binds to the span\n"
+     "    )\n"),
+    ("G001", "flag", "pkg/parallel/m.py",
+     "import numpy as np\n"
+     "def fetch(arr):\n"
+     "    return np.asarray(\n"
+     "        arr,\n"
+     "    )\n"
+     "    # lint: fetch-site -- below the span: does not bind\n"),
 ]
 
 
@@ -315,7 +519,7 @@ def test_every_rule_has_all_three_case_kinds():
 
 def test_all_rules_registered_and_distinct():
     ids = [r.id for r in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 9
+    assert len(ids) == len(set(ids)) == 13
     assert all(hasattr(r, "name") and r.name for r in ALL_RULES)
 
 
@@ -341,10 +545,12 @@ def test_baseline_roundtrip(tmp_path):
 
 
 def test_cli_repo_is_clean_under_shipped_baseline():
+    # The FULL v2 surface: package, tests, bench driver, entry script,
+    # and the tooling (the linter lints itself) — under the EMPTY
+    # baseline.
     rc = cli.main(
-        [
-            "fastapriori_tpu",
-            "tests",
+        cli.DEFAULT_PATHS
+        + [
             "--baseline",
             os.path.join(REPO_ROOT, BASELINE),
             "--root",
@@ -469,3 +675,288 @@ def test_syntax_error_is_reported_not_crashed(tmp_path):
     (pkg / "mod.py").write_text("def f(:\n")
     rc = cli.main(["pkg", "--root", str(tmp_path)])
     assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# v2 dataflow layer (tools/lint/graph.py + flow.py)
+
+
+def test_call_graph_resolves_renamed_imports():
+    from tools.lint.graph import PackageGraph
+
+    a = FileContext("pkg/a.py", "def helper(x):\n    return x\n")
+    b = FileContext(
+        "pkg/b.py",
+        "from pkg.a import helper as h\n"
+        "def run(x):\n"
+        "    return h(x)\n",
+    )
+    g = PackageGraph([a, b])
+    run_fn = g.modules["pkg.b"].functions["run"]
+    assert "pkg.a.helper" in g.callees(b, run_fn)
+
+
+def test_call_graph_resolves_dotted_module_imports():
+    from tools.lint.graph import PackageGraph
+
+    a = FileContext("pkg/sub/a.py", "def helper(x):\n    return x\n")
+    b = FileContext(
+        "pkg/b.py",
+        "import pkg.sub.a as mod\n"
+        "def run(x):\n"
+        "    return mod.helper(x)\n",
+    )
+    g = PackageGraph([a, b])
+    run_fn = g.modules["pkg.b"].functions["run"]
+    assert "pkg.sub.a.helper" in g.callees(b, run_fn)
+
+
+def test_cross_file_constant_resolution_through_rename():
+    """`from pkg.meshdef import AXIS as A` must resolve to the literal
+    (v1's flat constant table is keyed by the ORIGINAL name and misses
+    the rename) — shrinking G002's waiver pressure."""
+    src = (
+        "from jax import lax\n"
+        "from pkg.meshdef import AXIS as A\n"
+        "def f(x):\n"
+        "    return lax.psum(x, A)\n"
+    )
+    result = engine.lint_sources([MESH_DECL, ("pkg/mod.py", src)])
+    assert not [f for f in result.findings if f.rule == "G002"]
+
+
+def test_shape_flow_summary_propagates_one_level():
+    """A helper returning `x.shape[0]` makes its CALLERS' shape args
+    dynamic — one level of cross-function propagation."""
+    helper = ("pkg/parallel/h.py", "def rows(x):\n    return x.shape[0]\n")
+    user = (
+        "pkg/parallel/m.py",
+        "import jax.numpy as jnp\n"
+        "from pkg.parallel.h import rows\n"
+        "def up(x):\n"
+        "    return jnp.zeros((rows(x), 8), jnp.int32)\n",
+    )
+    result = engine.lint_sources([MESH_DECL, helper, user])
+    hits = [f for f in result.findings if f.rule == "G011"]
+    assert hits and hits[0].path == "pkg/parallel/m.py"
+
+
+def test_g012_registry_membership_and_staleness():
+    registry = {"vars": {"FA_KNOWN": {"description": "d", "readers": []},
+                         "FA_STALE": {"description": "d", "readers": []}}}
+    src = (
+        "import os\n"
+        "from fastapriori_tpu.errors import InputError\n"
+        "def knob():\n"
+        "    raw = os.environ.get('FA_SURPRISE', '')\n"
+        "    if raw:\n"
+        "        raise InputError('bad')\n"
+        "    return raw\n"
+    )
+    result = engine.lint_sources(
+        [("pkg/mod.py", src)], env_registry=registry
+    )
+    msgs = [f.message for f in result.findings if f.rule == "G012"]
+    assert any("FA_SURPRISE" in m and "env_registry" in m for m in msgs)
+    assert any("FA_STALE" in m and "no remaining" in m for m in msgs)
+    # FA_KNOWN is... also unreferenced — stale too.  A referenced knob
+    # is not:
+    src2 = src.replace("FA_SURPRISE", "FA_KNOWN")
+    result2 = engine.lint_sources(
+        [("pkg/mod.py", src2)], env_registry=registry
+    )
+    msgs2 = [f.message for f in result2.findings if f.rule == "G012"]
+    assert not any("FA_KNOWN" in m and "no remaining" in m for m in msgs2)
+    assert not any("env_registry.json —" in m and "FA_KNOWN" in m
+                   for m in msgs2)
+
+
+def test_fixture_package_flow_rules_json_findings(tmp_path, capsys):
+    """Every G010-G013 must-flag snippet, driven through the real CLI
+    (`python -m tools.lint --format json` equivalent) as one fixture
+    package — the dataflow layer works end-to-end, not just in-memory."""
+    pkg = tmp_path / "pkg"
+    parallel = pkg / "parallel"
+    parallel.mkdir(parents=True)
+    (pkg / "meshdef.py").write_text(MESH_DECL[1])
+    for i, (rule, kind, path, src) in enumerate(CASES):
+        if kind != "flag" or rule not in ("G010", "G011", "G012", "G013"):
+            continue
+        target = parallel if "parallel" in path else pkg
+        (target / f"injected_{rule.lower()}_{i}.py").write_text(src)
+    rc = cli.main(["pkg", "--root", str(tmp_path), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    flagged = {f["rule"] for f in out["findings"]}
+    assert {"G010", "G011", "G012", "G013"} <= flagged
+
+
+# ---------------------------------------------------------------------------
+# contract inventory + drift check
+
+
+def test_inventory_is_deterministic_and_censuses_sites():
+    src = (
+        "import numpy as np\n"
+        "from fastapriori_tpu.reliability import retry, failpoints\n"
+        "COVERAGE = ('fetch.a',)\n"
+        "def pull(x):\n"
+        "    failpoints.fire('pull.start')\n"
+        "    # lint: waive G008 -- census me\n"
+        "    return retry.fetch(lambda: np.asarray(x), 'a')\n"
+    )
+    r1 = engine.lint_sources([("pkg/mod.py", src)])
+    r2 = engine.lint_sources([("pkg/mod.py", src)])
+    assert r1.inventory == r2.inventory
+    inv = r1.inventory
+    assert {"label": "a", "path": "pkg/mod.py", "count": 1} in (
+        inv["fetch_sites"]
+    )
+    assert {"site": "pull.start", "path": "pkg/mod.py", "count": 1} in (
+        inv["failpoint_sites"]
+    )
+    assert any(w["justification"] == "census me" for w in inv["waivers"])
+
+
+def test_inventory_excludes_test_files_from_site_census():
+    src = (
+        "import numpy as np\n"
+        "from fastapriori_tpu.reliability import retry\n"
+        "def pull(x):\n"
+        "    return retry.fetch(lambda: np.asarray(x), 'a')\n"
+    )
+    inv = engine.lint_sources([("tests/test_x.py", src)]).inventory
+    assert inv["fetch_sites"] == []
+
+
+def test_env_registry_regeneration_preserves_descriptions():
+    src = (
+        "import os\n"
+        "from fastapriori_tpu.errors import InputError\n"
+        "def knob():\n"
+        "    raw = os.environ.get('FA_NEW_KNOB', '')\n"
+        "    if raw not in ('', '1'):\n"
+        "        raise InputError('bad')\n"
+        "    return raw\n"
+    )
+    result = engine.lint_sources([("pkg/mod.py", src)])
+    old = {"vars": {"FA_NEW_KNOB": {"description": "kept!", "readers": []}}}
+    reg = engine.regenerate_env_registry(result.pkg, old)
+    assert reg["vars"]["FA_NEW_KNOB"]["description"] == "kept!"
+    assert reg["vars"]["FA_NEW_KNOB"]["readers"] == ["pkg/mod.py"]
+    # Unreferenced entries drop out on regeneration.
+    old["vars"]["FA_GONE"] = {"description": "x", "readers": []}
+    reg2 = engine.regenerate_env_registry(result.pkg, old)
+    assert "FA_GONE" not in reg2["vars"]
+
+
+def test_cli_inventory_write_then_check_roundtrip(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (tmp_path / "tools" / "lint").mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        "import numpy as np\n"
+        "from fastapriori_tpu.reliability import retry\n"
+        "COVERAGE = ('fetch.a',)\n"
+        "def pull(x):\n"
+        "    return retry.fetch(lambda: np.asarray(x), 'a')\n"
+    )
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--write-inventory"]) == 0
+    capsys.readouterr()
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--check-inventory"]) == 0
+    # Any census churn now fails the drift check until regenerated.
+    (pkg / "mod.py").write_text(
+        "import numpy as np\n"
+        "from fastapriori_tpu.reliability import retry\n"
+        "COVERAGE = ('fetch.b',)\n"
+        "def pull(x):\n"
+        "    return retry.fetch(lambda: np.asarray(x), 'b')\n"
+    )
+    capsys.readouterr()
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--check-inventory"]) == 1
+    err = capsys.readouterr().err
+    assert "drift" in err
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--write-inventory"]) == 0
+    capsys.readouterr()
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--check-inventory"]) == 0
+
+
+def test_shipped_inventory_matches_tree():
+    """The committed inventory/registry/README table must match what
+    the tree regenerates (the same check tools/ci.sh runs)."""
+    rc = cli.main(
+        cli.DEFAULT_PATHS
+        + [
+            "--baseline",
+            os.path.join(REPO_ROOT, BASELINE),
+            "--root",
+            REPO_ROOT,
+            "--check-inventory",
+        ]
+    )
+    assert rc == 0
+
+
+def test_waiver_above_decorator_attaches_to_def_line():
+    src = (
+        "import jax\n"
+        "def run(fs, xs):\n"
+        "    for f in fs:\n"
+        "        # lint: waive G003 -- hoisting tracked in ROADMAP\n"
+        "        @jax.jit\n"
+        "        def step(x):\n"
+        "            return x\n"
+        "        xs = step(xs)\n"
+        "    return xs\n"
+    )
+    result = engine.lint_sources([("pkg/mod.py", src)])
+    assert not [f for f in result.findings if f.rule == "G003"]
+
+
+def test_g011_sanitizer_inside_branch_is_respected():
+    """A sink in a branch SUITE must be judged with the suite's own
+    env — `n = next_pow2(n)` just before the sink is a sanitize, even
+    when both live inside an `if` (regression: the pre-scan used the
+    stale pre-branch env and flagged correctly bucketed code)."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "from fastapriori_tpu.ops.bitmap import next_pow2\n"
+        "def up(xs, cond):\n"
+        "    n = len(xs)\n"
+        "    if cond:\n"
+        "        n = next_pow2(n)\n"
+        "        return jnp.zeros((n, 8), jnp.int32)\n"
+        "    return None\n"
+    )
+    result = engine.lint_sources([("pkg/parallel/m.py", src)])
+    assert not [f for f in result.findings if f.rule == "G011"]
+    # ...while an UNsanitized sink in a branch suite still flags.
+    flagged = engine.lint_sources(
+        [("pkg/parallel/m.py", src.replace("n = next_pow2(n)", "pass"))]
+    )
+    assert [f for f in flagged.findings if f.rule == "G011"]
+
+
+def test_inventory_modes_refuse_partial_paths(capsys):
+    """Regenerating (or drift-checking) the committed inventory from a
+    partial path set would silently truncate the census."""
+    rc = cli.main(
+        ["fastapriori_tpu", "--root", REPO_ROOT, "--check-inventory"]
+    )
+    assert rc == 2
+    assert "full default paths" in capsys.readouterr().err
+
+
+def test_stacked_waiver_segments_parse_independently():
+    from tools.lint.engine import _parse_waiver_segments
+
+    segs = _parse_waiver_segments(
+        "# lint: fetch-site -- why one; lint: waive G013 -- why two"
+    )
+    assert [t for t, _ in segs] == [{"fetch-site"}, {"G013"}]
+    assert [j for _, j in segs] == ["why one", "why two"]

@@ -125,6 +125,46 @@ def test_retry_absorbs_transient_and_records():
     assert ledger.snapshot()[0]["site"] == "fetch.t"
 
 
+# The package-wide audited-fetch-site inventory (graftlint G013 checks
+# every literal `retry.fetch`/`fetch_async` label is unique AND armed
+# somewhere as a `fetch.<label>` failpoint — this table is that
+# coverage, and the census forces it to grow with every new site).
+# Labels passed as variables use their documented spellings.
+FETCH_SITE_INVENTORY = [
+    "fetch.pair",  # parallel/mesh.py pair-phase packed fetch
+    "fetch.pair_pre",  # models/apriori.py overlapped-ingest pair fetch
+    "fetch.pair_regather",  # parallel/mesh.py overflow-retry re-pack
+    "fetch.local_rows",  # parallel/mesh.py per-process row fetch
+    "fetch.fused",  # models/apriori.py whole-loop engine result
+    "fetch.tail",  # models/apriori.py tail-fold packed result
+    "fetch.counts",  # parallel/mesh.py deferred count gather (site arg)
+    "fetch.counts_drain",  # models/apriori.py byte-budgeted mid-mine drain
+    "fetch.counts_resolve",  # models/apriori.py tail-fold count resolve
+    "fetch.level_bits",  # models/apriori.py per-level survivor bitmask
+    "fetch.level_counts",  # models/apriori.py end-of-mine count fetch
+    "fetch.rule_mask",  # rules/gen.py device-engine survivor bitmask
+    "fetch.rule_counts",  # rules/gen.py surviving-denominator gather
+]
+
+
+@pytest.mark.parametrize("site", FETCH_SITE_INVENTORY)
+def test_every_inventoried_fetch_site_is_armable_and_retried(site):
+    """Each audited fetch site must be reachable by the injection
+    machinery: arming `<site>:oom*1` makes the first attempt fail
+    transiently, the retry wrapper absorbs it, and the ledger names the
+    site.  (End-to-end injection through the production dispatch paths
+    is exercised per-site in the suites below and in
+    tools/failpoint_smoke.py.)"""
+    failpoints.arm(site, "oom*1")
+    label = site[len("fetch."):]
+    out = retry.fetch(lambda: 7, label, policy=retry.RetryPolicy(
+        max_attempts=2, base_delay_s=0.0
+    ))
+    assert out == 7
+    events = [e for e in ledger.snapshot() if e["kind"] == "retry"]
+    assert events and events[0]["site"] == site
+
+
 def test_retry_gives_up_after_policy_bound():
     failpoints.arm("fetch.t", "oom")  # unlimited
     policy = retry.RetryPolicy(max_attempts=3, base_delay_s=0.0)
@@ -635,6 +675,36 @@ def test_fa_no_pallas_typo_fails_the_dispatch(monkeypatch):
     monkeypatch.setenv("FA_NO_PALLAS", "fasle")
     with pytest.raises(InputError, match="FA_NO_PALLAS"):
         FastApriori(config=_mine_config()).run(_dataset())
+
+
+def test_env_helpers_are_strict(monkeypatch):
+    """utils/env.py — the shared strict parsers every knob that is not
+    itself a bespoke parser (bench link gates, compile-cache opt-outs)
+    now routes through; graftlint G012 enforces the routing."""
+    from fastapriori_tpu.utils.env import env_flag, env_float, env_int
+
+    monkeypatch.setenv("FA_X", "yes")
+    assert env_flag("FA_X") is True
+    monkeypatch.setenv("FA_X", "0")
+    assert env_flag("FA_X", default=True) is False
+    monkeypatch.delenv("FA_X", raising=False)
+    assert env_flag("FA_X", default=True) is True
+    monkeypatch.setenv("FA_X", "fasle")
+    with pytest.raises(InputError, match="FA_X"):
+        env_flag("FA_X")
+    monkeypatch.setenv("FA_X", "12")
+    assert env_int("FA_X", 3) == 12
+    monkeypatch.setenv("FA_X", "1.5")
+    with pytest.raises(InputError, match="integer"):
+        env_int("FA_X", 3)
+    monkeypatch.setenv("FA_X", "-1")
+    with pytest.raises(InputError, match="out of range"):
+        env_int("FA_X", 3, minimum=0)
+    monkeypatch.setenv("FA_X", "2.5")
+    assert env_float("FA_X", 1.0) == 2.5
+    monkeypatch.setenv("FA_X", "fast")
+    with pytest.raises(InputError, match="number"):
+        env_float("FA_X", 1.0)
 
 
 # ---------------------------------------------------------------------------

@@ -1,12 +1,25 @@
 #!/bin/sh
-# Tier-1 gate: graftlint first (fast, no JAX import), then the test
-# suite, then the failpoint smoke pass (injected transient fetch /
-# kill-resume / truncated artifact against the full CLI pipeline).
+# Tier-1 gate: graftlint first (fast, no JAX import) including the
+# contract-inventory drift check, then the test suite, then the
+# failpoint smoke pass (injected transient fetch / kill-resume /
+# truncated artifact against the full CLI pipeline).
 # Usage: tools/ci.sh [extra pytest args].
 set -e
 cd "$(dirname "$0")/.."
 
-python -m tools.lint fastapriori_tpu tests --baseline tools/lint/baseline.json
+# Full linted surface (package + tests + bench driver + entry script +
+# tooling) under the EMPTY baseline, plus the inventory drift check:
+# tools/lint/inventory.json, env_registry.json and the README knob
+# table must match what the tree regenerates — inventory churn rides
+# the PR that causes it.  Wall time is logged and budgeted (<10 s).
+lint_t0=$(python -c 'import time; print(time.time())')
+python -m tools.lint --baseline tools/lint/baseline.json --check-inventory
+python - "$lint_t0" <<'EOF'
+import sys, time
+elapsed = time.time() - float(sys.argv[1])
+print(f"lint+inventory wall time: {elapsed:.2f}s (budget 10s)")
+sys.exit(1 if elapsed > 10.0 else 0)
+EOF
 
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -53,8 +53,10 @@ def make_inputs(root: str) -> str:
     ]
     inp = os.path.join(root, "in") + os.sep
     os.makedirs(inp)
+    # lint: waive G009 -- smoke-test INPUT fixtures in a fresh temp dir, not run artifacts
     with open(os.path.join(inp, "D.dat"), "w") as f:
         f.writelines(l + "\n" for l in lines)
+    # lint: waive G009 -- smoke-test INPUT fixtures in a fresh temp dir, not run artifacts
     with open(os.path.join(inp, "U.dat"), "w") as f:
         f.writelines(l + "\n" for l in lines[:25])
     return inp

@@ -11,13 +11,28 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
 from tools.lint import engine
 from tools.lint.rules import ALL_RULES, RULES_BY_ID
 
-DEFAULT_PATHS = ["fastapriori_tpu", "tests"]
+# The full linted surface (v2): the package, the test suite, the bench
+# driver, the multichip entry script, and the tooling (including this
+# linter — it obeys its own contracts).
+DEFAULT_PATHS = [
+    "fastapriori_tpu",
+    "tests",
+    "bench.py",
+    "__graft_entry__.py",
+    "tools",
+]
+
+# README block the env-knob table is rendered into (from the checked
+# registry, never by hand).
+_TABLE_BEGIN = "<!-- fa-env-registry:begin -->"
+_TABLE_END = "<!-- fa-env-registry:end -->"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -62,7 +77,96 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="also print findings the baseline already freezes",
     )
+    p.add_argument(
+        "--write-inventory",
+        action="store_true",
+        help=(
+            "regenerate tools/lint/inventory.json, env_registry.json "
+            "and the README env-knob table from this run, then exit 0"
+        ),
+    )
+    p.add_argument(
+        "--check-inventory",
+        action="store_true",
+        help=(
+            "fail (exit 1) if the committed inventory/registry/README "
+            "table drift from what this run would regenerate"
+        ),
+    )
     return p
+
+
+def _render_readme(readme: str, table: str) -> Optional[str]:
+    """README text with the block between the env-registry markers
+    replaced by ``table``; None when the markers are missing."""
+    begin = readme.find(_TABLE_BEGIN)
+    end = readme.find(_TABLE_END)
+    if begin < 0 or end < 0 or end < begin:
+        return None
+    head = readme[: begin + len(_TABLE_BEGIN)]
+    return f"{head}\n{table}{readme[end:]}"
+
+
+def _inventory_artifacts(result, root: str):
+    """(fresh inventory, fresh registry, fresh README text or None,
+    per-artifact drift messages) for --write/--check-inventory."""
+    import difflib
+
+    drift = []
+    inv_path = os.path.join(root, engine.INVENTORY_PATH)
+    reg_path = os.path.join(root, engine.ENV_REGISTRY_PATH)
+    readme_path = os.path.join(root, "README.md")
+    try:
+        with open(inv_path, "r", encoding="utf-8") as fh:
+            committed_inv = json.load(fh)
+    except (FileNotFoundError, json.JSONDecodeError):
+        committed_inv = None
+    committed_reg = engine.load_env_registry(root)
+    fresh_inv = result.inventory
+    fresh_reg = engine.regenerate_env_registry(result.pkg, committed_reg)
+    if committed_inv != fresh_inv:
+        old = json.dumps(committed_inv, indent=2, sort_keys=True)
+        new = json.dumps(fresh_inv, indent=2, sort_keys=True)
+        diff = "\n".join(
+            list(
+                difflib.unified_diff(
+                    old.splitlines(),
+                    new.splitlines(),
+                    "committed inventory.json",
+                    "regenerated",
+                    lineterm="",
+                )
+            )[:40]
+        )
+        drift.append(
+            f"{engine.INVENTORY_PATH} drifted from the tree:\n{diff}"
+        )
+    if committed_reg != fresh_reg:
+        drift.append(
+            f"{engine.ENV_REGISTRY_PATH} drifted (vars or readers "
+            "changed); regenerate with --write-inventory and describe "
+            "any new knob"
+        )
+    fresh_readme = None
+    try:
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            readme = fh.read()
+    except FileNotFoundError:
+        readme = None
+    if readme is not None:
+        table = engine.render_env_table(fresh_reg)
+        fresh_readme = _render_readme(readme, table)
+        if fresh_readme is None:
+            drift.append(
+                "README.md lacks the fa-env-registry markers; the knob "
+                "table must be rendered from the registry, not typed"
+            )
+        elif fresh_readme != readme:
+            drift.append(
+                "README.md env-knob table drifted from the registry; "
+                "regenerate with --write-inventory"
+            )
+    return fresh_inv, fresh_reg, fresh_readme, readme_path, drift
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -82,6 +186,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         rules = [RULES_BY_ID[w] for w in wanted]
 
+    if args.write_inventory or args.check_inventory:
+        if args.select:
+            print(
+                "--write/--check-inventory need the full rule set and "
+                "the full default paths; drop --select",
+                file=sys.stderr,
+            )
+            return 2
+        # A partial-path run would regenerate (or drift-check) the
+        # committed inventory from a TRUNCATED census; refuse when the
+        # root holds linted files the given paths do not cover.
+        full = set(engine.iter_py_files(DEFAULT_PATHS, args.root))
+        given = set(engine.iter_py_files(paths, args.root))
+        missing = full - given
+        if missing:
+            print(
+                f"--write/--check-inventory need the full default "
+                f"paths ({' '.join(DEFAULT_PATHS)}): {len(missing)} "
+                "linted file(s) under this root are not covered by "
+                f"{' '.join(paths)}",
+                file=sys.stderr,
+            )
+            return 2
+
     baseline = None
     if args.baseline and not args.write_baseline:
         try:
@@ -95,6 +223,61 @@ def main(argv: Optional[List[str]] = None) -> int:
     result = engine.lint_paths(
         paths, root=args.root, baseline=baseline, rules=rules
     )
+
+    if args.write_inventory or args.check_inventory:
+        fresh_inv, fresh_reg, fresh_readme, readme_path, drift = (
+            _inventory_artifacts(result, args.root)
+        )
+        if args.write_inventory:
+            # lint: waive G009 -- lint artifacts, not run outputs: a torn write is re-run, not parsed
+            with open(
+                os.path.join(args.root, engine.INVENTORY_PATH),
+                "w",
+                encoding="utf-8",
+            ) as fh:
+                json.dump(fresh_inv, fh, indent=2, sort_keys=False)
+                fh.write("\n")
+            # lint: waive G009 -- lint artifacts, not run outputs: a torn write is re-run, not parsed
+            with open(
+                os.path.join(args.root, engine.ENV_REGISTRY_PATH),
+                "w",
+                encoding="utf-8",
+            ) as fh:
+                json.dump(fresh_reg, fh, indent=2, sort_keys=False)
+                fh.write("\n")
+            if fresh_readme is not None:
+                # lint: waive G009 -- lint artifacts, not run outputs: a torn write is re-run, not parsed
+                with open(readme_path, "w", encoding="utf-8") as fh:
+                    fh.write(fresh_readme)
+            undescribed = [
+                n
+                for n, e in fresh_reg["vars"].items()
+                if not e.get("description")
+            ]
+            print(
+                f"inventory written: {len(fresh_inv['fetch_sites'])} "
+                f"fetch site(s), {len(fresh_inv['failpoint_sites'])} "
+                f"failpoint site(s), {len(fresh_reg['vars'])} env "
+                f"knob(s), {len(fresh_inv['waivers'])} waiver(s)"
+            )
+            if undescribed:
+                print(
+                    "describe these registry entries before committing: "
+                    + ", ".join(sorted(undescribed)),
+                    file=sys.stderr,
+                )
+            return 0
+        if drift:
+            for msg in drift:
+                print(f"inventory drift: {msg}", file=sys.stderr)
+            print(
+                "inventory churn must ride the PR that causes it: run "
+                "`python -m tools.lint --write-inventory` and commit "
+                "the result",
+                file=sys.stderr,
+            )
+            return 1
+        # fall through: --check-inventory also reports lint findings
 
     if args.write_baseline:
         if not args.baseline:
@@ -110,6 +293,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             return 2
         data = engine.make_baseline(result.findings)
+        # lint: waive G009 -- the baseline is a lint artifact, not a run output; a torn write is re-run
         with open(args.baseline, "w", encoding="utf-8") as fh:
             json.dump(data, fh, indent=2, sort_keys=False)
             fh.write("\n")

@@ -15,7 +15,13 @@ A ``# lint:`` comment on the flagged line or the line directly above it
 waives matching rules on that line.  Tokens are either a rule id
 (``G001``) after the word ``waive``, or a rule's named alias
 (``fetch-site``); anything after ``--`` is the human justification and is
-ignored by the matcher (but reviewers should insist on it).
+ignored by the matcher (but reviewers should insist on it).  Three
+grammar refinements pinned by tests (v2): a comment above a DECORATOR
+attaches to the decorated ``def``/``class`` line (findings anchor
+there); several ``lint:`` segments may be stacked in one comment
+(``# lint: fetch-site -- x; lint: waive G004 -- y``) and all match; and
+a waiver anywhere inside a multi-line statement binds to the flagged
+node's span, so the comment can sit on the argument it is about.
 
 Baselines freeze pre-existing findings so the CLI only fails on NEW ones:
 a finding's fingerprint is ``rule|path|stripped-source-line`` (line
@@ -35,9 +41,6 @@ import re
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-_WAIVER_RE = re.compile(r"lint:\s*([^#]*)")
-
-
 @dataclasses.dataclass(frozen=True)
 class Finding:
     rule: str  # "G001"
@@ -46,6 +49,10 @@ class Finding:
     col: int  # 0-based
     message: str
     snippet: str  # stripped source line (fingerprint component)
+    # Last line of the flagged node (multi-line statements): waivers
+    # anywhere in [line, end_line] bind to this finding.  NOT part of
+    # the fingerprint — reformatting must not un-freeze a baseline.
+    end_line: int = 0
 
     def fingerprint(self) -> str:
         return f"{self.rule}|{self.path}|{self.snippet}"
@@ -62,29 +69,56 @@ class LintResult:
     findings: List[Finding]
     new_findings: List[Finding]  # after baseline subtraction
     parse_errors: List[Finding]  # syntax errors reported as G000
+    # Machine-readable contract inventory (fetch sites, failpoint
+    # sites, env knobs, waiver census) built from the same parsed
+    # files — tools/ci.sh drift-checks it against the committed copy.
+    inventory: Optional[dict] = None
+    # The package context the run was built from (registry
+    # regeneration re-walks it; never serialized).
+    pkg: Optional["PackageContext"] = None
 
     @property
     def failed(self) -> bool:
         return bool(self.new_findings) or bool(self.parse_errors)
 
 
-def _parse_waiver_tokens(comment: str) -> Set[str]:
-    """``# lint: waive G001, G006 -- why`` -> {"G001", "G006"}.
+def _parse_waiver_segments(comment: str) -> List[Tuple[Set[str], str]]:
+    """``# lint: waive G001 -- why; lint: fetch-site -- why2`` ->
+    [({"G001"}, "why"), ({"fetch-site"}, "why2")].
 
-    The justification separator accepts ``--`` and the unicode dashes
-    people actually type (– —); and only well-formed tokens (rule ids /
-    kebab-case aliases) count, so a missing separator can never let a
-    justification word accidentally waive another rule."""
-    m = _WAIVER_RE.search(comment)
-    if not m:
-        return set()
-    body = re.split(r"--|[–—]", m.group(1))[0]
-    tokens = {
-        t
-        for t in re.split(r"[,\s]+", body.strip())
-        if re.fullmatch(r"[A-Za-z][A-Za-z0-9_-]*", t)
-    }
-    tokens.discard("waive")
+    Every ``lint:`` segment in the comment is parsed (stacked waivers on
+    one line must ALL match — pinned by tests).  The justification
+    separator accepts ``--`` and the unicode dashes people actually type
+    (– —); only well-formed tokens (rule ids / kebab-case aliases)
+    count, so a missing separator can never let a justification word
+    accidentally waive another rule."""
+    out: List[Tuple[Set[str], str]] = []
+    for segment in re.split(r"lint:", comment)[1:]:
+        segment = segment.split("#")[0]
+        parts = re.split(r"--|[–—]", segment, maxsplit=1)
+        body = parts[0]
+        justification = parts[1].strip().rstrip(";").strip() if (
+            len(parts) > 1
+        ) else ""
+        # A stacked comment separates segments with ';' — keep the
+        # leading segment's tokens clean of the next segment's prose.
+        body = body.split(";")[0]
+        tokens = {
+            t
+            for t in re.split(r"[,\s]+", body.strip())
+            if re.fullmatch(r"[A-Za-z][A-Za-z0-9_-]*", t)
+        }
+        tokens.discard("waive")
+        if tokens:
+            out.append((tokens, justification))
+    return out
+
+
+def _parse_waiver_tokens(comment: str) -> Set[str]:
+    """Union of every stacked segment's tokens (the waiver matcher)."""
+    tokens: Set[str] = set()
+    for seg_tokens, _just in _parse_waiver_segments(comment):
+        tokens |= seg_tokens
     return tokens
 
 
@@ -110,11 +144,18 @@ class FileContext:
             )
         self.comments: Dict[int, str] = {}
         self.waivers: Dict[int, Set[str]] = {}
+        # line -> [(tokens, justification)] per stacked segment (the
+        # inventory's waiver census reads the justifications).
+        self.waiver_details: Dict[int, List[Tuple[Set[str], str]]] = {}
         self._scan_comments()
         self.str_consts: Dict[str, str] = {}
         self.int_consts: Dict[str, int] = {}
+        # Decorated def/class line -> extra lines whose waivers attach
+        # to it (each decorator line + the line above the first one).
+        self._decorator_alt: Dict[int, List[int]] = {}
         if self.tree is not None:
             self._collect_consts()
+            self._collect_decorator_spans()
 
     def _line(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -128,9 +169,12 @@ class FileContext:
             ):
                 if tok.type == tokenize.COMMENT:
                     self.comments[tok.start[0]] = tok.string
-                    waived = _parse_waiver_tokens(tok.string)
-                    if waived:
-                        self.waivers[tok.start[0]] = waived
+                    segments = _parse_waiver_segments(tok.string)
+                    if segments:
+                        self.waiver_details[tok.start[0]] = segments
+                        self.waivers[tok.start[0]] = set().union(
+                            *(t for t, _ in segments)
+                        )
         except (tokenize.TokenError, IndentationError):
             pass  # parse_error already carries the report
 
@@ -149,8 +193,32 @@ class FileContext:
                 ):
                     self.int_consts[tgt.id] = node.value.value
 
-    def is_waived(self, rule_id: str, aliases: Sequence[str], line: int) -> bool:
-        for ln in (line, line - 1):
+    def _collect_decorator_spans(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if not node.decorator_list:
+                continue
+            first = min(d.lineno for d in node.decorator_list)
+            # Findings on a decorated def anchor at the `def` line; a
+            # waiver written above the decorator stack (or on any
+            # decorator line) must attach there too.
+            self._decorator_alt[node.lineno] = [first - 1] + sorted(
+                d.lineno for d in node.decorator_list
+            )
+
+    def is_waived(
+        self,
+        rule_id: str,
+        aliases: Sequence[str],
+        line: int,
+        end_line: int = 0,
+    ) -> bool:
+        candidates = set(range(line - 1, max(end_line, line) + 1))
+        candidates.update(self._decorator_alt.get(line, ()))
+        for ln in candidates:
             toks = self.waivers.get(ln)
             if toks and (rule_id in toks or any(a in toks for a in aliases)):
                 return True
@@ -160,8 +228,22 @@ class FileContext:
 class PackageContext:
     """Cross-file facts rules may consult (built in a first pass)."""
 
-    def __init__(self, files: Sequence[FileContext]):
+    def __init__(
+        self,
+        files: Sequence[FileContext],
+        env_registry: Optional[dict] = None,
+    ):
+        from tools.lint.graph import PackageGraph
+
         self.files = files
+        self.by_path: Dict[str, FileContext] = {f.path: f for f in files}
+        # The v2 symbol table / call graph (tools/lint/graph.py): rules
+        # resolve renamed imports, cross-file constants, and callees
+        # through it.
+        self.graph = PackageGraph(files)
+        # Committed FA_* knob registry (tools/lint/env_registry.json);
+        # None when linting sources with no registry to check against.
+        self.env_registry = env_registry
         # NAME -> str value, package-wide (for `from ... import AXIS`).
         self.str_consts: Dict[str, str] = {}
         for f in files:
@@ -171,20 +253,38 @@ class PackageContext:
             if f.tree is not None:
                 self._collect_axes(f)
 
+    # Axis-declaration sources.  ``P``/``PartitionSpec`` literals count
+    # as declarations (G002 satellite): a spec names the mesh axes it
+    # shards over, and this codebase writes specs next to the shard_map
+    # they feed — a typo'd spec axis fails the same trace-time way and
+    # is caught by the same census.
+    _MESH_CTORS = ("Mesh", "make_mesh", "AbstractMesh", "P", "PartitionSpec")
+    _SHARD_CALLS = ("shard_map", "smap", "pmap")
+
     def _collect_axes(self, ctx: FileContext) -> None:
         """Mesh axis declarations: string literals (or resolvable names)
         anywhere in the arguments of ``Mesh(...)`` / ``make_mesh(...)`` /
-        ``AbstractMesh(...)`` calls."""
+        ``AbstractMesh(...)`` / ``P(...)`` / ``PartitionSpec(...)``
+        calls, plus the ``axis_names=`` / ``axis_name=`` keywords of
+        ``shard_map(...)``-style calls (the keyword spelling ROADMAP
+        queued for G002)."""
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if terminal_name(node.func) not in (
-                "Mesh",
-                "make_mesh",
-                "AbstractMesh",
-            ):
+            t = terminal_name(node.func)
+            if t in self._MESH_CTORS:
+                exprs = list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]
+            elif t in self._SHARD_CALLS:
+                exprs = [
+                    kw.value
+                    for kw in node.keywords
+                    if kw.arg in ("axis_names", "axis_name")
+                ]
+            else:
                 continue
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for arg in exprs:
                 for sub in ast.walk(arg):
                     s = resolve_str(sub, ctx, self)
                     if s is not None:
@@ -215,8 +315,10 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 def resolve_str(
     node: ast.AST, ctx: FileContext, pkg: Optional["PackageContext"] = None
 ) -> Optional[str]:
-    """Constant str, or a Name resolvable to a module-level / package-level
-    string constant."""
+    """Constant str, or a Name resolvable to a module-level /
+    package-level string constant — including, via the v2 graph, a
+    renamed cross-file import (``from pkg.meshdef import AXIS as A``)
+    or a dotted module reference (``meshdef.AXIS``)."""
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
     if isinstance(node, ast.Name):
@@ -224,14 +326,20 @@ def resolve_str(
             return ctx.str_consts[node.id]
         if pkg is not None and node.id in pkg.str_consts:
             return pkg.str_consts[node.id]
+    if pkg is not None and isinstance(node, (ast.Name, ast.Attribute)):
+        return pkg.graph.resolve_str_const(ctx, node)
     return None
 
 
-def resolve_int(node: ast.AST, ctx: FileContext) -> Optional[int]:
+def resolve_int(
+    node: ast.AST, ctx: FileContext, pkg: Optional["PackageContext"] = None
+) -> Optional[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int) and not isinstance(node.value, bool):
         return node.value
     if isinstance(node, ast.Name) and node.id in ctx.int_consts:
         return ctx.int_consts[node.id]
+    if pkg is not None and isinstance(node, (ast.Name, ast.Attribute)):
+        return pkg.graph.resolve_int_const(ctx, node)
     return None
 
 
@@ -259,9 +367,11 @@ def iter_py_files(paths: Iterable[str], root: str = ".") -> List[str]:
 
 
 def _run_rules(
-    files: Sequence[FileContext], rules: Sequence
-) -> Tuple[List[Finding], List[Finding]]:
-    pkg = PackageContext(files)
+    files: Sequence[FileContext],
+    rules: Sequence,
+    env_registry: Optional[dict] = None,
+) -> Tuple[List[Finding], List[Finding], "PackageContext"]:
+    pkg = PackageContext(files, env_registry=env_registry)
     findings: List[Finding] = []
     parse_errors = [f.parse_error for f in files if f.parse_error is not None]
     for ctx in files:
@@ -270,23 +380,56 @@ def _run_rules(
         for rule in rules:
             for finding in rule.check(ctx, pkg):
                 if not ctx.is_waived(
-                    rule.id, rule.aliases, finding.line
+                    rule.id, rule.aliases, finding.line, finding.end_line
                 ):
                     findings.append(finding)
+    # Package-wide rules (the v2 census rules): findings may land in any
+    # file; waivers still apply through the owning file's context.
+    for rule in rules:
+        for finding in rule.check_package(pkg):
+            ctx = pkg.by_path.get(finding.path)
+            if ctx is None or not ctx.is_waived(
+                rule.id, rule.aliases, finding.line, finding.end_line
+            ):
+                findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings, parse_errors
+    return findings, parse_errors, pkg
 
 
 def lint_sources(
-    sources: Sequence[Tuple[str, str]], rules: Optional[Sequence] = None
+    sources: Sequence[Tuple[str, str]],
+    rules: Optional[Sequence] = None,
+    env_registry: Optional[dict] = None,
 ) -> LintResult:
     """In-memory entry point (what tests/test_lint.py drives):
     ``sources`` is [(relpath, source_text), ...]."""
     if rules is None:
         from tools.lint.rules import ALL_RULES as rules  # noqa: N811
     files = [FileContext(p, s) for p, s in sources]
-    findings, parse_errors = _run_rules(files, rules)
-    return LintResult(findings, list(findings), parse_errors)
+    findings, parse_errors, pkg = _run_rules(
+        files, rules, env_registry=env_registry
+    )
+    return LintResult(
+        findings, list(findings), parse_errors, build_inventory(pkg), pkg
+    )
+
+
+ENV_REGISTRY_PATH = os.path.join("tools", "lint", "env_registry.json")
+INVENTORY_PATH = os.path.join("tools", "lint", "inventory.json")
+
+
+def load_env_registry(root: str = ".") -> Optional[dict]:
+    """The committed FA_* knob registry, or None when the tree being
+    linted does not carry one (fixture packages)."""
+    path = os.path.join(root, ENV_REGISTRY_PATH)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return None
+    if not isinstance(data, dict) or "vars" not in data:
+        raise ValueError(f"{path}: not a graftlint env registry file")
+    return data
 
 
 def lint_paths(
@@ -294,9 +437,12 @@ def lint_paths(
     root: str = ".",
     baseline: Optional[dict] = None,
     rules: Optional[Sequence] = None,
+    env_registry: Optional[dict] = None,
 ) -> LintResult:
     if rules is None:
         from tools.lint.rules import ALL_RULES as rules  # noqa: N811
+    if env_registry is None:
+        env_registry = load_env_registry(root)
     files = []
     for fp in iter_py_files(paths, root):
         rel = os.path.relpath(fp, root)
@@ -308,9 +454,11 @@ def lint_paths(
             files[-1].parse_error = Finding(
                 "G000", rel.replace(os.sep, "/"), 1, 0, f"unreadable: {e}", ""
             )
-    findings, parse_errors = _run_rules(files, rules)
+    findings, parse_errors, pkg = _run_rules(
+        files, rules, env_registry=env_registry
+    )
     new = subtract_baseline(findings, baseline or {})
-    return LintResult(findings, new, parse_errors)
+    return LintResult(findings, new, parse_errors, build_inventory(pkg), pkg)
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +486,272 @@ def make_baseline(findings: Sequence[Finding]) -> dict:
         ),
         "fingerprints": dict(sorted(counts.items())),
     }
+
+
+# ---------------------------------------------------------------------------
+# Contract inventory (v2): the machine-readable census of the repo's
+# prose-documented preconditions — audited fetch sites, failpoint sites,
+# FA_* env knobs, and the waiver audit trail.  tools/ci.sh drift-checks
+# the committed tools/lint/inventory.json against a fresh build, so
+# inventory churn must ride the PR that causes it.
+
+_RETRY_FETCH_FQS = (
+    "fastapriori_tpu.reliability.retry.fetch",
+    "fastapriori_tpu.reliability.retry.fetch_async",
+)
+_FAILPOINT_FIRE_FQ = "fastapriori_tpu.reliability.failpoints.fire"
+_ENV_VAR_RE = re.compile(r"FA_[A-Z0-9_]+")
+
+
+def is_test_path(path: str) -> bool:
+    parts = path.replace(os.sep, "/").split("/")
+    return any(p in ("tests", "tests_tpu") for p in parts)
+
+
+def fetch_label_sites(ctx: FileContext, pkg: "PackageContext"):
+    """``(label, call-node)`` for every audited-fetch-helper call with a
+    literal site label in this file, resolved to the reliability module
+    through the graph (a local ``fetch()`` of some cache API does not
+    count; a renamed import still does)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fq = pkg.graph.resolve_expr(ctx, node.func)
+        if fq not in _RETRY_FETCH_FQS:
+            continue
+        label = None
+        for a in list(node.args) + [
+            kw.value for kw in node.keywords if kw.arg == "site"
+        ]:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                label = a.value
+                break
+        if label is not None:
+            out.append((label, node))
+    return out
+
+
+def failpoint_fire_sites(ctx: FileContext, pkg: "PackageContext"):
+    """``(site, call-node)`` for literal ``failpoints.fire("...")``
+    sites (dynamic sites — f-strings, variables — are not censusable
+    and are deliberately skipped)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fq = pkg.graph.resolve_expr(ctx, node.func)
+        if fq != _FAILPOINT_FIRE_FQ:
+            d = dotted_name(node.func)
+            if d is None or not d.endswith("failpoints.fire"):
+                continue
+        if node.args and isinstance(node.args[0], ast.Constant) and (
+            isinstance(node.args[0].value, str)
+        ):
+            out.append((node.args[0].value, node))
+    return out
+
+
+def env_read_sites(ctx: FileContext):
+    """``(name, node)`` for every FA_* environment READ: ``os.environ
+    .get``/``os.getenv``/``os.environ[...]`` (loads only — tests that
+    SET knobs are not reads)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        name_node = None
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            if d.endswith("environ.get") or d in ("os.getenv", "getenv"):
+                if node.args:
+                    name_node = node.args[0]
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Load
+        ):
+            d = dotted_name(node.value) or ""
+            if d.endswith("environ"):
+                name_node = node.slice
+                if isinstance(name_node, getattr(ast, "Index", ())):
+                    name_node = name_node.value  # py<3.9 AST shape
+        if (
+            name_node is not None
+            and isinstance(name_node, ast.Constant)
+            and isinstance(name_node.value, str)
+            and name_node.value.startswith("FA_")
+        ):
+            out.append((name_node.value, node))
+    return out
+
+
+def str_constant_paths(pkg: "PackageContext") -> Dict[str, Set[str]]:
+    """Every string literal in the package -> paths holding it (built
+    once per run; the census rules and the registry scan share it)."""
+    cached = getattr(pkg, "_str_constant_paths", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, Set[str]] = {}
+    for ctx in pkg.files:
+        if ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                out.setdefault(node.value, set()).add(ctx.path)
+    pkg._str_constant_paths = out
+    return out
+
+
+def env_var_references(pkg: "PackageContext") -> Dict[str, Set[str]]:
+    """FA_* name -> paths holding a whole-string literal reference —
+    the registry-completeness universe (covers knobs read by native
+    code but exercised from tests, e.g. FA_NO_SIMD)."""
+    return {
+        value: paths
+        for value, paths in str_constant_paths(pkg).items()
+        if _ENV_VAR_RE.fullmatch(value)
+    }
+
+
+def site_census(pkg: "PackageContext"):
+    """``(fetch_sites, fire_sites, env_reads)`` over every NON-TEST
+    file, each as ``[(key, ctx, node)]`` — built once per run and
+    shared by G013 and the inventory builder."""
+    cached = getattr(pkg, "_site_census", None)
+    if cached is not None:
+        return cached
+    fetches, fires, envs = [], [], []
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        for label, node in fetch_label_sites(ctx, pkg):
+            fetches.append((label, ctx, node))
+        for site, node in failpoint_fire_sites(ctx, pkg):
+            fires.append((site, ctx, node))
+        for name, node in env_read_sites(ctx):
+            envs.append((name, ctx, node))
+    pkg._site_census = (fetches, fires, envs)
+    return pkg._site_census
+
+
+def _counted(entries):
+    """[(key-dict)] -> sorted unique entries with a ``count`` field."""
+    counts: Dict[Tuple, int] = {}
+    for e in entries:
+        key = tuple(sorted(e.items()))
+        counts[key] = counts.get(key, 0) + 1
+    out = []
+    for key, n in sorted(counts.items()):
+        d = dict(key)
+        d["count"] = n
+        out.append(d)
+    return out
+
+
+def build_inventory(pkg: "PackageContext") -> dict:
+    """Deterministic contract inventory over the linted package (test
+    files are excluded from the site censuses — they exercise sites,
+    they do not define them — but included in the waiver census)."""
+    fetch_census, fire_census, env_census = site_census(pkg)
+    fetches = [{"label": l, "path": c.path} for l, c, _n in fetch_census]
+    fires = [{"site": s, "path": c.path} for s, c, _n in fire_census]
+    envs = [{"name": n, "path": c.path} for n, c, _n in env_census]
+    waivers = []
+    for ctx in pkg.files:
+        if ctx.tree is None:
+            continue
+        for _line, segments in sorted(ctx.waiver_details.items()):
+            for tokens, justification in segments:
+                waivers.append(
+                    {
+                        "path": ctx.path,
+                        "tokens": ",".join(sorted(tokens)),
+                        "justification": justification,
+                    }
+                )
+    return {
+        "version": 1,
+        "comment": (
+            "Generated by `python -m tools.lint ... --write-inventory`; "
+            "drift-checked by tools/ci.sh.  Regenerate in the PR that "
+            "changes any censused site."
+        ),
+        "fetch_sites": _counted(fetches),
+        "failpoint_sites": _counted(fires),
+        "env_reads": _counted(envs),
+        "waivers": _counted(waivers),
+    }
+
+
+def regenerate_env_registry(
+    pkg: "PackageContext", existing: Optional[dict]
+) -> dict:
+    """Rebuild tools/lint/env_registry.json deterministically from the
+    parsed package: the variable set and reader paths come from the
+    scan; descriptions are carried over from the committed registry
+    (new knobs get an empty description for a human to fill in — G012
+    keeps unknown reads failing until the entry exists)."""
+    old_vars = (existing or {}).get("vars", {})
+    refs = env_var_references(pkg)
+    # Test files reference knobs two ways that must not be conflated: a
+    # test exercising a REAL knob keeps its (possibly native-read, e.g.
+    # FA_NO_SIMD) registry entry alive, but a lint FIXTURE knob living
+    # only in test sources must never enter the registry.  So test-only
+    # references RETAIN existing entries and never ADD new ones.
+    nontest_names: Set[str] = set()
+    for name, paths in refs.items():
+        if any(not is_test_path(p) for p in paths):
+            nontest_names.add(name)
+    names = nontest_names | (set(old_vars) & set(refs))
+    readers: Dict[str, Set[str]] = {}
+    for name, ctx, _node in site_census(pkg)[2]:
+        readers.setdefault(name, set()).add(ctx.path)
+    # Knobs read through the strict helpers (utils/env.py) have no
+    # literal os.environ read at the call site — the literal name
+    # handed to ANY call in non-test code marks the reader.
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Constant) and isinstance(
+                    a.value, str
+                ) and _ENV_VAR_RE.fullmatch(a.value):
+                    readers.setdefault(a.value, set()).add(ctx.path)
+    out_vars = {}
+    for name in sorted(names):
+        entry = {
+            "description": old_vars.get(name, {}).get("description", ""),
+            "readers": sorted(readers.get(name, ())),
+        }
+        out_vars[name] = entry
+    return {
+        "version": 1,
+        "comment": (
+            "FA_* knob registry: the variable set and reader paths are "
+            "generated (`--write-inventory`); descriptions are "
+            "hand-written and preserved across regenerations.  G012 "
+            "fails reads of unregistered knobs and flags stale entries."
+        ),
+        "vars": out_vars,
+    }
+
+
+def render_env_table(registry: dict) -> str:
+    """The README's FA_* knob table, rendered from the checked registry
+    so the docs cannot drift from the artifact."""
+    lines = [
+        "| knob | read at | purpose |",
+        "|------|---------|---------|",
+    ]
+    for name, entry in sorted(registry.get("vars", {}).items()):
+        readers = ", ".join(f"`{p}`" for p in entry.get("readers", []))
+        if not readers:
+            readers = "— (native code / tests only)"
+        desc = entry.get("description", "") or "*(undocumented)*"
+        lines.append(f"| `{name}` | {readers} | {desc} |")
+    return "\n".join(lines) + "\n"
 
 
 def subtract_baseline(

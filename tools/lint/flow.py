@@ -1,0 +1,543 @@
+"""graftlint v2 dataflow: intra-function def-use/taint walks.
+
+Two analyses, both statement-ordered approximations (branches are walked
+with a shared environment, the worse state wins; runtime re-ordering
+inside loops is out of scope — a lint that guesses wrong asks for a
+waiver, it does not stay silent):
+
+- **Shape taint** (G011): a *dynamic int* — ``len()``, ``.shape[...]``,
+  ``.size``, and arithmetic thereon — is DYNAMIC until it flows through
+  one of the bucket helpers (``next_pow2`` / ``pad_axis`` /
+  ``_pad_positions``), which make it BUCKETED.  A DYNAMIC value reaching
+  a shape-forming argument compiles a fresh XLA program per distinct
+  value (VERDICT r5 weak #6: 14 cache-miss compiles on a *primed*
+  cache).  Arithmetic on a BUCKETED value stays BUCKETED: dividing a
+  pow2 by a constant keeps the shape family finite, which is the whole
+  point of the discipline.
+
+- **Donation tracking** (G010): an argument passed at a
+  ``donate_argnums``/``donate_argnames`` position of a jitted call has
+  its buffer freed at dispatch; any later reference in the same scope
+  reads freed memory (jax errors out at best).  One level of
+  cross-function propagation: a function that forwards its own parameter
+  to a donated position *donates that parameter*, and resolved callers
+  inherit the contract.
+
+Both analyses get one level of cross-function propagation through
+tools/lint/graph.py summaries and no more — depth-2 inference is where
+static guesses about this codebase start being wrong silently.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.graph import PackageGraph
+
+# -- shape taint ------------------------------------------------------------
+
+CLEAN, BUCKETED, DYNAMIC = 0, 1, 2
+
+# The bucket helpers (ops/bitmap.py next_pow2 / pad_axis and
+# parallel/mesh.py _pad_positions) — matched by terminal name, same
+# convention as every v1 rule.
+SANITIZER_NAMES = ("next_pow2", "pad_axis", "_pad_positions")
+
+# Terminal call names that introduce a dynamic int.
+_DYNAMIC_CALLS = ("len",)
+
+# Attribute reads that introduce a dynamic int.
+_DYNAMIC_ATTRS = ("shape", "size", "nbytes")
+
+# Calls that propagate their argument states unchanged.
+_PASSTHROUGH_CALLS = ("int", "abs", "min", "max", "sum", "round")
+
+
+class ShapeFlow:
+    """Per-function shape-taint walk.
+
+    ``summaries`` maps fully-qualified function names to the taint state
+    of their return value (computed by :func:`return_summaries` — the
+    one level of cross-function propagation).
+    """
+
+    def __init__(
+        self,
+        ctx,
+        graph: Optional[PackageGraph] = None,
+        summaries: Optional[Dict[str, int]] = None,
+        check_sinks: bool = True,
+    ):
+        self.ctx = ctx
+        self.graph = graph
+        self.summaries = summaries or {}
+        # The summary pass only needs the assignment walk + return
+        # states; skipping sink evaluation there halves the package
+        # pass (lint wall time is CI-budgeted).
+        self.check_sinks = check_sinks
+
+    # -- expression evaluation ------------------------------------------
+    def eval(self, node: ast.AST, env: Dict[str, int]) -> int:
+        from tools.lint.engine import terminal_name
+
+        if isinstance(node, ast.Constant):
+            return CLEAN
+        if isinstance(node, ast.Name):
+            return env.get(node.id, CLEAN)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _DYNAMIC_ATTRS:
+                return DYNAMIC
+            return CLEAN
+        if isinstance(node, ast.Subscript):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return max(
+                (self.eval(e, env) for e in node.elts), default=CLEAN
+            )
+        if isinstance(node, ast.BinOp):
+            return max(
+                self.eval(node.left, env), self.eval(node.right, env)
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, ast.IfExp):
+            return max(self.eval(node.body, env), self.eval(node.orelse, env))
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            # Comprehension targets are treated CLEAN; the element
+            # expression's state is the collection's element state.
+            return self.eval(node.elt, env)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            arg_state = max(
+                (self.eval(a, env) for a in node.args), default=CLEAN
+            )
+            if t in SANITIZER_NAMES:
+                return BUCKETED
+            if t in _DYNAMIC_CALLS:
+                return DYNAMIC
+            if t in _PASSTHROUGH_CALLS:
+                return arg_state
+            if self.graph is not None:
+                hit = self.graph.resolve_call(self.ctx, node)
+                if hit is not None:
+                    mod, fn = hit
+                    for local, cand in mod.functions.items():
+                        if cand is fn:
+                            state = self.summaries.get(
+                                f"{mod.name}.{local}"
+                            )
+                            if state is not None:
+                                return state
+            return CLEAN
+        return CLEAN
+
+    # -- statement walk -------------------------------------------------
+    def _assign(self, target: ast.AST, state: int, env: Dict[str, int]):
+        if isinstance(target, ast.Name):
+            env[target.id] = state
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign(el, state, env)
+
+    def walk(
+        self, body: Sequence[ast.stmt], env: Dict[str, int]
+    ) -> Iterator[Tuple[ast.Call, str, int]]:
+        """Yield ``(call, argument-description, state)`` for every
+        shape-sink argument; the caller decides which states to flag."""
+        compound = (
+            ast.For,
+            ast.While,
+            ast.If,
+            ast.With,
+            ast.AsyncWith,
+            ast.Try,
+        )
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run later, analyzed separately
+            if self.check_sinks:
+                # Sinks inside a compound statement's SUITES are checked
+                # by the recursive walk below, AFTER the suite's own
+                # assignments update the env — pre-scanning them here
+                # would judge `n = next_pow2(n); jnp.zeros(n)` with the
+                # stale pre-branch env.  Only header expressions (the
+                # test / iterable / context managers) belong to this
+                # statement's scan.
+                if isinstance(stmt, compound):
+                    headers: List[ast.AST] = []
+                    if isinstance(stmt, (ast.If, ast.While)):
+                        headers = [stmt.test]
+                    elif isinstance(stmt, ast.For):
+                        headers = [stmt.iter]
+                    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        headers = [i.context_expr for i in stmt.items]
+                    for h in headers:
+                        for node in ast.walk(h):
+                            if isinstance(node, ast.Call):
+                                yield from self._check_sink(node, env)
+                else:
+                    for node in ast.walk(stmt):
+                        if isinstance(node, ast.Call):
+                            yield from self._check_sink(node, env)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                if value is None:
+                    continue
+                state = self.eval(value, env)
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    self._assign(t, state, env)
+            elif isinstance(stmt, ast.AugAssign):
+                state = max(
+                    self.eval(stmt.target, env), self.eval(stmt.value, env)
+                )
+                self._assign(stmt.target, state, env)
+            elif isinstance(stmt, ast.For):
+                self._assign(stmt.target, self.eval(stmt.iter, env), env)
+                yield from self.walk(stmt.body + stmt.orelse, env)
+            elif isinstance(stmt, ast.While):
+                yield from self.walk(stmt.body + stmt.orelse, env)
+            elif isinstance(stmt, ast.If):
+                yield from self.walk(stmt.body, env)
+                yield from self.walk(stmt.orelse, env)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self.walk(stmt.body, env)
+            elif isinstance(stmt, ast.Try):
+                yield from self.walk(stmt.body, env)
+                for h in stmt.handlers:
+                    yield from self.walk(h.body, env)
+                yield from self.walk(stmt.orelse + stmt.finalbody, env)
+
+    # Shape-forming sinks: terminal name -> selector of the shape
+    # argument expressions in the call.  Only DEVICE shape-formers
+    # count (jnp/lax roots, ShapeDtypeStruct): a host numpy scratch
+    # buffer with a data-exact size compiles nothing — the discipline
+    # binds at the point a size becomes a compiled shape.
+    def _sink_args(self, call: ast.Call) -> List[Tuple[str, ast.AST]]:
+        from tools.lint.engine import dotted_name, terminal_name
+
+        t = terminal_name(call.func)
+        out: List[Tuple[str, ast.AST]] = []
+
+        def kw(name):
+            for k in call.keywords:
+                if k.arg == name:
+                    return k.value
+            return None
+
+        def device_root() -> bool:
+            if not isinstance(call.func, ast.Attribute):
+                return False
+            d = dotted_name(call.func.value)
+            return d in ("jnp", "lax") or (
+                d is not None
+                and d.startswith(("jax.numpy", "jax.lax"))
+            )
+
+        if t in ("zeros", "ones", "full", "empty") and device_root():
+            shape = kw("shape") or (call.args[0] if call.args else None)
+            if shape is not None:
+                out.append((f"{t}() shape", shape))
+        elif t == "reshape":
+            if isinstance(call.func, ast.Attribute) and not _is_module_root(
+                call.func.value
+            ):
+                args = list(call.args)  # x.reshape(a, b)
+            else:
+                args = list(call.args[1:])  # jnp.reshape(x, shape)
+            for a in args:
+                out.append(("reshape() dim", a))
+            nk = kw("newshape") or kw("shape")
+            if nk is not None:
+                out.append(("reshape() shape", nk))
+        elif t == "broadcast_to" and device_root():
+            shape = kw("shape") or (
+                call.args[1] if len(call.args) > 1 else None
+            )
+            if shape is not None:
+                out.append(("broadcast_to() shape", shape))
+        elif t == "pad" and device_root():
+            width = kw("pad_width") or (
+                call.args[1] if len(call.args) > 1 else None
+            )
+            if width is not None:
+                out.append(("pad() width", width))
+        elif t in ("ShapeDtypeStruct", "shape_dtype_struct"):
+            shape = kw("shape") or (call.args[0] if call.args else None)
+            if shape is not None:
+                out.append((f"{t} shape", shape))
+        elif t == "dynamic_slice":
+            sizes = kw("slice_sizes") or (
+                call.args[2] if len(call.args) > 2 else None
+            )
+            if sizes is not None:
+                out.append(("dynamic_slice() sizes", sizes))
+        return out
+
+    def _check_sink(
+        self, call: ast.Call, env: Dict[str, int]
+    ) -> Iterator[Tuple[ast.Call, str, int]]:
+        for desc, expr in self._sink_args(call):
+            yield call, desc, self.eval(expr, env)
+
+
+def _is_module_root(node: ast.AST) -> bool:
+    """``jnp.reshape`` vs ``x.reshape``: treat a bare lower-case Name
+    that looks like a module alias (jnp/np/numpy/lax/jax chains) as a
+    module root, so the first positional arg is the array, not a dim."""
+    from tools.lint.engine import dotted_name
+
+    d = dotted_name(node)
+    return d in ("jnp", "np", "numpy", "jax", "lax") or (
+        d is not None and d.startswith(("jax.", "numpy."))
+    )
+
+
+def return_summaries(
+    files: Sequence, graph: PackageGraph
+) -> Dict[str, int]:
+    """Taint state of each package function's return value, with nested
+    calls resolved only through the sanitizer/dynamic primitives — the
+    depth-1 summary the per-function walk consults."""
+    out: Dict[str, int] = {}
+    for ctx in files:
+        table = graph.by_path.get(ctx.path)
+        if table is None:
+            continue
+        flow = ShapeFlow(ctx, graph=None, summaries=None, check_sinks=False)
+        for local, fn in table.functions.items():
+            env: Dict[str, int] = {}
+            # Run the assignment walk so `n = len(x); return n` works.
+            for _ in flow.walk(fn.body, env):
+                pass
+            state = CLEAN
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    state = max(state, flow.eval(node.value, env))
+            out[f"{table.name}.{local}"] = state
+    return out
+
+
+# -- donation tracking ------------------------------------------------------
+
+
+def _donation_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """``jit(..., donate_argnums=/donate_argnames=)`` -> (positions,
+    kwarg names), or None when the call donates nothing."""
+    from tools.lint.engine import terminal_name
+
+    if terminal_name(call.func) not in ("jit", "pjit"):
+        return None
+    positions: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, int
+                ):
+                    positions.add(sub.value)
+        elif kw.arg == "donate_argnames":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    names.add(sub.value)
+    if not positions and not names:
+        return None
+    return positions, names
+
+
+class DonationUse:
+    """One use-after-donation event."""
+
+    __slots__ = ("use", "name", "donate_line")
+
+    def __init__(self, use: ast.AST, name: str, donate_line: int):
+        self.use = use
+        self.name = name
+        self.donate_line = donate_line
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    args = fn.args
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+def donating_functions(
+    files: Sequence, graph: PackageGraph
+) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """Functions that forward a parameter to a donated position of a
+    jit wrapper defined in their own scope — the one-level donation
+    summary (``mesh.py _unpack_fn``'s inner ``unpack(arr)`` is the
+    in-tree instance)."""
+    out: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for ctx in files:
+        table = graph.by_path.get(ctx.path)
+        if table is None:
+            continue
+        # A donating function necessarily spells donate_argnums/-names
+        # somewhere in its own file; skip the walk everywhere else.
+        if "donate_arg" not in ctx.source:
+            continue
+        module_donators = _scope_donators(ctx.tree.body)
+        for local, fn in table.functions.items():
+            params = _param_names(fn)
+            if params and params[0] in ("self", "cls"):
+                params = params[1:]
+            donated_pos: Set[int] = set()
+            donating = dict(module_donators)
+            donating.update(_scope_donators(fn.body))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                spec = _call_donation(node, donating)
+                if spec is None:
+                    continue
+                positions, names = spec
+                for i in positions:
+                    if i < len(node.args) and isinstance(
+                        node.args[i], ast.Name
+                    ):
+                        arg = node.args[i].id
+                        if arg in params:
+                            donated_pos.add(params.index(arg))
+                for kw in node.keywords:
+                    if kw.arg in names and isinstance(kw.value, ast.Name):
+                        if kw.value.id in params:
+                            donated_pos.add(params.index(kw.value.id))
+            if donated_pos:
+                out[f"{table.name}.{local}"] = (donated_pos, set())
+    return out
+
+
+def _scope_donators(body: Sequence[ast.stmt]) -> Dict[str, Tuple]:
+    """Names bound (anywhere in this scope, including nested defs'
+    enclosing scope via closures) to a donating jit call:
+    ``inner = jax.jit(f, donate_argnums=0)``."""
+    out: Dict[str, Tuple] = {}
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            spec = (
+                _donation_spec(node.value)
+                if isinstance(node.value, ast.Call)
+                else None
+            )
+            if spec is not None:
+                out[tgt.id] = spec + (node.lineno,)
+    return out
+
+
+def _call_donation(
+    call: ast.Call, donating: Dict[str, Tuple]
+) -> Optional[Tuple[Set[int], Set[str]]]:
+    """Donation spec for a call site: direct ``jit(...)(x)``, or a call
+    through a name bound to a donating wrapper."""
+    if isinstance(call.func, ast.Call):
+        spec = _donation_spec(call.func)
+        if spec is not None:
+            return spec
+    if isinstance(call.func, ast.Name) and call.func.id in donating:
+        positions, names, _line = donating[call.func.id]
+        return positions, names
+    return None
+
+
+def donation_uses(
+    ctx,
+    graph: Optional[PackageGraph] = None,
+    fn_summary: Optional[Dict[str, Tuple[Set[int], Set[str]]]] = None,
+) -> Iterator[DonationUse]:
+    """Walk every scope of ``ctx`` for donated-then-referenced buffers.
+
+    Statement-ordered within a scope: a Store to the name between the
+    donating call and the use clears the taint (the name was rebound to
+    a live buffer)."""
+    scopes: List[Tuple[Sequence[ast.stmt], ast.AST]] = [(ctx.tree.body, ctx.tree)]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append((node.body, node))
+    module_donators = _scope_donators(ctx.tree.body)
+    for body, scope in scopes:
+        donating = dict(module_donators)
+        donating.update(_scope_donators(body))
+        # (name -> line of the donating call that consumed it)
+        pending: Dict[str, int] = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scope, walked separately
+            # 1) uses of already-donated names anywhere in this stmt;
+            # each donating call's spec is resolved ONCE and reused in
+            # step 2 (graph resolution is the expensive part of this
+            # CI-wall-time-budgeted pass).
+            specs: Dict[int, Tuple[Set[int], Set[str]]] = {}
+            calls_in_order: List[ast.Call] = []
+            consumed_args: Set[int] = set()
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    spec = _call_donation(node, donating)
+                    if spec is None and graph is not None and fn_summary:
+                        hit = graph.resolve_call(ctx, node)
+                        if hit is not None:
+                            mod, target = hit
+                            for local, cand in mod.functions.items():
+                                if cand is target:
+                                    spec = fn_summary.get(
+                                        f"{mod.name}.{local}"
+                                    )
+                    if spec is not None:
+                        specs[id(node)] = spec
+                        calls_in_order.append(node)
+                        positions, names = spec
+                        # A FIRST donation consumes its argument quietly;
+                        # donating an already-donated name is itself a
+                        # use-after-donation, so leave it flaggable.
+                        for i in positions:
+                            if i < len(node.args) and isinstance(
+                                node.args[i], ast.Name
+                            ) and node.args[i].id not in pending:
+                                consumed_args.add(id(node.args[i]))
+                        for kw in node.keywords:
+                            if kw.arg in names and isinstance(
+                                kw.value, ast.Name
+                            ) and kw.value.id not in pending:
+                                consumed_args.add(id(kw.value))
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in pending
+                    and id(node) not in consumed_args
+                ):
+                    yield DonationUse(node, node.id, pending[node.id])
+            # 2) record this stmt's donations for later statements
+            for call in calls_in_order:
+                positions, names = specs[id(call)]
+                for i in positions:
+                    if i < len(call.args) and isinstance(
+                        call.args[i], ast.Name
+                    ):
+                        pending[call.args[i].id] = call.lineno
+                for kw in call.keywords:
+                    if kw.arg in names and isinstance(kw.value, ast.Name):
+                        pending[kw.value.id] = call.lineno
+            # 3) stores rebind LAST (`x = f(x)` re-donates through a
+            # fresh buffer: the RHS runs before the assignment lands,
+            # so the store clears the taint the call just recorded)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ):
+                    pending.pop(node.id, None)

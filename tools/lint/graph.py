@@ -1,0 +1,222 @@
+"""graftlint v2 package graph: symbol table + call graph (pure stdlib).
+
+graftlint v1 rules see one AST node at a time plus a flat constant
+table; the remaining repo invariants are *flow* properties (a donated
+buffer referenced after the jitted call, a dynamic int reaching a shape
+argument) whose sources and sinks live in different functions — and
+sometimes different files.  This module builds the package-wide view the
+flow rules (tools/lint/flow.py) walk:
+
+- a **module table** per file: import bindings (``from x import y as z``
+  resolves ``z`` to ``x.y``), top-level functions/methods, and the
+  module's constant tables;
+- a **symbol table** keyed by fully-qualified dotted name;
+- a **call graph** by *terminal-name resolution*: a call is resolved
+  through the file's import bindings first, and — matching the v1 rule
+  convention that ``lax.psum`` and a bare ``psum`` are the same thing —
+  falls back to a package-unique terminal-name match, so a renamed
+  import cannot hide a callee from the flow rules;
+- **cross-file constant resolution** (``from pkg.meshdef import AXIS as
+  A`` resolves ``A`` to the literal), shrinking the waiver pressure on
+  the constant-driven rules (G002/G004).
+
+Resolution stays deliberately shallow beyond that: no attribute-type
+inference, no dynamic dispatch.  A lint heuristic that guesses wrong
+silently is worse than one that asks for a waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+def module_name(path: str) -> str:
+    """``pkg/parallel/mesh.py`` -> ``pkg.parallel.mesh``;
+    ``pkg/__init__.py`` -> ``pkg``."""
+    p = path[:-3] if path.endswith(".py") else path
+    p = p.replace("/", ".")
+    if p.endswith(".__init__"):
+        p = p[: -len(".__init__")]
+    return p
+
+
+class ModuleTable:
+    """One file's contribution to the package graph."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.name = module_name(ctx.path)
+        self.is_package = ctx.path.endswith("__init__.py")
+        # local binding -> fully-qualified dotted target.
+        self.imports: Dict[str, str] = {}
+        # local (possibly Class.method) name -> FunctionDef node.
+        self.functions: Dict[str, ast.AST] = {}
+        if ctx.tree is not None:
+            self._collect()
+
+    def _package(self, level: int) -> str:
+        """Base package a ``level``-dot relative import resolves against."""
+        base = self.name if self.is_package else self.name.rpartition(".")[0]
+        for _ in range(level - 1):
+            base = base.rpartition(".")[0]
+        return base
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        # `import a.b.c` binds the root `a`; dotted uses
+                        # resolve through the longest-prefix walk below.
+                        root = a.name.split(".")[0]
+                        self.imports.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = self._package(node.level)
+                    mod = f"{base}.{node.module}" if node.module else base
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = f"{mod}.{a.name}"
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[stmt.name] = stmt
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.functions[f"{stmt.name}.{sub.name}"] = sub
+
+    def resolve_dotted(self, dotted: str) -> Optional[str]:
+        """Local dotted reference -> fully-qualified name, through the
+        import bindings (longest prefix wins) or this module's own
+        top-level definitions."""
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            alias = ".".join(parts[:i])
+            target = self.imports.get(alias)
+            if target is not None:
+                rest = parts[i:]
+                return ".".join([target] + rest) if rest else target
+        head = parts[0]
+        if head in self.functions or head in self.ctx.str_consts or (
+            head in self.ctx.int_consts
+        ):
+            return f"{self.name}.{dotted}"
+        return None
+
+
+class PackageGraph:
+    """Symbol table + call graph over every linted file."""
+
+    def __init__(self, files: Sequence):
+        self.modules: Dict[str, ModuleTable] = {}
+        self.by_path: Dict[str, ModuleTable] = {}
+        for ctx in files:
+            table = ModuleTable(ctx)
+            self.modules[table.name] = table
+            self.by_path[ctx.path] = table
+        # Terminal function name -> fq names defining it (for the
+        # unique-terminal fallback).
+        self._by_terminal: Dict[str, List[str]] = {}
+        for mod in self.modules.values():
+            for local, fn in mod.functions.items():
+                fq = f"{mod.name}.{local}"
+                self._by_terminal.setdefault(
+                    local.rpartition(".")[2], []
+                ).append(fq)
+
+    # -- symbol lookup ----------------------------------------------------
+    def lookup_function(self, fq: str) -> Optional[Tuple[ModuleTable, ast.AST]]:
+        """Fully-qualified name -> (module, FunctionDef), trying both the
+        plain ``mod.fn`` and the ``mod.Class.meth`` split."""
+        for cut in (1, 2):
+            parts = fq.rsplit(".", cut)
+            if len(parts) != cut + 1:
+                continue
+            mod = self.modules.get(parts[0])
+            if mod is not None:
+                fn = mod.functions.get(".".join(parts[1:]))
+                if fn is not None:
+                    return mod, fn
+        return None
+
+    def lookup_str_const(self, fq: str) -> Optional[str]:
+        mod_name, _, attr = fq.rpartition(".")
+        mod = self.modules.get(mod_name)
+        if mod is not None:
+            return mod.ctx.str_consts.get(attr)
+        return None
+
+    def lookup_int_const(self, fq: str) -> Optional[int]:
+        mod_name, _, attr = fq.rpartition(".")
+        mod = self.modules.get(mod_name)
+        if mod is not None:
+            return mod.ctx.int_consts.get(attr)
+        return None
+
+    # -- expression resolution --------------------------------------------
+    def resolve_expr(self, ctx, node: ast.AST) -> Optional[str]:
+        """Name/attribute-chain expression -> fully-qualified name (via
+        the file's import bindings), or None."""
+        from tools.lint.engine import dotted_name
+
+        d = dotted_name(node)
+        if d is None:
+            return None
+        table = self.by_path.get(ctx.path)
+        if table is None:
+            return None
+        return table.resolve_dotted(d)
+
+    def resolve_call(
+        self, ctx, call: ast.Call
+    ) -> Optional[Tuple[ModuleTable, ast.AST]]:
+        """Resolve a call's target function: import-resolution first,
+        then the package-unique terminal-name fallback."""
+        from tools.lint.engine import terminal_name
+
+        fq = self.resolve_expr(ctx, call.func)
+        if fq is not None:
+            hit = self.lookup_function(fq)
+            if hit is not None:
+                return hit
+        t = terminal_name(call.func)
+        if t is not None:
+            candidates = self._by_terminal.get(t, [])
+            if len(candidates) == 1:
+                return self.lookup_function(candidates[0])
+        return None
+
+    def callees(self, ctx, fn: ast.AST) -> Set[str]:
+        """Fully-qualified names of every resolvable call in ``fn``
+        (test/diagnostic surface for the call graph)."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                hit = self.resolve_call(ctx, node)
+                if hit is not None:
+                    mod, target = hit
+                    for local, cand in mod.functions.items():
+                        if cand is target:
+                            out.add(f"{mod.name}.{local}")
+        return out
+
+    # -- cross-file constants ---------------------------------------------
+    def resolve_str_const(self, ctx, node: ast.AST) -> Optional[str]:
+        """``from pkg.meshdef import AXIS as A`` + ``A`` -> the literal
+        (also handles the dotted ``meshdef.AXIS`` spelling)."""
+        fq = self.resolve_expr(ctx, node)
+        if fq is None:
+            return None
+        return self.lookup_str_const(fq)
+
+    def resolve_int_const(self, ctx, node: ast.AST) -> Optional[int]:
+        fq = self.resolve_expr(ctx, node)
+        if fq is None:
+            return None
+        return self.lookup_int_const(fq)

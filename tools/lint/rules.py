@@ -46,10 +46,35 @@ class Rule:
     ) -> Iterator[Finding]:
         raise NotImplementedError
 
+    def check_package(self, pkg: PackageContext) -> Iterator[Finding]:
+        """Package-wide pass (v2 census rules); runs once after the
+        per-file checks.  Findings are still waivable through the
+        owning file's context."""
+        return iter(())
+
+    # Statements-with-bodies span their whole suite; binding waivers
+    # across a function body would be far looser than the "inside a
+    # multi-line call" grammar the tests pin, so those anchor to their
+    # header line only.
+    _NO_SPAN = (
+        ast.FunctionDef,
+        ast.AsyncFunctionDef,
+        ast.ClassDef,
+        ast.For,
+        ast.While,
+        ast.If,
+        ast.Try,
+        ast.With,
+        ast.ExceptHandler,
+    )
+
     def finding(
         self, ctx: FileContext, node: ast.AST, message: str
     ) -> Finding:
         line = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", None) or line
+        if isinstance(node, self._NO_SPAN):
+            end = line
         return Finding(
             rule=self.id,
             path=ctx.path,
@@ -57,6 +82,7 @@ class Rule:
             col=getattr(node, "col_offset", 0),
             message=message,
             snippet=ctx._line(line),
+            end_line=end,
         )
 
 
@@ -816,6 +842,323 @@ class ArtifactWriteRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# v2 flow-sensitive rules (tools/lint/{graph,flow}.py substrate): the
+# remaining invariants are FLOW properties a per-node rule cannot see.
+
+
+class DonationAfterUseRule(Rule):
+    """G010 — donated buffers must not be referenced after the call.
+
+    ``donate_argnums``/``donate_argnames`` frees the argument buffer at
+    dispatch (the point of `parallel/mesh.py:239`'s donation is exactly
+    that early free); a later reference in the same scope reads a
+    deleted array — jax raises on CPU, and on a real device the error
+    surfaces asynchronously, far from the bug.  One level of
+    cross-function propagation: a helper that forwards its parameter to
+    a donated position donates that parameter, and resolved callers
+    inherit the contract (ROADMAP graftlint follow-up).
+    """
+
+    id = "G010"
+    name = "donation-after-use"
+    aliases = ("donate-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import flow
+
+        summary = getattr(pkg, "_donating_fns", None)
+        if summary is None:
+            summary = flow.donating_functions(pkg.files, pkg.graph)
+            pkg._donating_fns = summary
+        # Fast path: a file can only have a donation-after-use if it
+        # spells a donation itself or calls a known donating function
+        # by name (lint wall time is CI-budgeted).
+        if "donate_arg" not in ctx.source and not any(
+            fq.rsplit(".", 1)[1] in ctx.source for fq in summary
+        ):
+            return
+        for use in flow.donation_uses(ctx, pkg.graph, summary):
+            yield self.finding(
+                ctx,
+                use.use,
+                f"`{use.name}` was donated to a jitted call on line "
+                f"{use.donate_line} (donate_argnums/argnames frees the "
+                "buffer at dispatch) and is referenced afterwards; "
+                "rebind the name or drop the donation",
+            )
+
+
+class ShapeBucketRule(Rule):
+    """G011 — dynamic ints must be bucketed before they become shapes.
+
+    Every distinct shape entering a traced entry point is a full XLA
+    compile; VERDICT r5 measured 14 cache-miss compiles on a *primed*
+    cache because data-dependent sizes escaped the pow2-bucket
+    discipline.  In the dispatch layers (``parallel/``, ``models/``,
+    ``rules/``), a dynamic int — ``len()``, ``.shape[...]``, ``.size``,
+    arithmetic thereon — reaching a shape-forming argument
+    (``zeros``/``reshape``/``pad``/``ShapeDtypeStruct``/slice sizes)
+    must flow through the bucket helpers (``ops/bitmap.py next_pow2`` /
+    ``pad_axis``, ``mesh.py _pad_positions``) first.  Traced function
+    bodies are exempt: inside a trace, shapes are inherited from inputs
+    whose bucketing was (or was flagged) at the dispatch site.
+    """
+
+    id = "G011"
+    name = "shape-bucket"
+    aliases = ("bucket-ok",)
+
+    # Layers whose host code computes shapes for compiled dispatch.
+    scope_path_parts: Tuple[str, ...] = ("parallel/", "models/", "rules/")
+
+    def check(self, ctx, pkg):
+        from tools.lint import flow
+
+        if not any(p in ctx.path for p in self.scope_path_parts):
+            return
+        if "tests" in ctx.path.split("/"):
+            return
+        summaries = getattr(pkg, "_shape_summaries", None)
+        if summaries is None:
+            summaries = flow.return_summaries(pkg.files, pkg.graph)
+            pkg._shape_summaries = summaries
+        traced = set()
+        traced_fns = list(_device_functions(ctx))
+        # Functions handed to jit/shard_map by NAME (the `_fn` closures
+        # mesh.py builds and wraps per compile key) are traced bodies
+        # too: their shapes are static per trace, keyed by the caller.
+        wrapped = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = terminal_name(node.func)
+            if t in _JIT_NAMES or t in _SHARD_NAMES:
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        wrapped.add(a.id)
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if fn.name in wrapped:
+                    traced_fns.append(fn)
+        for fn in traced_fns:
+            for node in ast.walk(fn):
+                traced.add(id(node))
+        sf = flow.ShapeFlow(ctx, pkg.graph, summaries)
+        scopes = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(node) not in traced:
+                    scopes.append(node.body)
+        seen = set()
+        for body in scopes:
+            for call, desc, state in sf.walk(body, {}):
+                if state != flow.DYNAMIC or id(call) in seen:
+                    continue
+                if id(call) in traced:
+                    continue
+                seen.add(id(call))
+                yield self.finding(
+                    ctx,
+                    call,
+                    f"dynamic int reaches {desc} — every distinct value "
+                    "compiles a fresh XLA program; round it through "
+                    "next_pow2/pad_axis/_pad_positions (ops/bitmap.py) "
+                    "first",
+                )
+
+
+class EnvContractRule(Rule):
+    """G012 — FA_* env knobs are a strict, registered contract.
+
+    Every knob read must (a) route through a STRICT parser — a typo'd
+    value raises ``InputError`` instead of silently running a default
+    on a production mine (the FA_NO_PALLAS contract, ADVICE r5 #4) —
+    and (b) match an entry in the committed
+    ``tools/lint/env_registry.json``, from which the README's knob
+    table is rendered.  Registry entries with no remaining reference
+    anywhere in the tree flag too, so the registry cannot rot.
+    Strictness is detected as: the innermost enclosing function raises
+    ``InputError`` itself, or calls a package function that does (one
+    level of propagation — the ``parse_spec`` idiom).  Test code may
+    poke knobs freely.
+    """
+
+    id = "G012"
+    name = "env-contract"
+    aliases = ("env-ok",)
+
+    def _fn_raises_input_error(self, fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                t = terminal_name(
+                    exc.func if isinstance(exc, ast.Call) else exc
+                )
+                if t == "InputError":
+                    return True
+        return False
+
+    def _fn_is_strict(self, fn: ast.AST, ctx, pkg) -> bool:
+        if self._fn_raises_input_error(fn):
+            return True
+        # One level of call-graph propagation: the read's value is
+        # handed to a strict parser defined elsewhere in the package.
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = pkg.graph.resolve_call(ctx, node)
+            if hit is not None and self._fn_raises_input_error(hit[1]):
+                return True
+        return False
+
+    def check(self, ctx, pkg):
+        from tools.lint import engine as eng
+
+        if eng.is_test_path(ctx.path):
+            return
+        reads = eng.env_read_sites(ctx)
+        if not reads:
+            return
+        # Innermost enclosing function per read node: ast.walk is
+        # breadth-first, so nested defs are visited after their parents
+        # and the deepest function's assignment wins.
+        enclosing = {}
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    enclosing[id(sub)] = fn
+        for name, node in reads:
+            fn = enclosing.get(id(node))
+            if fn is None:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{name} read at module level — knob reads belong "
+                    "inside a strict parser (InputError on typos)",
+                )
+            elif not self._fn_is_strict(fn, ctx, pkg):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{name} read without a strict parse: `{fn.name}` "
+                    "neither raises InputError nor calls a package "
+                    "parser that does — a typo'd value silently runs "
+                    "the default (the invisible-degradation class the "
+                    "ledger exists to kill)",
+                )
+            registry = pkg.env_registry
+            if registry is not None and name not in registry.get(
+                "vars", {}
+            ):
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{name} is not in tools/lint/env_registry.json — "
+                    "add it (python -m tools.lint --write-inventory) "
+                    "and describe it",
+                )
+
+    def check_package(self, pkg):
+        from tools.lint import engine as eng
+
+        registry = pkg.env_registry
+        if registry is None:
+            return
+        refs = eng.env_var_references(pkg)
+        for name in sorted(registry.get("vars", {})):
+            if name not in refs:
+                yield Finding(
+                    rule=self.id,
+                    path=eng.ENV_REGISTRY_PATH.replace("\\", "/"),
+                    line=1,
+                    col=0,
+                    message=(
+                        f"registry entry {name} has no remaining "
+                        "reference in the tree — drop it "
+                        "(--write-inventory) or restore the reader"
+                    ),
+                    snippet=name,
+                )
+
+
+class SiteCensusRule(Rule):
+    """G013 — the audited-site inventory is unique and covered.
+
+    The README's "audited fetch sites" claim is only checkable if the
+    labels form a census: every ``retry.fetch``/``fetch_async`` site
+    label and every literal ``failpoints.fire`` site must be unique
+    package-wide (a duplicated label makes two link fetches
+    indistinguishable in the ledger and un-armable individually), and
+    every fetch label must have failpoint coverage — a literal
+    ``fetch.<label>`` armed somewhere in the tree (tests /
+    tools/failpoint_smoke.py) — or carry a waiver saying why injection
+    cannot reach it.  Test files exercise sites, they do not define
+    them, so their calls are exempt from the census.
+    """
+
+    id = "G013"
+    name = "site-census"
+    aliases = ("site-ok",)
+
+    def check(self, ctx, pkg):
+        return iter(())
+
+    def _coverage_literals(self, pkg) -> set:
+        from tools.lint import engine as eng
+
+        return {
+            value
+            for value in eng.str_constant_paths(pkg)
+            if "fetch." in value
+        }
+
+    def check_package(self, pkg):
+        from tools.lint import engine as eng
+
+        fetch_sites, fire_sites, _envs = eng.site_census(pkg)
+        # Uniqueness: flag EVERY site of a duplicated label, so the
+        # finding lands next to both spellings.
+        for sites, what in ((fetch_sites, "fetch label"), (
+            fire_sites, "failpoint site",
+        )):
+            by_label = {}
+            for label, ctx, node in sites:
+                by_label.setdefault(label, []).append((ctx, node))
+            for label, where in sorted(by_label.items()):
+                if len(where) < 2:
+                    continue
+                locs = ", ".join(
+                    f"{c.path}:{n.lineno}" for c, n in where
+                )
+                for ctx, node in where:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"{what} {label!r} is not unique package-wide "
+                        f"({locs}) — duplicated labels make ledger "
+                        "entries indistinguishable and failpoints "
+                        "un-armable individually",
+                    )
+        # Coverage: every fetch label must be armable-and-armed.
+        covered = self._coverage_literals(pkg)
+        for label, ctx, node in fetch_sites:
+            want = f"fetch.{label}"
+            if any(
+                c == want or (want + ":") in c for c in covered
+            ):
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"fetch site {label!r} has no failpoint coverage: no "
+                f"literal {want!r} is armed anywhere in the tree — add "
+                "it to the fetch-site inventory test "
+                "(tests/test_reliability.py) or waive with why "
+                "injection cannot reach it",
+            )
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncRule(),
     CollectiveAxisRule(),
@@ -826,6 +1169,10 @@ ALL_RULES: Sequence[Rule] = (
     HazardousDefaultsRule(),
     TodoIssueRule(),
     ArtifactWriteRule(),
+    DonationAfterUseRule(),
+    ShapeBucketRule(),
+    EnvContractRule(),
+    SiteCensusRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
