@@ -1307,22 +1307,51 @@ def _recommend_workload(args, raw, d_path) -> int:
 
 
 _SCALING_CHILD = """
-import json, jax, sys, time
+import json, os, sys, time
+n_dev = int(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_dev}"
+    ).strip()
+import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(sys.argv[2]))
+try:
+    # JAX >= 0.5 spells the device split as a config option; the pinned
+    # 0.4.37 rejects the name — there the XLA flag above is the only
+    # (and sufficient) mechanism (same split as tests/conftest.py).
+    jax.config.update("jax_num_cpu_devices", n_dev)
+except AttributeError:
+    pass
 from fastapriori_tpu.config import MinerConfig
 from fastapriori_tpu.models.apriori import FastApriori
 # The scaling check exercises the SHARDED level path (the engine choice
-# is a separate concern benchmarked on the real chip).
+# is a separate concern benchmarked on the real chip); argv[4] pins the
+# count-reduction engine so the record carries BOTH the r5-comparable
+# dense psum series and the sparse engine's measured comms bytes.
 cfg = MinerConfig(min_support=float(sys.argv[3]), num_devices=int(sys.argv[2]),
-                  engine="level", log_metrics=True)
+                  engine="level", log_metrics=True,
+                  count_reduce=sys.argv[4])
 m = FastApriori(config=cfg)
 m.run_file(sys.argv[1])
-rec_start = len(m.metrics.records)  # psum for the WARM run only
+rec_start = len(m.metrics.records)  # comms for the WARM run only
 t0 = time.perf_counter(); m.run_file(sys.argv[1])
 wall = time.perf_counter() - t0
-psum = sum(r.get("psum_bytes", 0) for r in m.metrics.records[rec_start:])
-print(json.dumps({"wall_s": wall, "psum_bytes": psum}))
+warm = m.metrics.records[rec_start:]
+psum = sum(r.get("psum_bytes", 0) for r in warm)
+gather = sum(r.get("gather_bytes", 0) for r in warm)
+eng = next((r["engine"] for r in warm if r.get("event") == "count_reduce"),
+           "dense")
+levels = [
+    {"k": r.get("k"), "reduce": r.get("reduce", "dense"),
+     "psum_bytes": r.get("psum_bytes", 0),
+     "gather_bytes": r.get("gather_bytes", 0)}
+    for r in warm if r.get("event") == "level"
+]
+print(json.dumps({"wall_s": wall, "psum_bytes": psum,
+                  "gather_bytes": gather, "count_reduce": eng,
+                  "levels": levels}))
 """
 
 
@@ -1356,22 +1385,35 @@ def _scaling_measure(args, deadline=None) -> dict:
                         file=sys.stderr,
                     )
                     break
-            proc = subprocess.run(
-                [sys.executable, "-c", _SCALING_CHILD, f.name, str(n),
-                 str(args.min_support)],
-                capture_output=True,
-                timeout=timeout,
-            )
-            line = next(
-                (
-                    l
-                    for l in proc.stdout.decode().splitlines()
-                    if l.startswith("{")
-                ),
-                None,
-            )
-            if proc.returncode == 0 and line:
-                out["devices"][str(n)] = json.loads(line)
+            # Dense first (the r5-comparable psum-invariance series),
+            # then — on real meshes — the sparse engine, whose measured
+            # gather+psum bytes are THE r6 acceptance figure (ISSUE 6:
+            # per-dispatch collective bytes <= 25% of dense at mid
+            # levels on 4+ devices).
+            engines = ("dense",) if n == 1 else ("dense", "sparse")
+            for engine in engines:
+                proc = subprocess.run(
+                    [sys.executable, "-c", _SCALING_CHILD, f.name, str(n),
+                     str(args.min_support), engine],
+                    capture_output=True,
+                    timeout=timeout,
+                )
+                line = next(
+                    (
+                        l
+                        for l in proc.stdout.decode().splitlines()
+                        if l.startswith("{")
+                    ),
+                    None,
+                )
+                if proc.returncode == 0 and line:
+                    rec = json.loads(line)
+                    if engine == "dense":
+                        out["devices"][str(n)] = rec
+                    else:
+                        out["devices"].setdefault(str(n), {})[
+                            "sparse"
+                        ] = rec
     finally:
         os.unlink(f.name)
     # All virtual devices share ONE physical core, so wall time cannot
@@ -1388,9 +1430,24 @@ def _scaling_measure(args, deadline=None) -> dict:
             else None
         )
         rec["overhead_vs_1dev"] = ov
+        sp = rec.get("sparse")
+        if sp and rec.get("psum_bytes"):
+            # The headline ISSUE-6 figure: sparse collective bytes
+            # (mask gather + compact psum) as a fraction of the dense
+            # psum payload on the same mesh.
+            sp["collective_vs_dense"] = round(
+                (sp["psum_bytes"] + sp["gather_bytes"])
+                / rec["psum_bytes"],
+                4,
+            )
         print(
-            f"scaling[virtual-cpu] n={n}: {rec['wall_s']:.2f}s "
-            f"overhead_vs_1dev={ov} psum={rec['psum_bytes']}",
+            f"scaling[virtual-cpu] n={n}: {rec.get('wall_s', 0.0):.2f}s "
+            f"overhead_vs_1dev={ov} psum={rec.get('psum_bytes')}"
+            + (
+                f" sparse_vs_dense={sp['collective_vs_dense']}"
+                if sp and "collective_vs_dense" in sp
+                else ""
+            ),
             file=sys.stderr,
         )
     ov8 = (out["devices"].get("8") or {}).get("overhead_vs_1dev")

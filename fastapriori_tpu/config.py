@@ -56,6 +56,36 @@ class MinerConfig:
     # table uploads, which only amortize on big levels (VERDICT r5
     # weak #8 is a 16.34M-rule workload; 2M is ~0.5 s of host joins).
     rule_device_min_rules: int = 1 << 21
+    # Count-reduction engine for the mesh collectives (ops/count.py
+    # local_sparse_psum): "auto" (default) runs the threshold-sparse
+    # exchange — per-shard local prune at the weighted-pigeonhole
+    # threshold, packed-mask all_gather of the survivor union, compact
+    # segment psum, on-device scatter-back — on multi-device single-
+    # process txn meshes where candidate supports are power-law and the
+    # dense [NB, C] / [F, F] psum is ICI/DCN-bound (ROADMAP item 2;
+    # arxiv 1312.3020); "dense" forces the classic full-tensor psum
+    # (the differential oracle); "sparse" forces the sparse exchange
+    # where it is defined (1-device meshes, multi-process ingest, 2-D
+    # cand meshes and tiny candidate sets still fall back to dense,
+    # with a ledger event).  Counts are bit-exact either way: a shard
+    # only prunes candidates that provably cannot reach min_count
+    # globally, and every union survivor's compact segment sums ALL
+    # shards' contributions.  FA_COUNT_REDUCE overrides, strictly
+    # parsed like FA_NO_PALLAS.
+    count_reduce: str = "auto"
+    # Sparse exchange: union-compaction slot budget per reduction (the
+    # psum payload is 4·cap bytes).  None = auto (pow2 bucket of
+    # n_candidates/16, floor 1024 — ops/count.py sparse_union_cap); an
+    # explicit value is pow2-bucketed and forced.  A union overflow
+    # falls back to the dense psum for that dispatch (ledger event) and
+    # records the grown budget for repeat runs.  FA_COUNT_SPARSE_CAP
+    # overrides, strictly parsed.
+    count_sparse_cap: Optional[int] = None
+    # Below this many candidate slots per reduction the sparse exchange
+    # cannot beat the dense psum (two collectives' latency vs one small
+    # payload) — such dispatches stay dense even under count_reduce=
+    # "sparse".
+    count_sparse_min: int = 4096
     # Level engine (transfer-minimal kernels, ops/count.py
     # local_level_gather / local_pair_gather): transaction-axis scan chunk
     # (bounds the [tc, P] membership intermediate in HBM), padded prefix

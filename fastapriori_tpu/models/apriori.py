@@ -224,6 +224,151 @@ class FastApriori:
                 )
         failpoints.fire(f"level.{k}")
 
+    # -- count-reduction engine (ROADMAP item 2: sparse allreduce) -----
+    _COUNT_REDUCE = ("auto", "dense", "sparse")
+
+    def _count_reduce_engine(
+        self, data: CompressedData
+    ) -> Tuple[str, str]:
+        """Resolve the count-reduction engine for this mine:
+        ``FA_COUNT_REDUCE`` (strict) overrides
+        ``config.count_reduce`` (validated just as strictly — a typo'd
+        config silently running the dense path would be invisible in a
+        record).  Returns ``(engine, requested)`` where engine is
+        "dense" or "sparse": the sparse exchange is defined only on
+        multi-device single-process 1-D txn meshes — elsewhere "auto"
+        quietly stays dense and a forced "sparse" falls back WITH a
+        ledger event (the engine-choice pattern of rules/gen.py
+        ``_pick_rule_engine``)."""
+        from fastapriori_tpu.utils.env import env_choice
+
+        req = env_choice("FA_COUNT_REDUCE", self._COUNT_REDUCE)
+        if req is None:
+            req = self.config.count_reduce
+            if req not in self._COUNT_REDUCE:
+                from fastapriori_tpu.errors import InputError
+
+                raise InputError(
+                    f"unrecognized MinerConfig.count_reduce value "
+                    f"{req!r}: use one of {'/'.join(self._COUNT_REDUCE)}"
+                )
+        if req == "dense":
+            return "dense", req
+        ctx = self.context
+        reason = None
+        if ctx.txn_shards < 2:
+            reason = "one_txn_shard"
+        elif ctx.cand_shards != 1:
+            reason = "cand_mesh"
+        elif data.shard is not None or jax.process_count() != 1:
+            reason = "multi_process"
+        if reason is not None:
+            if req == "sparse":
+                ledger.record(
+                    "count_reduce_fallback", once_key=reason,
+                    reason=reason,
+                )
+            return "dense", req
+        ledger.record(
+            "count_reduce_engine", once_key="sparse", engine="sparse"
+        )
+        return "sparse", req
+
+    def _sparse_cap(self, n_valid: int, hint_key=None) -> int:
+        """Union-compaction slot budget for one sparse reduction
+        (ops/count.py sparse_union_cap — pow2 buckets), with the
+        config/env override and, when ``hint_key`` is given, the grown
+        budget a previous overflow of this profile recorded (the
+        pair-cap-hint pattern: repeat runs never re-pay the dense
+        redo)."""
+        from fastapriori_tpu.ops.count import sparse_union_cap
+        from fastapriori_tpu.utils.env import env_int
+
+        override = env_int(
+            "FA_COUNT_SPARSE_CAP", 0, minimum=0
+        ) or self.config.count_sparse_cap
+        cap = sparse_union_cap(n_valid, override)
+        if hint_key is not None:
+            hint = self.context.pair_cap_hint(hint_key)
+            if hint:
+                cap = min(max(cap, hint), _next_pow2(max(n_valid, 8)))
+        return cap
+
+    def _sparse_thresholds(
+        self, data: CompressedData, t_pad: int, heavy: bool
+    ) -> np.ndarray:
+        """Per-shard local-prune thresholds for the sparse exchange
+        (int32[S], replicated into the kernels): the weighted pigeonhole
+        over the STATIC shard weight totals — a candidate whose local
+        count sits below ``max(1, ceil(min_count · W_s / W))`` on every
+        shard provably sums below min_count, so per-shard pruning at
+        these thresholds loses no frequent candidate.  ``heavy``: the
+        single-low-digit weight split is active — the main kernels
+        count with ``w % 128`` and shard 0 adds the exact heavy-row
+        remainder (ops/count.py ``_heavy_gate``), so shard 0's budget
+        carries the remainder total."""
+        s = self.context.txn_shards
+        w = np.zeros(t_pad, dtype=np.int64)
+        w[: data.total_count] = data.weights
+        if heavy:
+            low = w % 128
+            per = low.reshape(s, -1).sum(axis=1)
+            per[0] += int((w - low).sum())
+        else:
+            per = w.reshape(s, -1).sum(axis=1)
+        total = int(per.sum())
+        if total <= 0:
+            return np.ones(s, dtype=np.int32)
+        thr = -(-(int(data.min_count) * per) // total)  # exact ceil
+        return np.maximum(1, thr).astype(np.int32)
+
+    def _fused_count_reduce_setup(
+        self, data: CompressedData, t_pad: int, f_pad: int,
+        n_digits: int, n_chunks: int, fast_f32: bool, packed_input: bool,
+    ):
+        """Count-reduction setup shared by both fused flavors (packed
+        upload and resident bitmap — the same sharing as
+        :meth:`_fused_attempt_loop`): resolves the engine, applies the
+        tiny-candidate-space floor (with a ledger event — the fused
+        program then runs dense end to end), computes the per-shard
+        prune thresholds, and returns the ``build(m, reduce) ->
+        (program, caps)`` closure whose compaction budgets honor the
+        overflow-grown hint from previous runs."""
+        cfg = self.config
+        ctx = self.context
+        count_reduce, _req = self._count_reduce_engine(data)
+        if count_reduce == "sparse" and f_pad * f_pad < cfg.count_sparse_min:
+            ledger.record(
+                "count_reduce_fallback", once_key="tiny_fused",
+                reason="tiny_candidate_set", site="fused",
+            )
+            count_reduce = "dense"  # tiny candidate space: psum wins
+        sparse_thr = (
+            self._sparse_thresholds(data, t_pad, heavy=False)
+            if count_reduce == "sparse"
+            else None
+        )
+        hint_key = ("sparse_fused", t_pad, f_pad, int(data.min_count))
+
+        def build(m, reduce):
+            caps = (
+                (
+                    self._sparse_cap(f_pad * f_pad, hint_key=hint_key),
+                    self._sparse_cap(m * f_pad, hint_key=hint_key),
+                )
+                if reduce == "sparse"
+                else None
+            )
+            return (
+                ctx.fused_miner(
+                    m, cfg.fused_l_max, n_digits, n_chunks, fast_f32,
+                    packed_input=packed_input, sparse_caps=caps,
+                ),
+                caps,
+            )
+
+        return count_reduce, sparse_thr, build, hint_key
+
     def _fused_fallback(self, partial: Optional[list]) -> None:
         """One call per fused→level fallback: the legacy metrics event
         (asserted by the engine tests / bench parsers) plus the
@@ -1233,14 +1378,17 @@ class FastApriori:
         # accommodate that, the fused engine can't run at all.
         m_cap = max(m_cap, _next_pow2(cfg.fused_l_max + 2))
 
-        def build(m):
-            return ctx.fused_miner(
-                m, cfg.fused_l_max, n_digits, n_chunks, fast_f32
+        count_reduce, sparse_thr, build, sp_hint_key = (
+            self._fused_count_reduce_setup(
+                data, t_pad, f_pad, n_digits, n_chunks, fast_f32,
+                packed_input=True,
             )
-
+        )
         return self._fused_attempt_loop(
             profile, build, packed, w, data.min_count, m_cap, m_cap_max,
             t_pad, f_pad, n_digits,
+            count_reduce=count_reduce, sparse_thr=sparse_thr,
+            sparse_hint_key=sp_hint_key,
         )
 
     def _size_fused_budget(
@@ -1290,52 +1438,104 @@ class FastApriori:
     def _fused_attempt_loop(
         self, profile, build, bitmap_arg, w, min_count, m_cap: int,
         m_cap_max: int, t_pad: int, f_pad: int, n_digits: int,
+        count_reduce: str = "dense", sparse_thr=None,
+        sparse_hint_key=None,
     ) -> Tuple[Optional[list], Optional[list]]:
         """The fused engine's overflow-retry loop, shared by the packed
         upload path (:meth:`_mine_fused`) and the resident-bitmap path
-        (:meth:`_fused_resident`).  ``build(m_cap)`` returns the jitted
-        program; returns ``(levels, None)`` on success or
-        ``(None, salvaged_complete_levels_or_None)`` on failure."""
+        (:meth:`_fused_resident`).  ``build(m_cap, reduce)`` returns
+        ``(jitted program, sparse caps or None)``; returns
+        ``(levels, None)`` on success or
+        ``(None, salvaged_complete_levels_or_None)`` on failure.  A
+        sparse union-compaction overflow re-runs the SAME row budget
+        with the dense reduction (one ledger event) — exact either
+        way."""
         from fastapriori_tpu.ops import fused
 
         cfg = self.config
         ctx = self.context
         rows = None  # last attempt's output (None if no attempt ran)
         m_cap_run = 0
+        reduce = count_reduce
         while m_cap <= m_cap_max:
-            m_cap_run = m_cap
-            with self.metrics.timed("fused_mine", m_cap=m_cap) as met:
-                fn = build(m_cap)
+            with self.metrics.timed(
+                "fused_mine", m_cap=m_cap, reduce=reduce
+            ) as met:
+                fn, caps = build(m_cap, reduce)
+                args = [bitmap_arg, w, jnp.int32(min_count)]
+                if caps is not None:
+                    args.append(jnp.asarray(sparse_thr, dtype=jnp.int32))
                 # ONE device->host transfer for the whole mining result.
                 packed_out = retry.fetch(
                     # lint: fetch-site -- the fused engine's single audited fetch, retry-wrapped
-                    lambda: np.asarray(
-                        fn(bitmap_arg, w, jnp.int32(min_count))
-                    ),
+                    lambda: np.asarray(fn(*args)),
                     "fused",
                 )
-                rows, cols, counts, n_lvl, incomplete, overflow = (
-                    fused.unpack_fused_result(packed_out, cfg.fused_l_max)
+                (
+                    a_rows, a_cols, a_counts, n_lvl, incomplete, overflow,
+                    sparse_ovf, sparse_nu,
+                ) = fused.unpack_fused_result(packed_out, cfg.fused_l_max)
+                if sparse_ovf:
+                    # Union compaction overflowed: every level's counts
+                    # are unusable (and n_lvl is undefined) — redo this
+                    # budget dense.
+                    met.update(sparse_overflow=True)
+                else:
+                    m_cap_run = m_cap
+                    rows, cols, counts = a_rows, a_cols, a_counts
+                    # MAC estimate for the MFU report: level 2 is D Gram
+                    # matmuls over [t_pad, f_pad]; each while-loop
+                    # iteration (one per level >= 3, plus the
+                    # terminating check's last full iteration) does the
+                    # candidate-gen pair of [m_cap, m_cap/f_pad] matmuls
+                    # plus the membership + D counting matmuls over
+                    # [t_pad, m_cap, f_pad].
+                    n_iters = max(int(np.count_nonzero(n_lvl)), 1)
+                    if caps is not None:
+                        from fastapriori_tpu.ops.count import (
+                            sparse_psum_bytes,
+                        )
+
+                        g2, p2 = sparse_psum_bytes(
+                            f_pad * f_pad, caps[0], ctx.txn_shards
+                        )
+                        gl, pl = sparse_psum_bytes(
+                            m_cap * f_pad, caps[1], ctx.txn_shards
+                        )
+                        psum_b = p2 + (n_iters - 1) * pl
+                        gather_b = g2 + (n_iters - 1) * gl
+                    else:
+                        psum_b = 4 * f_pad * f_pad + (n_iters - 1) * (
+                            4 * m_cap * f_pad
+                        )
+                        gather_b = 0
+                    met.update(
+                        incomplete=incomplete,
+                        overflow=overflow,
+                        macs=n_digits * t_pad * f_pad * f_pad
+                        + (n_iters - 1)
+                        * (
+                            2 * m_cap * m_cap * f_pad
+                            + (1 + n_digits) * t_pad * m_cap * f_pad
+                        ),
+                        psum_bytes=psum_b,
+                        gather_bytes=gather_b,
+                    )
+            if sparse_ovf:
+                ledger.record(
+                    "count_sparse_overflow", site="fused",
+                    m_cap=m_cap, caps=list(caps), n_union=sparse_nu,
                 )
-                # MAC estimate for the MFU report: level 2 is D Gram
-                # matmuls over [t_pad, f_pad]; each while-loop iteration
-                # (one per level >= 3, plus the terminating check's last
-                # full iteration) does the candidate-gen pair of
-                # [m_cap, m_cap/f_pad] matmuls plus the membership +
-                # D counting matmuls over [t_pad, m_cap, f_pad].
-                n_iters = max(int(np.count_nonzero(n_lvl)), 1)
-                met.update(
-                    incomplete=incomplete,
-                    overflow=overflow,
-                    macs=n_digits * t_pad * f_pad * f_pad
-                    + (n_iters - 1)
-                    * (
-                        2 * m_cap * m_cap * f_pad
-                        + (1 + n_digits) * t_pad * m_cap * f_pad
-                    ),
-                    psum_bytes=4 * f_pad * f_pad
-                    + (n_iters - 1) * 4 * m_cap * f_pad,
-                )
+                if sparse_hint_key is not None and sparse_nu > 0:
+                    # Memoize the true union size (the pair-cap-hint
+                    # pattern): repeat runs size the compaction right
+                    # instead of re-paying this wasted sparse dispatch
+                    # plus the dense redo.
+                    ctx.record_pair_cap(
+                        sparse_hint_key, _next_pow2(sparse_nu)
+                    )
+                reduce = "dense"
+                continue  # same budget, dense reduction (cannot recurse)
             if not incomplete:
                 ctx.record_fused_m_cap(profile, m_cap)
                 return (
@@ -1432,15 +1632,17 @@ class FastApriori:
         w_np[: data.total_count] = data.weights
         w = jax.device_put(w_np, ctx.sharding_vector())
 
-        def build(m):
-            return ctx.fused_miner(
-                m, cfg.fused_l_max, n_digits, n_chunks, fast_f32,
+        count_reduce, sparse_thr, build, sp_hint_key = (
+            self._fused_count_reduce_setup(
+                data, t_pad, f_pad, n_digits, n_chunks, fast_f32,
                 packed_input=False,
             )
-
+        )
         lv, partial = self._fused_attempt_loop(
             profile, build, bitmap, w, data.min_count, m_cap, m_cap_max,
             t_pad, f_pad, n_digits,
+            count_reduce=count_reduce, sparse_thr=sparse_thr,
+            sparse_hint_key=sp_hint_key,
         )
         return lv, partial, False
 
@@ -1621,6 +1823,20 @@ class FastApriori:
         ctx = self.context
         f = data.num_items
         min_count = data.min_count
+        # Count-reduction engine (ROADMAP item 2): sparse threshold
+        # exchange on multi-device meshes, dense psum elsewhere — and
+        # always available as the differential oracle / overflow
+        # fallback.  Resolved once per mine; the per-shard prune
+        # thresholds are static (shard weight totals).
+        count_reduce, _cr_req = self._count_reduce_engine(data)
+        sparse_thr = (
+            self._sparse_thresholds(data, t_pad, heavy is not None)
+            if count_reduce == "sparse"
+            else None
+        )
+        self.metrics.emit(
+            "count_reduce", engine=count_reduce, requested=_cr_req
+        )
         # Frequent k-sets live as a lex-sorted int32 [M, k] matrix between
         # levels; frozensets are materialized ONCE at the end (the per-set
         # Python objects were the dominant cost on dense data).
@@ -1695,6 +1911,12 @@ class FastApriori:
             # whole phase is a FETCH of its packed output (~2·cap·4
             # bytes), not a dispatch.
             with self.metrics.timed("level", k=2) as m:
+                f_pad_p = bitmap.shape[1]
+                rinfo = {
+                    "reduce": "dense",
+                    "psum_bytes": 4 * f_pad_p * f_pad_p,
+                    "gather_bytes": 0,
+                }
                 if pair_pre is not None:
                     idx, cnt, n2, tri = pair_fetch()
                     cap = pair_pre["cap"]
@@ -1724,10 +1946,26 @@ class FastApriori:
                         cfg.pair_cap, ctx.pair_cap_hint(cap_key) or 0
                     )
                     hb, hw = heavy if heavy is not None else (None, None)
-                    idx, cnt, n2, tri, counts_dev = ctx.pair_gather(
+                    sp_cap = None
+                    spk = ("sparse_pair", t_pad, f, min_count)
+                    if (
+                        count_reduce == "sparse"
+                        and f_pad_p * f_pad_p >= cfg.count_sparse_min
+                    ):
+                        sp_cap = self._sparse_cap(
+                            f_pad_p * f_pad_p, hint_key=spk
+                        )
+                    idx, cnt, n2, tri, counts_dev, rinfo = ctx.pair_gather(
                         bitmap, w_digits, scales, min_count, f, cap,
                         heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
+                        sparse_cap=sp_cap, sparse_thr=sparse_thr,
                     )
+                    if rinfo.get("fallback") == "sparse_overflow":
+                        # Remember the true union size so repeat runs
+                        # size the compaction right (pair_cap pattern).
+                        ctx.record_pair_cap(
+                            spk, _next_pow2(rinfo["n_union"])
+                        )
                     d_disp = 1
                     if n2 > cap:
                         # Overflow: re-extract at the exact budget over
@@ -1756,7 +1994,9 @@ class FastApriori:
                     frequent=n2,
                     cand3=tri,
                     macs=d_eff * t_pad * f_pad * f_pad,
-                    psum_bytes=4 * f_pad * f_pad,
+                    reduce=rinfo["reduce"],
+                    psum_bytes=rinfo["psum_bytes"],
+                    gather_bytes=rinfo["gather_bytes"],
                 )
             if need_n2:
                 # Cold path: the pair gather above doubles as the fused
@@ -1932,6 +2172,8 @@ class FastApriori:
                     fast_f32,
                     heavy,
                     defer_counts=defer,
+                    count_reduce=count_reduce,
+                    sparse_thr=sparse_thr,
                 )
                 m.update(frequent=nxt.shape[0], **lvl_stats)
             if isinstance(nxt_counts, list):  # deferred (pending runs)
@@ -2247,6 +2489,8 @@ class FastApriori:
         fast_f32: bool = False,
         heavy: Optional[tuple] = None,
         defer_counts: bool = True,
+        count_reduce: str = "dense",
+        sparse_thr=None,
     ) -> Tuple[np.ndarray, object, dict]:
         """C8 for one level, transfer-minimal: greedy chunks of at most
         P_CAP prefixes / C_CAP candidates go through the compiled-once
@@ -2255,6 +2499,14 @@ class FastApriori:
         device-resident and resolve in one end-of-mine gather
         (``defer_counts``; the second return is then the pending list,
         otherwise the eager int64 counts).
+
+        ``count_reduce="sparse"`` (with ``sparse_thr``, the [S]
+        per-shard prune thresholds) runs each dispatch's candidate
+        reduction as the threshold-sparse exchange; blocks under the
+        ``count_sparse_min`` floor stay dense, and a union-compaction
+        overflow discards the level and recounts it dense (ledger
+        event + grown budget hint for repeat runs) — bit-exact either
+        way.
 
         ``cand_blocks`` is an ITERATOR of ``(x_idx, ys)`` blocks in
         global ``(x_idx, y)`` order (candidates.gen_candidates_stream).
@@ -2292,8 +2544,11 @@ class FastApriori:
         d_eff = 1 if fast_f32 else len(scales)
         stats = {
             "candidates": 0, "dispatches": 0, "macs": 0, "psum_bytes": 0,
+            "gather_bytes": 0,
+            "reduce": "dense",
         }
-        inflight = []  # (placed, device out, block counts buffer)
+        sp_hint_key = ("sparse_level", t_pad, f_pad, min_count)
+        inflight = []  # (placed, device out, counts buffer, sparse cap)
         blocks = []  # (x_idx, ys, counts buffer)
         for x_idx, ys in cand_blocks:
             if x_idx.size == 0:
@@ -2367,7 +2622,11 @@ class FastApriori:
             placed_all = []  # per-block-chunk placement lists
             for shards in chunk_descs:
                 prefix_cols = np.full((p_cap, k_pad), zcol, dtype=cols_dt)
-                cand_idx = np.zeros(c_cap, dtype=np.int32)
+                # Padded candidate slots gather the guaranteed-zero
+                # column's count (0) rather than slot 0's real count —
+                # under the sparse reduction a hot slot-0 count would
+                # drag every padding slot into the union.
+                cand_idx = np.full(c_cap, zcol, dtype=np.int32)
                 placed = []  # (counts slice, offset in cand_idx, length)
                 for sh, (c_start, c_end, base, n_c) in enumerate(shards):
                     n_p = c_end - c_start
@@ -2402,8 +2661,25 @@ class FastApriori:
             nb_pad = _next_pow2(nb) if nb <= 16 else -(-nb // 8) * 8
             for _ in range(nb_pad - nb):
                 pcs.append(np.full((p_cap, k_pad), zcol, dtype=cols_dt))
-                cis.append(np.zeros(c_cap, dtype=np.int32))
+                cis.append(np.full(c_cap, zcol, dtype=np.int32))
             hb, hw = heavy if heavy is not None else (None, None)
+            # Per-dispatch reduction engine: the sparse exchange only
+            # beats the dense psum above the candidate-count floor.
+            sp_cap = None
+            if count_reduce == "sparse":
+                if c_cap >= self.config.count_sparse_min:
+                    sp_cap = self._sparse_cap(c_cap, hint_key=sp_hint_key)
+                elif stats["dispatches"] == 0:
+                    # The mine selected sparse but this level runs
+                    # dense (config.py's tiny-candidate-set fallback
+                    # contract): one ledger event per level, so a
+                    # record shows WHICH reduction each level ran.
+                    ledger.record(
+                        "count_reduce_fallback",
+                        once_key="tiny_level",
+                        reason="tiny_candidate_set",
+                        site="level", k=s + 1, c_cap=c_cap,
+                    )
             bits, counts_out = ctx.level_gather_batch(
                 bitmap,
                 w_digits,
@@ -2416,25 +2692,42 @@ class FastApriori:
                 heavy_b=hb,
                 heavy_w=hw,
                 fast_f32=fast_f32,
+                sparse_cap=sp_cap,
+                sparse_thr=sparse_thr,
             )
             # Audited fetch issued NON-BLOCKING at dispatch time
             # (reliability/retry.py fetch_async): the ~C/8-byte survivor
             # mask crosses the link while the host preps the next block
             # (and, for the last block, while it runs the collect loop
             # below) — a congested link stalls the copy, not the host.
-            inflight.append(
-                (placed_all, retry.fetch_async(bits, "level_bits"),
-                 counts_out)
-            )
+            # Distinct labels per reduction engine: the sparse payload
+            # carries the union censuses too, and its failpoint must be
+            # armable independently (G013).
+            if sp_cap is not None:
+                bits_fu = retry.fetch_async(bits, "level_bits_sparse")
+            else:
+                bits_fu = retry.fetch_async(bits, "level_bits")
+            inflight.append((placed_all, bits_fu, counts_out, sp_cap))
             # Per-launch cost model (metrics/MFU): membership matmul
             # [T, P_cap] + counting matmuls [P_cap, F] over padded
             # global shapes per scanned chunk — including the padding
             # chunks, which execute the full-size matmuls (the MFU
-            # figure must reflect what the device actually ran); psum
-            # reduces each [C_cap] gather.
+            # figure must reflect what the device actually ran); the
+            # reduction moves either the dense 4·C psum payload or the
+            # sparse mask-gather + compact-psum payloads per chunk.
             stats["dispatches"] += 1
             stats["macs"] += nb_pad * (1 + d_eff) * t_pad * p_cap * f_pad
-            stats["psum_bytes"] += nb_pad * 4 * c_cap
+            if sp_cap is not None:
+                from fastapriori_tpu.ops.count import sparse_psum_bytes
+
+                g_b, p_b = sparse_psum_bytes(
+                    c_cap, sp_cap, ctx.txn_shards
+                )
+                stats["psum_bytes"] += nb_pad * p_b
+                stats["gather_bytes"] += nb_pad * g_b
+                stats["reduce"] = "sparse"
+            else:
+                stats["psum_bytes"] += nb_pad * 4 * c_cap
         empty = (
             np.empty((0, s + 1), dtype=np.int32),
             None,
@@ -2452,9 +2745,53 @@ class FastApriori:
         # so multi-process scaling records decompose into compute vs
         # link terms (VERDICT r5 next #7 remainder).
         t_collect0 = time.perf_counter()
-        pending = []  # (counts_dev [NB, C], flat positions int64[n])
-        for (placed_all, bits_fu, counts_out), blk in zip(inflight, blocks):
+        # Consume every async fetch first and decode the sparse blocks'
+        # trailing union censuses: an overflowed union truncated the
+        # compaction, so that dispatch's counts (and mask) silently MISS
+        # candidates — the whole level must recount dense before any
+        # survivor state is built from it.
+        fetched = []
+        max_nu = 0
+        for placed_all, bits_fu, counts_out, sp_cap in inflight:
             mask = bits_fu.result()  # consume the async fetch (retried)
+            if sp_cap is not None:
+                nus = mask[:, -4:].astype(np.int64)
+                nus = (
+                    nus[:, 0]
+                    | (nus[:, 1] << 8)
+                    | (nus[:, 2] << 16)
+                    | (nus[:, 3] << 24)
+                )
+                if nus.size and int(nus.max()) > sp_cap:
+                    max_nu = max(max_nu, int(nus.max()))
+                mask = mask[:, :-4]
+            fetched.append((placed_all, mask, counts_out))
+        if max_nu:
+            ledger.record(
+                "count_sparse_overflow", site="level", k=s + 1,
+                n_union=max_nu,
+            )
+            ctx.record_pair_cap(sp_hint_key, _next_pow2(max_nu))
+            nxt_d, cnts_d, stats_d = self._count_level(
+                ctx, bitmap, w_digits, scales, level,
+                gen_candidates_stream(level), min_count, n_chunks,
+                fast_f32, heavy, defer_counts=defer_counts,
+                count_reduce="dense",
+            )
+            # The wasted sparse dispatches still ran (and their bytes
+            # still crossed the mesh) — account them on top of the
+            # dense recount's own figures.
+            stats_d["dispatches"] += stats["dispatches"]
+            stats_d["macs"] += stats["macs"]
+            stats_d["psum_bytes"] += stats["psum_bytes"]
+            stats_d["gather_bytes"] = (
+                stats_d.get("gather_bytes", 0) + stats["gather_bytes"]
+            )
+            stats_d["candidates"] = stats["candidates"]
+            stats_d["sparse_overflow"] = max_nu
+            return nxt_d, cnts_d, stats_d
+        pending = []  # (counts_dev [NB, C], flat positions int64[n])
+        for (placed_all, mask, counts_out), blk in zip(fetched, blocks):
             arr = np.unpackbits(mask, axis=1)  # [NB, C]
             c_tot = arr.shape[1]
             keep_blk = blk[2]

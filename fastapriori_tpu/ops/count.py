@@ -35,6 +35,106 @@ def _psum_if(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
     return lax.psum(x, axis_name) if axis_name is not None else x
 
 
+def sparse_union_cap(n_valid: int, override: Optional[int] = None) -> int:
+    """Union-compaction slot budget for :func:`local_sparse_psum`:
+    ``n_valid/16`` rounded to a pow2 bucket (floor 1024, never above the
+    candidate count's own bucket) — at that size the sparse exchange's
+    bytes (S·n/8 mask gather + 4·cap compact psum) stay under 25% of the
+    dense 4·n psum on a 4-shard mesh.  ``override``
+    (config.count_sparse_cap / FA_COUNT_SPARSE_CAP) is pow2-bucketed and
+    clamped the same way, so every compiled compaction shape stays in
+    the bucket family (G011)."""
+    from fastapriori_tpu.ops.bitmap import next_pow2
+
+    ceiling = next_pow2(max(n_valid, 8))
+    if override is not None and override > 0:
+        return min(next_pow2(override), ceiling)
+    return min(next_pow2(max(n_valid // 16, 1024)), ceiling)
+
+
+def _unpack_bits_msb(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., N//8] -> bool [..., N] (MSB-first, the inverse of
+    :func:`pack_bits_msb`)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[..., :, None] >> shifts) & 1
+    return bits.reshape(
+        *packed.shape[:-1], packed.shape[-1] * 8
+    ).astype(jnp.bool_)
+
+
+def local_sparse_psum(
+    local: jnp.ndarray,  # int32 partial counts (any shape, size % 8 == 0)
+    thr: jnp.ndarray,  # () int32 — THIS shard's local-prune threshold
+    cap: int,  # static union-compaction slot budget (pow2)
+    axis_name: str,
+    valid: Optional[jnp.ndarray] = None,  # bool, same shape: candidate mask
+) -> tuple:
+    """Threshold-sparse replacement for the dense ``lax.psum`` over the
+    txn mesh axis (ROADMAP item 2; *Sparse Allreduce*, arxiv 1312.3020):
+    candidate supports are power-law — most candidates die at
+    min_count — yet the dense reduction moves every partial count over
+    ICI/DCN.  Three steps, all inside the one counting dispatch:
+
+    1. **local prune**: each shard keeps candidates with local count
+       >= ``thr``, its weighted-pigeonhole threshold
+       ``max(1, ceil(min_count · W_s / W))`` (W_s = the shard's static
+       total transaction weight).  Any candidate below EVERY shard's
+       threshold sums below min_count globally, so the union of the
+       per-shard survivor sets is a superset of the frequent set —
+       pruning loses nothing.
+    2. **union exchange**: the survivor masks cross the axis bit-packed
+       (``all_gather`` of N/8 bytes per shard vs the dense psum's 4·N);
+       OR-ing them gives every shard the identical union.
+    3. **compact segment-sum**: each shard gathers its OWN local counts
+       at the first ``cap`` union positions and one compact [cap] psum
+       produces the EXACT global sums (every shard contributes at every
+       union position — including sub-threshold contributions — so
+       surviving counts are bit-exact vs the dense path); the sums
+       scatter back so callers see the same [N]-shaped tensor, zero at
+       provably-infrequent positions.
+
+    Returns ``(counts, n_union)``; ``n_union > cap`` means the
+    compaction truncated and the result is UNUSABLE — callers must
+    detect it and fall back to the dense reduction (they get the true
+    union size to resize with)."""
+    flat = local.reshape(-1)
+    n = flat.shape[0]
+    assert n % 8 == 0, n
+    promising = flat >= thr
+    if valid is not None:
+        promising = promising & valid.reshape(-1)
+    packed = pack_bits_msb(promising)  # [n//8] uint8
+    gathered = lax.all_gather(packed, axis_name)  # [S, n//8]
+    union_packed = lax.reduce(
+        gathered, jnp.uint8(0), lax.bitwise_or, (0,)
+    )
+    union = _unpack_bits_msb(union_packed)  # [n] bool, identical per shard
+    nu = jnp.sum(union, dtype=jnp.int32)
+    (upos,) = jnp.nonzero(union, size=cap, fill_value=0)
+    upos = upos.astype(jnp.int32)
+    slot_ok = jnp.arange(cap, dtype=jnp.int32) < nu
+    comp = jnp.where(slot_ok, jnp.take(flat, upos), 0)
+    summed = lax.psum(comp, axis_name)
+    # Scatter-ADD onto zeros: overflow fill slots point at position 0,
+    # but their contribution is masked to 0, so a real union member at
+    # position 0 still lands its exact sum.
+    counts = (
+        jnp.zeros_like(flat)
+        .at[upos]
+        .add(jnp.where(slot_ok, summed, 0))
+    )
+    return counts.reshape(local.shape), nu
+
+
+def sparse_psum_bytes(n_valid: int, cap: int, n_shards: int) -> tuple:
+    """(gather_bytes, psum_bytes) payload model of one
+    :func:`local_sparse_psum` call — the per-engine comms accounting
+    bench records next to the dense ``4·n`` psum figure.  The mask
+    gather lands S·n/8 bytes per shard; the compact psum payload is
+    4·cap (+4 for the union census riding the survivor fetch)."""
+    return n_shards * (n_valid // 8), 4 * cap + 4
+
+
 # Item-axis bound for the in-kernel level-3 candidate census: the extra
 # [F, F] matmul is ~2·F³ flops (sub-ms on the MXU at 4096, but F³ grows
 # fast and sparse-item datasets — the ones with F in the tens of
@@ -343,6 +443,8 @@ def local_pair_gather(
     heavy_w: Optional[jnp.ndarray] = None,  # [Th] int32
     axis_name: Optional[str] = None,
     fast_f32: bool = False,
+    sparse_thr: Optional[jnp.ndarray] = None,  # () int32 per-shard prune
+    sparse_cap: Optional[int] = None,  # static union slot budget
 ) -> tuple:
     """C6, transfer-minimal form: the pair Gram matmul PLUS the threshold,
     on device.  Only surviving pairs leave the chip: returns
@@ -360,6 +462,15 @@ def local_pair_gather(
     ``fast_f32``: run the Gram matmul as ONE float32 matmul (BLAS path on
     CPU backends, where XLA int8 matmuls are orders slower).  Exact only
     when the caller has proven every count < 2^24.
+
+    ``sparse_cap`` (with ``sparse_thr``) replaces the dense [F, F] psum
+    with the threshold-sparse exchange (:func:`local_sparse_psum`,
+    validity = the upper-triangle real-item candidate set): the
+    returned counts matrix then holds exact global counts at every
+    union position and zeros at provably-infrequent ones — identical
+    survivor extraction — and ``packed`` gains one trailing slot with
+    the union census, ``[... | n2 | tri | n_union]``, so the host can
+    detect compaction overflow (results unusable; redo dense).
     """
     f = bitmap.shape[1]
     if fast_f32:
@@ -376,10 +487,20 @@ def local_pair_gather(
         counts = _weighted_matmul(bitmap, bitmap, w_digits, scales)
     if heavy_b is not None:
         counts = counts + heavy_pair_correction(heavy_b, heavy_w, axis_name)
-    counts = _psum_if(counts, axis_name)
+    nu = None
+    if sparse_cap is not None:
+        iu = jnp.arange(f)
+        cand = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
+        counts, nu = local_sparse_psum(
+            counts, sparse_thr, sparse_cap, axis_name, valid=cand
+        )
+    else:
+        counts = _psum_if(counts, axis_name)
     packed = pair_threshold_pack(
         counts, min_count, num_items, cap, census=f <= TRI_F_CAP
     )
+    if nu is not None:
+        packed = jnp.concatenate([packed, nu[None]])
     return packed, counts
 
 
@@ -417,6 +538,8 @@ def local_level_gather(
     fast_f32: bool = False,
     pallas_tiles: Optional[tuple] = None,
     wide_member: bool = False,
+    sparse_thr: Optional[jnp.ndarray] = None,  # () int32 per-shard prune
+    sparse_cap: Optional[int] = None,  # static union slot budget
 ) -> jnp.ndarray:
     """C8, transfer-minimal form: one compilation serves EVERY level.
 
@@ -460,6 +583,13 @@ def local_level_gather(
     prefixes — ADVICE r5 #1); dispatch sites set this for levels with
     ``k1 >= 128`` instead of miscounting.  4x the [tc, P] intermediate
     bytes, paid only on absurdly deep lattices.
+
+    ``sparse_cap`` (with ``sparse_thr``): the final [C] candidate-gather
+    reduction runs as the threshold-sparse exchange
+    (:func:`local_sparse_psum`) instead of the dense psum; the return
+    becomes ``(counts, n_union)``.  The dispatch layer fills padded
+    ``cand_idx`` slots with a guaranteed-zero-count position so padding
+    never enters the union.
     """
     t_loc, f_pad = bitmap.shape
     p = prefix_cols.shape[0]
@@ -494,6 +624,10 @@ def local_level_gather(
                 onehot, k1, heavy_b, heavy_w, axis_name
             )
         local = jnp.take(counts.reshape(-1), cand_idx)
+        if sparse_cap is not None:
+            return local_sparse_psum(
+                local, sparse_thr, sparse_cap, axis_name
+            )
         return _psum_if(local, axis_name)
 
     tc = t_loc // n_chunks
@@ -565,6 +699,8 @@ def local_level_gather(
             onehot, k1, heavy_b, heavy_w, axis_name
         )
     local = jnp.take(counts.reshape(-1), cand_idx)
+    if sparse_cap is not None:
+        return local_sparse_psum(local, sparse_thr, sparse_cap, axis_name)
     return _psum_if(local, axis_name)
 
 
@@ -583,13 +719,17 @@ def local_level_gather_batch(
     fast_f32: bool = False,
     pallas_tiles: Optional[tuple] = None,
     wide_member: bool = False,
+    sparse_thr: Optional[jnp.ndarray] = None,
+    sparse_cap: Optional[int] = None,
 ) -> jnp.ndarray:
     """A whole level's prefix blocks in ONE launch: ``lax.scan`` over the
     stacked blocks, each step = :func:`local_level_gather`.  Kernel
     launches carry a large fixed cost on remote/tunneled backends (the
     runtime round-trips per launch instead of pipelining), so a level
     with NB blocks pays it once instead of NB times.  Returns
-    ``[NB, C]`` gathered candidate counts."""
+    ``[NB, C]`` gathered candidate counts — or, with ``sparse_cap``
+    (the threshold-sparse reduction), ``([NB, C] counts, [NB] union
+    censuses)``."""
 
     def step(carry, xs):
         pc, ci = xs
@@ -608,6 +748,8 @@ def local_level_gather_batch(
             fast_f32=fast_f32,
             pallas_tiles=pallas_tiles,
             wide_member=wide_member,
+            sparse_thr=sparse_thr,
+            sparse_cap=sparse_cap,
         )
         return carry, out
 

@@ -144,6 +144,7 @@ def _fused_mine_local(
     packed,  # [T_local, F//8] uint8 — or [T_local, F] int8 (packed_input=False)
     w,  # [T_local] int32
     min_count,  # scalar int32
+    sparse_thr=None,  # [S] int32 per-shard prune thresholds (sparse only)
     *,
     m_cap: int,
     l_max: int,
@@ -152,6 +153,7 @@ def _fused_mine_local(
     fast_f32: bool,
     axis_name: Optional[str],
     packed_input: bool = True,
+    sparse_caps: Optional[Tuple[int, int]] = None,  # (pair, level) budgets
 ):
     f = packed.shape[1] * 8 if packed_input else packed.shape[1]
     t_local = packed.shape[0]
@@ -171,6 +173,25 @@ def _fused_mine_local(
 
     def psum(x):
         return lax.psum(x, axis_name) if axis_name is not None else x
+
+    def reduce_counts(counts, cand_mask, cap):
+        """The per-level count reduction: dense psum, or the
+        threshold-sparse exchange (ops/count.py local_sparse_psum — the
+        same local-prune/union-gather/compact-sum the level engine
+        runs) restricted to the level's candidate mask.  Returns
+        ``(global counts, union census)``; a census above ``cap``
+        makes the level's counts unusable — the overflow flag AND the
+        census ride the meta row so the host re-runs the attempt with
+        the dense reduction and memoizes the grown budget."""
+        if sparse_caps is None or axis_name is None:
+            return psum(counts), jnp.int32(0)
+        from fastapriori_tpu.ops.count import local_sparse_psum
+
+        thr = sparse_thr[lax.axis_index(axis_name)]
+        out, nu = local_sparse_psum(
+            counts, thr, cap, axis_name, valid=cand_mask
+        )
+        return out, nu
 
     def scan_counts(project, out_dim):
         """Σ over chunks of _weighted_counts(project(B_chunk), B_chunk)."""
@@ -192,8 +213,14 @@ def _fused_mine_local(
         return acc
 
     # ---- level 2: weighted Gram matmul (C6) ---------------------------
-    pair = psum(scan_counts(lambda b: b, f))  # [F, F] int32
-    mask2 = (pair >= min_count) & (col_ids[None, :] > col_ids[:, None])
+    upper2 = col_ids[None, :] > col_ids[:, None]
+    cap2 = sparse_caps[0] if sparse_caps else 0
+    pair, nu2 = reduce_counts(
+        scan_counts(lambda b: b, f), upper2, cap2
+    )  # [F, F] int32
+    sparse_nu = nu2
+    sparse_ovf = nu2 > jnp.int32(cap2)
+    mask2 = (pair >= min_count) & upper2
     n2 = jnp.sum(mask2, dtype=jnp.int32)
     r2, c2 = jnp.nonzero(mask2, size=m_cap, fill_value=0)
     valid2 = (jnp.arange(m_cap, dtype=jnp.int32) < n2)[:, None]
@@ -211,12 +238,14 @@ def _fused_mine_local(
     overflow = n2 > m_cap
 
     # ---- levels >= 3 (C7 + C8 + C9) -----------------------------------
+    capk = sparse_caps[1] if sparse_caps else 0
+
     def cond(state):
-        s, m, k, *_rest, ovf = state
-        return (~ovf) & (m >= k) & (k <= l_max + 1)
+        s, m, k, *_rest, ovf, sovf, _snu = state
+        return (~ovf) & (~sovf) & (m >= k) & (k <= l_max + 1)
 
     def body(state):
-        s, m, k, o_rows, o_cols, o_counts, o_n, ovf = state
+        s, m, k, o_rows, o_cols, o_counts, o_n, ovf, sovf, snu = state
         valid_row = (jnp.arange(m_cap, dtype=jnp.int32) < m)[:, None]
         cand = _gen_candidates_matmul(s, k, col_ids, valid_row)
 
@@ -240,7 +269,9 @@ def _fused_mine_local(
             )  # [T_c, M] intersection sizes (bounded by F: f32-exact)
             return (overlap == (k - 1).astype(acc)).astype(jnp.int8)
 
-        counts = psum(scan_counts(contains_prefix, m_cap))
+        counts, lvl_nu = reduce_counts(
+            scan_counts(contains_prefix, m_cap), cand, capk
+        )
 
         surv = cand & (counts >= min_count)
         n = jnp.sum(surv, dtype=jnp.int32)
@@ -258,7 +289,11 @@ def _fused_mine_local(
         o_counts = o_counts.at[idx].set(level_counts)
         o_n = o_n.at[idx].set(n)
         ovf = ovf | (n > m_cap)
-        return (s_next, n, k + 1, o_rows, o_cols, o_counts, o_n, ovf)
+        return (
+            s_next, n, k + 1, o_rows, o_cols, o_counts, o_n, ovf,
+            sovf | (lvl_nu > jnp.int32(capk)),
+            jnp.maximum(snu, lvl_nu),
+        )
 
     state = (
         s2,
@@ -269,22 +304,33 @@ def _fused_mine_local(
         out_counts,
         out_n,
         overflow,
+        sparse_ovf,
+        sparse_nu,
     )
-    s, m, k, out_rows, out_cols, out_counts, out_n, overflow = (
-        lax.while_loop(cond, body, state)
-    )
+    (
+        s, m, k, out_rows, out_cols, out_counts, out_n, overflow,
+        sparse_ovf, sparse_nu,
+    ) = lax.while_loop(cond, body, state)
     # incomplete: loop stopped by the l_max bound while still converging.
     incomplete = overflow | ((m >= k) & (k > l_max + 1))
     # Pack everything into ONE int32 array so the host needs a single
     # device->host transfer (each blocking fetch costs a full round trip
     # on tunneled backends): rows | cols | counts stacked level-major,
     # then a meta row holding per-level survivor counts, the incomplete
-    # flag at slot l_max, and the overflow flag at slot l_max+1
+    # flag at slot l_max, and the overflow flags at slot l_max+1
     # (m_cap > l_max+1 is asserted by the builders).  Overflow is
     # reported separately because the host's responses differ: overflow
     # retries with a budget sized from the true survivor counts (out_n
     # is the pre-cap sum, so the overflowing level's need is exact),
     # while an l_max-bound stop can't be fixed by more rows at all.
+    # Bit 1 of the overflow slot is the sparse-reduction union overflow
+    # (reduce_counts): the host re-runs the SAME budget with the dense
+    # reduction — sharing the slot keeps the meta layout (and every
+    # dense build's bytes) unchanged.  The max union census rides slot
+    # l_max+2 when the row has room (m_cap == l_max+2 skips it — the
+    # host just loses the budget memo, never correctness) so repeat
+    # runs size the compaction right instead of re-paying the wasted
+    # sparse dispatch.
     meta = (
         jnp.zeros((m_cap,), dtype=jnp.int32)
         .at[:l_max]
@@ -292,8 +338,13 @@ def _fused_mine_local(
         .at[l_max]
         .set(incomplete.astype(jnp.int32))
         .at[l_max + 1]
-        .set(overflow.astype(jnp.int32))
+        .set(
+            overflow.astype(jnp.int32)
+            + 2 * sparse_ovf.astype(jnp.int32)
+        )
     )
+    if m_cap > l_max + 2:
+        meta = meta.at[l_max + 2].set(sparse_nu)
     return jnp.concatenate(
         [out_rows, out_cols, out_counts, meta[None, :]], axis=0
     )
@@ -359,6 +410,7 @@ def make_fused_miner(
     n_chunks: int = 1,
     fast_f32: bool = False,
     packed_input: bool = True,
+    sparse_caps: Optional[Tuple[int, int]] = None,
 ):
     """Build the jitted fused mining program.  With a mesh, the bitmap and
     weights are sharded over the txn axis inside shard_map (psum
@@ -366,7 +418,10 @@ def make_fused_miner(
     packed [3*l_max+1, m_cap] int32 result (see _fused_mine_local).
     ``packed_input=False`` takes the level engine's resident unpacked
     int8 bitmap instead of the uint8 bit-packed form (pipelined-ingest
-    sharing)."""
+    sharing).  ``sparse_caps=(pair_cap, level_cap)`` switches both
+    count reductions to the threshold-sparse exchange; the program then
+    takes a fourth argument — the replicated [S] per-shard prune
+    thresholds (weighted pigeonhole over the static shard weights)."""
     assert m_cap > l_max + 1, (m_cap, l_max)  # meta row layout requirement
     kernel = functools.partial(
         _fused_mine_local,
@@ -377,14 +432,18 @@ def make_fused_miner(
         fast_f32=fast_f32,
         axis_name=AXIS if mesh is not None else None,
         packed_input=packed_input,
+        sparse_caps=sparse_caps if mesh is not None else None,
     )
     if mesh is None:
         return jax.jit(kernel)
+    in_specs = (P(AXIS, None), P(AXIS), P()) + (
+        (P(None),) if sparse_caps is not None else ()
+    )
     return jax.jit(
         compat.shard_map(
             kernel,
             mesh=mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P()),
+            in_specs=in_specs,
             out_specs=P(),
         )
     )
@@ -658,9 +717,18 @@ def unpack_tail_result(packed: np.ndarray, m_cap: int, l_max: int):
 
 def unpack_fused_result(
     packed: np.ndarray, l_max: int
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool, bool]:
+) -> Tuple[
+    np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool, bool, bool, int
+]:
     """Split the packed [3*l_max+1, m_cap] device result into
-    (rows, cols, counts, n_per_level, incomplete, overflow)."""
+    (rows, cols, counts, n_per_level, incomplete, overflow, sparse_ovf,
+    sparse_nu).  ``sparse_ovf`` (bit 1 of the overflow slot) means the
+    sparse count reduction's union compaction overflowed: every level's
+    counts are unusable and the attempt must re-run with the dense
+    reduction — checked BEFORE incomplete/overflow, which are undefined
+    then.  ``sparse_nu`` is the max union census (slot l_max+2; 0 when
+    the meta row had no room or the build was dense) — the budget the
+    host memoizes so repeat runs never re-pay the overflow."""
     rows = packed[:l_max]
     cols = packed[l_max : 2 * l_max]
     counts = packed[2 * l_max : 3 * l_max]
@@ -671,7 +739,9 @@ def unpack_fused_result(
         counts,
         meta[:l_max],
         bool(meta[l_max]),
-        bool(meta[l_max + 1]),
+        bool(meta[l_max + 1] & 1),
+        bool(meta[l_max + 1] >> 1),
+        int(meta[l_max + 2]) if meta.shape[0] > l_max + 2 else 0,
     )
 
 

@@ -43,6 +43,24 @@ def env_flag(name: str, default: bool = False) -> bool:
     )
 
 
+def env_choice(
+    name: str, choices: tuple, default: Optional[str] = None
+) -> Optional[str]:
+    """Strict enumerated knob: unset -> ``default``, a listed choice ->
+    itself (case-normalized), anything else -> ``InputError`` — the
+    FA_RULE_ENGINE/FA_COUNT_REDUCE contract."""
+    raw = os.environ.get(name, "")
+    val = raw.strip().lower()
+    if not val:
+        return default
+    if val in choices:
+        return val
+    raise InputError(
+        f"unrecognized {name} value {raw!r}: use one of "
+        f"{'/'.join(choices)} (or unset for the config default)"
+    )
+
+
 def env_int(
     name: str, default: int, minimum: Optional[int] = None
 ) -> int:

@@ -1,0 +1,347 @@
+"""Sparse count-reduction engine (ISSUE 6, ROADMAP item 2): the
+threshold-sparse exchange (ops/count.py local_sparse_psum — local prune
+at the weighted-pigeonhole threshold, packed-mask union all_gather,
+compact segment psum) must be BIT-EXACT against the dense psum on every
+corpus shape, across all three counting paths (level kernels, pair
+gather, fused whole-loop engine), and its engine
+selection/env/overflow contracts mirror the rule-engine table
+(tests/test_rules_device.py)."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.reliability import failpoints, ledger
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+
+
+def _mine(lines, min_support, **cfg):
+    miner = FastApriori(
+        config=MinerConfig(min_support=min_support, **cfg)
+    )
+    got, _, _ = miner.run(lines)
+    return dict(got), miner
+
+
+def _engine_events(miner=None):
+    return [
+        e for e in ledger.snapshot() if e["kind"] == "count_reduce_engine"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# differential suite: sparse vs dense, bit-exact counts per corpus shape
+
+
+def _t10i4_shaped():
+    """IBM-Quest-style power-law lines (the T10I4 family datagen
+    reproduces) — the corpus class the sparse exchange exists for."""
+    from fastapriori_tpu.utils.datagen import generate_transactions
+
+    return [
+        l.split()
+        for l in generate_transactions(
+            n_txns=1500, n_items=90, avg_txn_len=9, n_patterns=30,
+            avg_pattern_len=4, corruption=0.35, seed=11,
+        )
+    ]
+
+
+def _webdocs_shaped():
+    """Skewed long-tail baskets with duplicate lines/items (the
+    random_dataset edge semantics) — webdocs-like support skew."""
+    return tokenized(
+        random_dataset(23, n_txns=400, n_items=40, max_len=12)
+    )
+
+
+def _deep_lattice():
+    """Few items, long correlated baskets: the lattice goes deep (k well
+    past 5), exercising many per-level reductions."""
+    return tokenized(
+        random_dataset(13, n_txns=200, n_items=14, max_len=9)
+    )
+
+
+def _no_survivor_level():
+    """High support: level 2 (or 3) has candidates but zero survivors —
+    the sparse union must come back empty without tripping anything."""
+    return tokenized(random_dataset(3, n_txns=120))
+
+
+@pytest.mark.parametrize(
+    "lines_fn, min_support",
+    [
+        (_t10i4_shaped, 0.03),
+        (_webdocs_shaped, 0.04),
+        (_deep_lattice, 0.05),
+        (_no_survivor_level, 0.4),
+    ],
+    ids=["t10i4", "webdocs", "deep-lattice", "no-survivor"],
+)
+@pytest.mark.parametrize("engine", ["level", "fused"])
+def test_sparse_bitexact_vs_dense(lines_fn, min_support, engine):
+    lines = lines_fn()
+    exp, _ = _mine(
+        lines, min_support, engine=engine, num_devices=8,
+        count_reduce="dense",
+    )
+    got, miner = _mine(
+        lines, min_support, engine=engine, num_devices=8,
+        count_reduce="sparse", count_sparse_min=1,
+    )
+    assert got == exp
+    assert _engine_events()  # the choice landed on the ledger
+
+
+def test_sparse_overflow_falls_back_dense_and_stays_exact():
+    """A forced-tiny compaction budget overflows the union on every
+    non-trivial reduction; the engine must detect it (the union census
+    rides the survivor fetch), recount dense, record the ledger event,
+    and still produce bit-exact itemsets."""
+    lines = _t10i4_shaped()
+    exp, _ = _mine(
+        lines, 0.03, engine="level", num_devices=8, count_reduce="dense"
+    )
+    got, miner = _mine(
+        lines, 0.03, engine="level", num_devices=8,
+        count_reduce="sparse", count_sparse_min=1, count_sparse_cap=8,
+    )
+    assert got == exp
+    kinds = [e["kind"] for e in ledger.snapshot()]
+    assert "count_sparse_overflow" in kinds
+    # The grown budget was memoized: a repeat mine on the same context
+    # sizes the compaction right and pays no second overflow.
+    ledger.reset()
+    got2, _, _ = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", num_devices=8,
+            count_reduce="sparse", count_sparse_min=1, count_sparse_cap=8,
+        ),
+        context=miner.context,
+    ).run(lines)
+    assert dict(got2) == exp
+    assert not [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_sparse_overflow"
+    ]
+
+
+def test_fused_sparse_overflow_reruns_dense():
+    lines = _deep_lattice()
+    exp, _ = _mine(
+        lines, 0.05, engine="fused", num_devices=8, count_reduce="dense"
+    )
+    got, miner = _mine(
+        lines, 0.05, engine="fused", num_devices=8,
+        count_reduce="sparse", count_sparse_min=1, count_sparse_cap=8,
+    )
+    assert got == exp
+    events = [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_sparse_overflow"
+    ]
+    assert events and events[0]["site"] == "fused"
+    # The kernel reported the true union census and the host memoized
+    # it: a repeat mine on the same context sizes the compaction right
+    # and never re-pays the wasted sparse dispatch + dense redo.
+    assert events[0].get("n_union", 0) > 8
+    ledger.reset()
+    got2, _, _ = FastApriori(
+        config=MinerConfig(
+            min_support=0.05, engine="fused", num_devices=8,
+            count_reduce="sparse", count_sparse_min=1, count_sparse_cap=8,
+        ),
+        context=miner.context,
+    ).run(lines)
+    assert dict(got2) == exp
+    assert not [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_sparse_overflow"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# engine selection / fallback / env strictness (the rule-engine table)
+
+
+def test_auto_stays_dense_on_one_device():
+    lines = _deep_lattice()
+    _, miner = _mine(
+        lines, 0.05, engine="level", num_devices=1, count_reduce="auto"
+    )
+    recs = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "count_reduce"
+    ]
+    assert recs and recs[0]["engine"] == "dense"
+    assert not _engine_events()
+
+
+def test_auto_picks_sparse_on_multi_device():
+    lines = _deep_lattice()
+    _, miner = _mine(
+        lines, 0.05, engine="level", num_devices=8, count_reduce="auto"
+    )
+    recs = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "count_reduce"
+    ]
+    assert recs and recs[0]["engine"] == "sparse"
+
+
+def test_forced_sparse_on_one_device_falls_back_with_ledger():
+    lines = _deep_lattice()
+    got, _ = _mine(
+        lines, 0.05, engine="level", num_devices=1, count_reduce="sparse"
+    )
+    exp, _ = _mine(
+        lines, 0.05, engine="level", num_devices=1, count_reduce="dense"
+    )
+    assert got == exp
+    falls = [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_reduce_fallback"
+    ]
+    assert falls and falls[0]["reason"] == "one_txn_shard"
+
+
+def test_forced_sparse_on_cand_mesh_falls_back():
+    lines = _deep_lattice()
+    got, _ = _mine(
+        lines, 0.05, engine="level", num_devices=8, cand_devices=2,
+        count_reduce="sparse",
+    )
+    exp, _ = _mine(
+        lines, 0.05, engine="level", num_devices=8, cand_devices=2,
+        count_reduce="dense",
+    )
+    assert got == exp
+    falls = [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_reduce_fallback"
+    ]
+    assert falls and falls[0]["reason"] == "cand_mesh"
+
+
+def test_tiny_levels_stay_dense_under_auto():
+    """The count_sparse_min floor: candidate spaces under it keep the
+    dense psum even when the mine selected sparse (per-dispatch
+    decision — the exchange's two collectives cost more than a small
+    dense payload)."""
+    lines = _deep_lattice()
+    _, miner = _mine(
+        lines, 0.05, engine="level", num_devices=8,
+        count_reduce="sparse", count_sparse_min=1 << 30,
+    )
+    lvl = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "level" and r.get("k", 0) >= 3
+    ]
+    assert lvl and all(r.get("reduce") == "dense" for r in lvl)
+    # ...and the fallback is a recorded degradation (config.py's
+    # tiny-candidate-set contract), one event per dense level.
+    falls = [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_reduce_fallback"
+        and e.get("reason") == "tiny_candidate_set"
+    ]
+    assert falls
+
+
+def test_config_count_reduce_strictly_validated():
+    lines = _deep_lattice()
+    with pytest.raises(InputError, match="count_reduce"):
+        _mine(lines, 0.05, engine="level", count_reduce="sprase")
+
+
+def test_env_count_reduce_strictly_parsed(monkeypatch):
+    from fastapriori_tpu.utils.env import env_choice
+
+    monkeypatch.setenv("FA_COUNT_REDUCE", "  DENSE ")
+    assert env_choice("FA_COUNT_REDUCE", ("auto", "dense", "sparse")) == (
+        "dense"
+    )
+    monkeypatch.setenv("FA_COUNT_REDUCE", "sprase")  # the typo class
+    with pytest.raises(InputError, match="FA_COUNT_REDUCE"):
+        env_choice("FA_COUNT_REDUCE", ("auto", "dense", "sparse"))
+
+
+def test_env_overrides_config(monkeypatch):
+    """FA_COUNT_REDUCE=dense beats a sparse config — no sparse engine
+    event lands on the ledger."""
+    monkeypatch.setenv("FA_COUNT_REDUCE", "dense")
+    lines = _deep_lattice()
+    _, miner = _mine(
+        lines, 0.05, engine="level", num_devices=8, count_reduce="sparse"
+    )
+    assert not _engine_events()
+    recs = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "count_reduce"
+    ]
+    assert recs and recs[0]["engine"] == "dense"
+
+
+def test_env_sparse_cap_strictly_parsed(monkeypatch):
+    monkeypatch.setenv("FA_COUNT_SPARSE_CAP", "64k")
+    lines = _deep_lattice()
+    with pytest.raises(InputError, match="FA_COUNT_SPARSE_CAP"):
+        _mine(
+            lines, 0.05, engine="level", num_devices=8,
+            count_reduce="sparse", count_sparse_min=1,
+        )
+
+
+# ---------------------------------------------------------------------------
+# the primitive itself
+
+
+def test_sparse_union_cap_buckets():
+    from fastapriori_tpu.ops.count import sparse_union_cap
+
+    assert sparse_union_cap(1 << 18) == (1 << 18) // 16
+    assert sparse_union_cap(4096) == 1024  # floor
+    assert sparse_union_cap(512) == 512  # never above the space itself
+    assert sparse_union_cap(1 << 18, override=3000) == 4096  # pow2 bucket
+    assert sparse_union_cap(1024, override=1 << 20) == 1024  # clamped
+
+
+def test_sparse_thresholds_pigeonhole():
+    """Per-shard thresholds must satisfy the pigeonhole: a candidate
+    below every shard's threshold sums below min_count."""
+    from fastapriori_tpu.preprocess import preprocess
+
+    lines = _deep_lattice()
+    miner = FastApriori(
+        config=MinerConfig(min_support=0.05, num_devices=8)
+    )
+    data = preprocess(lines, 0.05)
+    s = miner.context.txn_shards
+    t_pad = ((data.total_count + s - 1) // s) * s
+    thr = miner._sparse_thresholds(data, t_pad, heavy=False)
+    assert thr.shape == (s,) and thr.dtype == np.int32
+    assert (thr >= 1).all()
+    # Σ (thr_s - 1) < min_count is exactly the no-lost-candidate bound.
+    assert int((thr.astype(np.int64) - 1).sum()) < data.min_count

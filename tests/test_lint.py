@@ -741,6 +741,72 @@ def test_shape_flow_summary_propagates_one_level():
     assert hits and hits[0].path == "pkg/parallel/m.py"
 
 
+def test_shape_flow_summary_fixpoint_catches_two_hop_launder():
+    """ISSUE 6 satellite (ROADMAP graftlint residue): a dynamic int
+    laundered through TWO helpers — ``rows`` returns ``x.shape[0]``,
+    ``padded`` forwards it — must still flag at the sink; the depth-1
+    summary judged the forwarding helper CLEAN and the launder escaped.
+    The fixpoint also must NOT over-taint: a two-hop chain whose inner
+    helper sanitizes through next_pow2 stays BUCKETED and clean."""
+    inner = ("pkg/parallel/h.py", "def rows(x):\n    return x.shape[0]\n")
+    fwd = (
+        "pkg/parallel/g.py",
+        "from pkg.parallel.h import rows\n"
+        "def padded(x):\n"
+        "    return rows(x) + 8\n",
+    )
+    user = (
+        "pkg/parallel/m.py",
+        "import jax.numpy as jnp\n"
+        "from pkg.parallel.g import padded\n"
+        "def up(x):\n"
+        "    return jnp.zeros((padded(x), 8), jnp.int32)\n",
+    )
+    result = engine.lint_sources([MESH_DECL, inner, fwd, user])
+    hits = [f for f in result.findings if f.rule == "G011"]
+    assert hits and hits[0].path == "pkg/parallel/m.py"
+    # The positive twin: the same two-hop chain sanitized at the root
+    # (the sparse-cap helper idiom — a compaction-size helper calling
+    # next_pow2 indirectly) must stay clean.
+    inner_ok = (
+        "pkg/parallel/h.py",
+        "from fastapriori_tpu.ops.bitmap import next_pow2\n"
+        "def rows(x):\n"
+        "    return next_pow2(x.shape[0])\n",
+    )
+    clean = engine.lint_sources([MESH_DECL, inner_ok, fwd, user])
+    assert not [f for f in clean.findings if f.rule == "G011"]
+
+
+def test_return_summaries_fixpoint_converges_monotonically():
+    """The summary iteration must reach a stable fixpoint (not oscillate)
+    and report the whole chain DYNAMIC."""
+    from tools.lint import flow
+    from tools.lint.engine import FileContext
+    from tools.lint.graph import PackageGraph
+
+    files = [
+        FileContext("pkg/a.py", "def f(x):\n    return len(x)\n"),
+        FileContext(
+            "pkg/b.py",
+            "from pkg.a import f\n"
+            "def g(x):\n"
+            "    return f(x)\n",
+        ),
+        FileContext(
+            "pkg/c.py",
+            "from pkg.b import g\n"
+            "def h(x):\n"
+            "    return g(x) * 2\n",
+        ),
+    ]
+    graph = PackageGraph(files)
+    summaries = flow.return_summaries(files, graph)
+    assert summaries["pkg.a.f"] == flow.DYNAMIC
+    assert summaries["pkg.b.g"] == flow.DYNAMIC
+    assert summaries["pkg.c.h"] == flow.DYNAMIC
+
+
 def test_g012_registry_membership_and_staleness():
     registry = {"vars": {"FA_KNOWN": {"description": "d", "readers": []},
                          "FA_STALE": {"description": "d", "readers": []}}}

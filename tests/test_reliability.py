@@ -141,7 +141,9 @@ FETCH_SITE_INVENTORY = [
     "fetch.counts_drain",  # models/apriori.py byte-budgeted mid-mine drain
     "fetch.counts_resolve",  # models/apriori.py tail-fold count resolve
     "fetch.level_bits",  # models/apriori.py per-level survivor bitmask
+    "fetch.level_bits_sparse",  # models/apriori.py sparse-engine bitmask+union census
     "fetch.level_counts",  # models/apriori.py end-of-mine count fetch
+    "fetch.pair_sparse",  # parallel/mesh.py sparse-engine pair packed fetch
     "fetch.rule_mask",  # rules/gen.py device-engine survivor bitmask
     "fetch.rule_counts",  # rules/gen.py surviving-denominator gather
 ]
@@ -394,7 +396,10 @@ def test_transient_fetch_failure_is_retried_and_run_succeeds():
     clean = FastApriori(config=_mine_config()).run(txns)[0]
     ledger.reset()
     failpoints.arm("fetch.pair", "oom*1")
-    miner = FastApriori(config=_mine_config())
+    # Dense engine pinned: on this 8-device mesh auto now selects the
+    # sparse exchange, whose pair fetch is its own site (pair_sparse,
+    # exercised below).
+    miner = FastApriori(config=_mine_config(count_reduce="dense"))
     got = miner.run(txns)[0]
     assert sorted(got) == sorted(clean)
     retries = [e for e in ledger.snapshot() if e["kind"] == "retry"]
@@ -406,7 +411,69 @@ def test_transient_fetch_failure_is_retried_and_run_succeeds():
 def test_injected_oom_without_retry_budget_still_fails():
     failpoints.arm("fetch.pair", "oom")  # every attempt
     with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
-        FastApriori(config=_mine_config()).run(_dataset())
+        FastApriori(config=_mine_config(count_reduce="dense")).run(
+            _dataset()
+        )
+
+
+def _sparse_config(**kw):
+    """Multi-device sparse count-reduction engine (ISSUE 6): the
+    compact-exchange fetch sites only exist on a >= 2 device mesh."""
+    return _mine_config(
+        num_devices=8, count_reduce="sparse", count_sparse_min=1, **kw
+    )
+
+
+def test_sparse_engine_fetch_failpoints_retried_end_to_end():
+    """The sparse engine's compact-exchange fetches (pair_sparse +
+    level_bits_sparse) are audited sites: an injected transient on each
+    must be absorbed by the retry wrapper inside a real sparse mine,
+    bit-exact against the clean run."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.pair_sparse", "oom*1")
+    failpoints.arm("fetch.level_bits_sparse", "oom*1")
+    miner = FastApriori(config=_sparse_config())
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    sites = {
+        e["site"] for e in ledger.snapshot() if e["kind"] == "retry"
+    }
+    assert {"fetch.pair_sparse", "fetch.level_bits_sparse"} <= sites
+
+
+def test_sparse_kill_resume_round_trip_bit_exact(tmp_path):
+    """ISSUE 6 satellite: kill-and-resume must stay byte-identical under
+    the sparse count-reduction engine — interrupt after a completed
+    level, resume from the checkpoint with the sparse engine still
+    selected, writer output byte-equal to the uninterrupted dense
+    run's."""
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+    clean_sets, _, clean_items = FastApriori(config=_mine_config()).run(
+        txns
+    )
+    failpoints.arm("level.3", "abort")  # die right after level 3 commits
+    miner = FastApriori(
+        config=_sparse_config(checkpoint_prefix=prefix)
+    )
+    with pytest.raises(failpoints.InjectedAbort):
+        miner.run(txns)
+    failpoints.disarm_all()
+    levels, meta = ckpt.load_checkpoint(prefix)
+    assert levels[-1][0].shape[1] == 3
+    resumed = FastApriori(config=_sparse_config())
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    got_sets, _, got_items = resumed.run(txns)
+    assert got_items == clean_items
+    out_a, out_b = str(tmp_path / "a_"), str(tmp_path / "b_")
+    writer.save_freq_itemsets(out_a, clean_sets, clean_items)
+    writer.save_freq_itemsets(out_b, got_sets, got_items)
+    assert (
+        open(out_a + "freqItemset", "rb").read()
+        == open(out_b + "freqItemset", "rb").read()
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -102,12 +102,23 @@ def test_2d_mesh_full_pipeline_with_fused_engine():
     assert sorted(rec) == sorted(exp_rec)
 
 
+def _level_byte_series(miner):
+    """k -> (psum_bytes, gather_bytes) per level event of the last run."""
+    return {
+        r.get("k"): (r.get("psum_bytes"), r.get("gather_bytes", 0))
+        for r in miner.metrics.records
+        if r.get("event") == "level"
+    }
+
+
 def test_psum_bytes_invariant_across_device_counts():
-    """Per-level psum bytes must be CONSTANT across 1/2/4/8 virtual
-    devices (VERDICT r5 next #7): the collective reduces the gathered
-    candidate array, whose size is set by the candidate space — a psum
-    payload that grew with the mesh would mean the kernels were
-    resharding data instead of reducing partial sums."""
+    """DENSE-engine contract: per-level psum bytes must be CONSTANT
+    across 1/2/4/8 virtual devices (VERDICT r5 next #7): the collective
+    reduces the gathered candidate array, whose size is set by the
+    candidate space — a psum payload that grew with the mesh would mean
+    the kernels were resharding data instead of reducing partial sums.
+    (The sparse engine's payload legitimately moves with the mesh — its
+    contract is the strictly-below-dense test following this one.)"""
     from fastapriori_tpu.config import MinerConfig
 
     lines = tokenized(random_dataset(11, n_txns=240, n_items=14, max_len=8))
@@ -115,14 +126,13 @@ def test_psum_bytes_invariant_across_device_counts():
     for n in (1, 2, 4, 8):
         miner = FastApriori(
             config=MinerConfig(
-                min_support=0.05, engine="level", num_devices=n
+                min_support=0.05, engine="level", num_devices=n,
+                count_reduce="dense",
             )
         )
         miner.run(lines)
         series[n] = {
-            r.get("k"): r.get("psum_bytes")
-            for r in miner.metrics.records
-            if r.get("event") == "level"
+            k: p for k, (p, _g) in _level_byte_series(miner).items()
         }
     assert series[1] and all(v is not None for v in series[1].values())
     for n in (2, 4, 8):
@@ -130,3 +140,53 @@ def test_psum_bytes_invariant_across_device_counts():
             f"per-level psum bytes moved with device count "
             f"(1 dev: {series[1]}, {n} dev: {series[n]})"
         )
+
+
+def test_sparse_collective_bytes_below_dense():
+    """SPARSE-engine contract (ROADMAP item 2, ISSUE 6): on a power-law
+    corpus at >= 2 devices the sparse exchange's total collective bytes
+    (mask gather + compact psum) must be strictly below the dense psum
+    payload — and <= 25% of it on the 4-device mesh, where the r6
+    acceptance bar sits — while staying bit-exact."""
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.utils.datagen import generate_transactions
+
+    # IBM-Quest-style power-law corpus: a core of planted patterns plus
+    # a long infrequent tail, so most mid-level candidates die at the
+    # threshold (the regime the sparse exchange exists for).
+    lines = [
+        l.split()
+        for l in generate_transactions(
+            n_txns=3000, n_items=200, avg_txn_len=8, n_patterns=60,
+            avg_pattern_len=5, corruption=0.4, seed=5,
+        )
+    ]
+    dense = FastApriori(
+        config=MinerConfig(
+            min_support=0.02, engine="level", num_devices=4,
+            count_reduce="dense",
+        )
+    )
+    exp, _, _ = dense.run(lines)
+    dense_bytes = {
+        k: p + g for k, (p, g) in _level_byte_series(dense).items()
+    }
+    for n in (2, 4, 8):
+        sparse = FastApriori(
+            config=MinerConfig(
+                min_support=0.02, engine="level", num_devices=n,
+                count_reduce="sparse", count_sparse_min=1,
+            )
+        )
+        got, _, _ = sparse.run(lines)
+        assert dict(got) == dict(exp)  # bit-exact vs the dense oracle
+        sparse_bytes = {
+            k: p + g for k, (p, g) in _level_byte_series(sparse).items()
+        }
+        assert sum(sparse_bytes.values()) < sum(dense_bytes.values()), (
+            n, sparse_bytes, dense_bytes,
+        )
+        if n == 4:
+            assert sum(sparse_bytes.values()) <= 0.25 * sum(
+                dense_bytes.values()
+            ), (sparse_bytes, dense_bytes)

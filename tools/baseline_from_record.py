@@ -133,6 +133,13 @@ def render(parsed: dict) -> str:
         ov = sc.get("sharding_overhead_8dev")
         out.append("")
         line = f"Scaling: 8-virtual-device sharding overhead {ov}"
+        sp4 = ((sc.get("devices") or {}).get("4") or {}).get("sparse") or {}
+        if sp4.get("collective_vs_dense") is not None:
+            line += (
+                "; sparse count-reduce collective bytes "
+                f"{sp4['collective_vs_dense']}x dense at 4 devices "
+                f"(engine {sp4.get('count_reduce')})"
+            )
         for key, label in (
             ("two_process", "2-process"),
             ("four_process", "4-process"),

@@ -301,27 +301,79 @@ def _is_module_root(node: ast.AST) -> bool:
 
 
 def return_summaries(
-    files: Sequence, graph: PackageGraph
+    files: Sequence, graph: PackageGraph, max_rounds: int = 5
 ) -> Dict[str, int]:
-    """Taint state of each package function's return value, with nested
-    calls resolved only through the sanitizer/dynamic primitives — the
-    depth-1 summary the per-function walk consults."""
+    """Taint state of each package function's return value — computed to
+    a small FIXPOINT so a dynamic int laundered through a CHAIN of
+    helpers is still caught (ROADMAP graftlint residue: the depth-1
+    summary judged ``def a(x): return len(x)`` DYNAMIC but
+    ``def b(x): return a(x)`` CLEAN, so a two-hop launder escaped
+    G011).  Round 0 resolves only the sanitizer/dynamic primitives;
+    each later round re-evaluates every return against the previous
+    round's summaries, so taint propagates one extra call hop per
+    round.  States move monotonically up the CLEAN < BUCKETED < DYNAMIC
+    lattice (a call resolves to the callee's summary or to the max of
+    its inputs, both monotone in the summary map), so the iteration
+    converges; ``max_rounds`` bounds it for pathological chains — lint
+    wall time is CI-budgeted — and real chains are 2-3 deep."""
+    from tools.lint.engine import terminal_name
+
     out: Dict[str, int] = {}
+    primitives = set(SANITIZER_NAMES) | set(_DYNAMIC_CALLS) | set(
+        _PASSTHROUGH_CALLS
+    )
+
+    def compute(flow, fn) -> int:
+        env: Dict[str, int] = {}
+        # Run the assignment walk so `n = len(x); return n` works.
+        for _ in flow.walk(fn.body, env):
+            pass
+        state = CLEAN
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                state = max(state, flow.eval(node.value, env))
+        return state
+
+    # Only functions with a call that graph resolution could rebind can
+    # change after round 0 (primitive calls never consult summaries);
+    # everything else keeps its round-0 state — the fixpoint's extra
+    # rounds then touch a fraction of the package (lint wall time is
+    # CI-budgeted at 10 s).
+    fns = []  # (ctx, qualified name, fn node, may_resolve)
     for ctx in files:
         table = graph.by_path.get(ctx.path)
         if table is None:
             continue
-        flow = ShapeFlow(ctx, graph=None, summaries=None, check_sinks=False)
         for local, fn in table.functions.items():
-            env: Dict[str, int] = {}
-            # Run the assignment walk so `n = len(x); return n` works.
-            for _ in flow.walk(fn.body, env):
-                pass
-            state = CLEAN
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Return) and node.value is not None:
-                    state = max(state, flow.eval(node.value, env))
-            out[f"{table.name}.{local}"] = state
+            may_resolve = any(
+                isinstance(node, ast.Call)
+                and terminal_name(node.func) not in primitives
+                for node in ast.walk(fn)
+            )
+            out[f"{table.name}.{local}"] = CLEAN
+            fns.append((ctx, f"{table.name}.{local}", fn, may_resolve))
+
+    for _round in range(max_rounds):
+        first = _round == 0
+        changed = False
+        flows: Dict[str, ShapeFlow] = {}
+        for ctx, qual, fn, may_resolve in fns:
+            if not first and not may_resolve:
+                continue
+            flow = flows.get(ctx.path)
+            if flow is None:
+                flow = flows[ctx.path] = ShapeFlow(
+                    ctx,
+                    graph=None if first else graph,
+                    summaries=None if first else out,
+                    check_sinks=False,
+                )
+            state = compute(flow, fn)
+            if state != out[qual]:
+                out[qual] = state
+                changed = True
+        if not changed:
+            break
     return out
 
 
