@@ -86,6 +86,31 @@ class MinerConfig:
     # payload) — such dispatches stay dense even under count_reduce=
     # "sparse".
     count_sparse_min: int = 4096
+    # Mining-engine LAYOUT choice (ROADMAP item 3): "bitmap" runs the
+    # horizontal bitmap-matmul engines (the fused/level machinery below
+    # — and the differential oracle, pinned bit-exact on every corpus);
+    # "vertical" runs the Eclat-style tid-lane engine (ops/vertical.py:
+    # per-item packed uint32 tid lanes, level-k support by sharded
+    # lane-wise AND + popcount — only the actual candidates are
+    # counted, a ~32·F/k op reduction on sparse wide-item corpora where
+    # the Gram/level matmuls run at 0.2-0.8% MFU); "auto" (default)
+    # picks vertical when the pair-phase density estimate
+    # (Σ item_counts / (n_raw · F)) falls below
+    # `vertical_density_max` AND the frequent-item axis is at least
+    # `vertical_min_items` wide — dense retail baskets keep the MXU
+    # engines, sparse clickstream corpora get the lane engine — with
+    # the choice (and any forced-engine fallback: cand meshes,
+    # multi-process ingest, CSR-less CompressedData) recorded on the
+    # degradation ledger.  FA_MINE_ENGINE overrides, strictly parsed
+    # like FA_COUNT_REDUCE.
+    mine_engine: str = "auto"
+    vertical_density_max: float = 0.01
+    vertical_min_items: int = 512
+    # Vertical engine: candidate slots per scan step inside one launch
+    # (bounds the [chunk, NL] gathered intersection lanes in HBM; pow2-
+    # bucketed, clamped to the dispatch's candidate budget).
+    # FA_VERTICAL_CHUNK overrides, strictly parsed.
+    vertical_cand_chunk: int = 1 << 12
     # Level engine (transfer-minimal kernels, ops/count.py
     # local_level_gather / local_pair_gather): transaction-axis scan chunk
     # (bounds the [tc, P] membership intermediate in HBM), padded prefix

@@ -322,6 +322,174 @@ class FastApriori:
         thr = -(-(int(data.min_count) * per) // total)  # exact ceil
         return np.maximum(1, thr).astype(np.int32)
 
+    # -- mining-engine layout choice (ROADMAP item 3: vertical Eclat) --
+    _MINE_ENGINES = ("auto", "bitmap", "vertical")
+
+    @staticmethod
+    def _has_csr(data: CompressedData) -> bool:
+        return (
+            data.total_count == 0
+            or len(data.basket_offsets) == data.total_count + 1
+        )
+
+    @staticmethod
+    def _density_estimate(data: CompressedData) -> float:
+        """Pair-phase density estimate: frequent-item occurrence mass
+        over the full ``T × F`` bitmap — the fraction of bitmap cells
+        the Gram matmul multiplies that are actually set.  Computed
+        from the ingest's own tables (item_counts are the raw per-rank
+        occurrence counts), so the choice costs no device work."""
+        f = data.num_items
+        if f <= 0 or data.n_raw <= 0:
+            return 1.0
+        return float(np.sum(data.item_counts)) / (float(data.n_raw) * f)
+
+    def _requested_mine_engine(self) -> str:
+        """The strictly-parsed mining-engine REQUEST (``FA_MINE_ENGINE``
+        over ``config.mine_engine``, a typo in either -> InputError) —
+        ONE definition shared by the pipeline-ingest probe and the
+        mine-time resolution, so the two sites can never drift."""
+        from fastapriori_tpu.utils.env import env_choice
+
+        req = env_choice("FA_MINE_ENGINE", self._MINE_ENGINES)
+        if req is None:
+            req = self.config.mine_engine
+            if req not in self._MINE_ENGINES:
+                from fastapriori_tpu.errors import InputError
+
+                raise InputError(
+                    f"unrecognized MinerConfig.mine_engine value "
+                    f"{req!r}: use one of {'/'.join(self._MINE_ENGINES)}"
+                )
+        return req
+
+    def _mine_engine(self, data: CompressedData) -> Tuple[str, str]:
+        """Resolve the mining-engine LAYOUT for this mine:
+        ``FA_MINE_ENGINE`` (strict) overrides ``config.mine_engine``
+        (validated just as strictly).  Returns ``(engine, requested)``
+        with engine "bitmap" or "vertical".  The vertical tid-lane
+        engine is defined on single-process 1-D txn meshes over a
+        CSR-bearing CompressedData — elsewhere "auto" quietly stays
+        bitmap and a forced "vertical" falls back WITH a ledger event
+        (the ``_count_reduce_engine`` pattern).  Auto picks vertical on
+        sparse wide-item corpora: density below
+        ``config.vertical_density_max`` with at least
+        ``config.vertical_min_items`` frequent items — and records the
+        choice (plus the density it saw) on the ledger, so a record
+        always names which engine counted it."""
+        req = self._requested_mine_engine()
+        if req == "bitmap":
+            return "bitmap", req
+        ctx = self.context
+        reason = None
+        if ctx.cand_shards != 1:
+            reason = "cand_mesh"
+        elif data.shard is not None or jax.process_count() != 1:
+            reason = "multi_process"
+        elif not self._has_csr(data):
+            reason = "no_csr"
+        if reason is not None:
+            if req == "vertical":
+                ledger.record(
+                    "mine_engine_fallback", once_key=reason, reason=reason
+                )
+            return "bitmap", req
+        if req == "vertical":
+            ledger.record(
+                "mine_engine", once_key="vertical", engine="vertical"
+            )
+            return "vertical", req
+        density = self._density_estimate(data)
+        cfg = self.config
+        if (
+            data.num_items >= cfg.vertical_min_items
+            and density <= cfg.vertical_density_max
+        ):
+            ledger.record(
+                "mine_engine", once_key="auto_vertical",
+                engine="vertical", density=round(density, 6),
+            )
+            return "vertical", req
+        return "bitmap", req
+
+    def _vertical_chunk(self, c_cap: int) -> int:
+        """Candidate scan-chunk for the vertical kernels: the config/env
+        knob pow2-bucketed, then halved until it DIVIDES this
+        dispatch's candidate budget (the scan reshape needs an exact
+        divisor, and c_cap can clamp to f_pad — a 128-multiple like
+        384 that is not a power of two).  The [chunk, NL] gathered
+        intersection lanes are the kernel's HBM intermediate."""
+        from fastapriori_tpu.utils.env import env_int
+
+        chunk = env_int(
+            "FA_VERTICAL_CHUNK", 0, minimum=0
+        ) or self.config.vertical_cand_chunk
+        chunk = min(_next_pow2(max(int(chunk), 8)), _next_pow2(c_cap))
+        while chunk > 1 and c_cap % chunk:
+            chunk //= 2
+        return max(chunk, 1)
+
+    def _mine_vertical(
+        self, data: CompressedData
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Vertical (Eclat-style) mining: per-item tid-lists as packed
+        uint32 lanes sharded over the txn mesh axis, level-k support by
+        lane-wise AND + popcount (ops/vertical.py), the SAME level loop
+        driving it (``_level_loop(vertical=True)`` — candidate
+        generation, deferred counts, drains, checkpoints and resume all
+        shared with the bitmap engine, which stays the differential
+        oracle)."""
+        from fastapriori_tpu.ops import vertical as vops
+
+        cfg = self.config
+        ctx = self.context
+        resume = self._take_resume(data)
+        self._require_csr(data)
+        with self.metrics.timed("arena_build") as m:
+            arena_np, f_pad, t_pad = vops.build_tid_arena_csr(
+                data.basket_indices,
+                data.basket_offsets,
+                data.num_items,
+                32 * ctx.txn_shards,
+                cfg.item_tile,
+            )
+            planes_np, scales = vops.weight_bit_planes(
+                # lint: host-data -- CompressedData weights are host numpy
+                np.asarray(data.weights, dtype=np.int64), t_pad
+            )
+            # Census first (vectorized), bucket fill only when the
+            # compressed upload wins: the pow2-bucketed segment lists
+            # pay off below ~half occupancy; dense corpora skip both
+            # the per-item fill loop and the scatter dispatch.
+            _, payload, seg_stats = vops.compress_arena(
+                arena_np, f_pad, build=False
+            )
+            use_compressed = payload * 2 <= arena_np.nbytes
+            buckets = (
+                vops.compress_arena(arena_np, f_pad)[0]
+                if use_compressed
+                else None
+            )
+            arena, upload_bytes = ctx.upload_tid_arena(arena_np, buckets)
+            w_planes = ctx.upload_lane_planes(planes_np)
+            m.update(
+                shape=[f_pad + 1, t_pad // 32],
+                planes=len(scales),
+                compressed=use_compressed,
+                occupancy=seg_stats["occupancy"],
+                upload_bytes=upload_bytes + planes_np.nbytes,
+            )
+        # The pair phase folds the REASSEMBLED weights into one f32
+        # Gram on CPU backends (ops/vertical.py fast_f32) — entries are
+        # weighted counts bounded by n_raw, so the gate is the same
+        # n_raw < 2^24 bound as :meth:`_fast_f32`; k >= 3 counting is
+        # integer popcounts and never needs the gate.
+        fast_f32 = self._fast_f32(data.n_raw)
+        return self._level_loop(
+            data, resume, arena, w_planes, scales, 1, fast_f32, t_pad,
+            None, vertical=True,
+        )
+
     def _fused_count_reduce_setup(
         self, data: CompressedData, t_pad: int, f_pad: int,
         n_digits: int, n_chunks: int, fast_f32: bool, packed_input: bool,
@@ -483,6 +651,15 @@ class FastApriori:
         other combination keeps the existing flow."""
         cfg = self.config
         if cfg.ingest_pipeline_blocks <= 1 or "://" in d_path:
+            return False
+        # A FORCED vertical mine needs the basket CSR for the tid-lane
+        # arena — the pipelined capture ingest pre-commits to the
+        # horizontal bitmap layout (and the CLI drops the CSR), so it
+        # is skipped up front.  The "auto" choice keeps the pipeline:
+        # its density probe rides the ingest tables, and a pipelined
+        # bitmap already on device beats re-ingesting (folding the
+        # probe into pass 1 is ROADMAP residue).
+        if self._requested_mine_engine() == "vertical":
             return False
         import jax
 
@@ -1162,6 +1339,15 @@ class FastApriori:
             data.shard.global_count if data.shard else data.total_count
         )
         if data.num_items >= 2 and total > 0:
+            # Mining-engine LAYOUT first (ROADMAP item 3): the vertical
+            # tid-lane engine replaces the whole bitmap pipeline when
+            # selected; the bitmap engines below stay the differential
+            # oracle (and the fallback for every mesh/ingest shape the
+            # vertical path does not cover).
+            engine, req = self._mine_engine(data)
+            self.metrics.emit("mine_engine", engine=engine, requested=req)
+            if engine == "vertical":
+                return self._mine_vertical(data)
             # Mid-mine resume and per-level checkpointing both force the
             # level engine: the whole-lattice fused dispatch has no
             # mid-points to seed from or checkpoint at.
@@ -1810,6 +1996,7 @@ class FastApriori:
         heavy: Optional[tuple] = None,
         try_fused: bool = False,
         pair_pre: Optional[dict] = None,
+        vertical: bool = False,
     ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """The level-synchronous loop over a device-resident bitmap
         (levels 2..k; reference C6+C7+C8+C9).  ``try_fused``: the
@@ -1818,7 +2005,16 @@ class FastApriori:
         over this same resident bitmap.  ``pair_pre``: in-flight
         ingest-overlapped pair outputs — both the engine auto-choice's
         sizing inputs (n2/census) and level 2 itself reduce to ONE host
-        fetch of its packed survivor array."""
+        fetch of its packed survivor array.
+
+        ``vertical``: ``bitmap`` is the tid-lane arena
+        (``uint32[F_pad+1, NL]``, lanes sharded over txn) and
+        ``w_digits``/``scales`` the weight bit-planes — the SAME loop
+        drives the Eclat-style kernels (ops/vertical.py) so candidate
+        generation, deferred counts, mid-mine drains, checkpointing and
+        resume stay engine-independent; the fused offer, the
+        heavy-weight split and the shallow-tail fold are bitmap-engine
+        machinery and stay off."""
         cfg = self.config
         ctx = self.context
         f = data.num_items
@@ -1911,7 +2107,9 @@ class FastApriori:
             # whole phase is a FETCH of its packed output (~2·cap·4
             # bytes), not a dispatch.
             with self.metrics.timed("level", k=2) as m:
-                f_pad_p = bitmap.shape[1]
+                f_pad_p = (
+                    bitmap.shape[0] - 1 if vertical else bitmap.shape[1]
+                )
                 rinfo = {
                     "reduce": "dense",
                     "psum_bytes": 4 * f_pad_p * f_pad_p,
@@ -1946,8 +2144,16 @@ class FastApriori:
                         cfg.pair_cap, ctx.pair_cap_hint(cap_key) or 0
                     )
                     hb, hw = heavy if heavy is not None else (None, None)
+                    # Both engines reduce the same [F, F] space (the
+                    # vertical pair runs per-plane Grams over the lane
+                    # arena — ops/vertical.py); only the hint-key
+                    # prefix differs so the two engines' overflow
+                    # budgets never cross-pollinate.
                     sp_cap = None
-                    spk = ("sparse_pair", t_pad, f, min_count)
+                    spk = (
+                        "sparse_vpair" if vertical else "sparse_pair",
+                        t_pad, f, min_count,
+                    )
                     if (
                         count_reduce == "sparse"
                         and f_pad_p * f_pad_p >= cfg.count_sparse_min
@@ -1955,11 +2161,25 @@ class FastApriori:
                         sp_cap = self._sparse_cap(
                             f_pad_p * f_pad_p, hint_key=spk
                         )
-                    idx, cnt, n2, tri, counts_dev, rinfo = ctx.pair_gather(
-                        bitmap, w_digits, scales, min_count, f, cap,
-                        heavy_b=hb, heavy_w=hw, fast_f32=fast_f32,
-                        sparse_cap=sp_cap, sparse_thr=sparse_thr,
-                    )
+                    if vertical:
+                        idx, cnt, n2, tri, counts_dev, rinfo = (
+                            ctx.vertical_pair_gather(
+                                bitmap, w_digits, scales, min_count, f,
+                                cap, cfg.level_txn_chunk,
+                                fast_f32=fast_f32,
+                                sparse_cap=sp_cap, sparse_thr=sparse_thr,
+                            )
+                        )
+                    else:
+                        idx, cnt, n2, tri, counts_dev, rinfo = (
+                            ctx.pair_gather(
+                                bitmap, w_digits, scales, min_count, f,
+                                cap,
+                                heavy_b=hb, heavy_w=hw,
+                                fast_f32=fast_f32,
+                                sparse_cap=sp_cap, sparse_thr=sparse_thr,
+                            )
+                        )
                     if rinfo.get("fallback") == "sparse_overflow":
                         # Remember the true union size so repeat runs
                         # size the compaction right (pair_cap pattern).
@@ -1983,17 +2203,22 @@ class FastApriori:
                     del counts_dev  # free the [F, F] matrix promptly
                     d_eff = 1 if fast_f32 else len(scales)
                     m.update(dispatches=d_disp)
-                f_pad = bitmap.shape[1]
+                f_pad = f_pad_p if vertical else bitmap.shape[1]
                 idx, cnt = idx[:n2], cnt[:n2]
                 cur = np.stack([idx // f_pad, idx % f_pad], axis=1).astype(
                     np.int32
                 )  # row-major upper triangle => already lex-sorted
                 levels.append((cur, cnt.astype(np.int64)))
+                if vertical:
+                    # The vertical pair IS a matmul phase (per-plane
+                    # Grams over the unpacked lane chunks): d_eff is
+                    # the plane count (1 under fast_f32).
+                    m.update(engine="vertical")
+                m.update(macs=d_eff * t_pad * f_pad * f_pad)
                 m.update(
                     candidates=f * (f - 1) // 2,
                     frequent=n2,
                     cand3=tri,
-                    macs=d_eff * t_pad * f_pad * f_pad,
                     reduce=rinfo["reduce"],
                     psum_bytes=rinfo["psum_bytes"],
                     gather_bytes=rinfo["gather_bytes"],
@@ -2114,6 +2339,7 @@ class FastApriori:
             tail_rows = 0 if ctx.platform == "cpu" else 65536
         tail_ok = (
             tail_rows > 0
+            and not vertical  # the fold is a bitmap-engine program
             and ctx.cand_shards == 1
             and data.shard is None
         )
@@ -2144,6 +2370,8 @@ class FastApriori:
                         if defer
                         else None
                     ),
+                    count_reduce=count_reduce,
+                    sparse_thr=sparse_thr,
                 )
                 if dispatched:
                     fold_attempts -= 1
@@ -2174,6 +2402,7 @@ class FastApriori:
                     defer_counts=defer,
                     count_reduce=count_reduce,
                     sparse_thr=sparse_thr,
+                    vertical=vertical,
                 )
                 m.update(frequent=nxt.shape[0], **lvl_stats)
             if isinstance(nxt_counts, list):  # deferred (pending runs)
@@ -2311,6 +2540,8 @@ class FastApriori:
         self, data, bitmap, w_digits, scales, cur: np.ndarray,
         n_chunks: int, heavy: Optional[tuple],
         pending_state: Optional[tuple] = None,
+        count_reduce: str = "dense",
+        sparse_thr=None,
     ) -> Tuple[list, bool, bool]:
         """Shallow-tail fold: mine every remaining level in ONE dispatch
         seeded from the current level matrix (ops/fused.py
@@ -2326,7 +2557,15 @@ class FastApriori:
         (mesh.tail_miner_with_resolve — the ROADMAP counts_resolve fold),
         so a tail-finished mine pays ZERO extra resolve dispatches; the
         end-of-mine ``counts_resolve`` event then reports
-        ``resolve_dispatches=0``, still as its own bench field."""
+        ``resolve_dispatches=0``, still as its own bench field.
+
+        ``count_reduce="sparse"`` (with ``sparse_thr``) folds the
+        threshold-sparse exchange into the tail's per-iteration
+        [p_cap, F] count reduction (ops/fused.py — the PR-6 residue:
+        this was the last counting path still dense); a union overflow
+        marks the level invalid like a p_cap overflow and the host
+        resumes per-level, recording the census so repeat runs size
+        the budget right."""
         from fastapriori_tpu.ops import fused
 
         cfg = self.config
@@ -2379,6 +2618,20 @@ class FastApriori:
         seed = np.zeros((m_cap, k0), np.int32)
         seed[:n0] = cur
         hb, hw = heavy if heavy is not None else (None, None)
+        # Count-reduction engine for the fold's per-iteration [p_cap, F]
+        # psum (PR-6 residue): sparse only above the candidate-space
+        # floor, budget grown by any previously recorded overflow.
+        sp_cap = None
+        sp_key = ("sparse_tail", t_pad, f_pad, int(data.min_count))
+        if count_reduce == "sparse" and sparse_thr is not None:
+            if p_cap * f_pad >= cfg.count_sparse_min:
+                sp_cap = self._sparse_cap(p_cap * f_pad, hint_key=sp_key)
+            else:
+                ledger.record(
+                    "count_reduce_fallback", once_key="tiny_tail",
+                    reason="tiny_candidate_set", site="tail",
+                    p_cap=p_cap,
+                )
         # Pending-count resolve folded into the SAME dispatch (the
         # ROADMAP counts_resolve follow-up): flatten the deferred levels
         # exactly like a mid-mine drain; the fold's program gathers them
@@ -2399,6 +2652,8 @@ class FastApriori:
                 bitmap, w_digits, ctx.replicate(seed), jnp.int32(n0),
                 jnp.int32(data.min_count),
             ]
+            if sp_cap is not None:
+                args += [jnp.asarray(sparse_thr, dtype=jnp.int32)]
             if heavy is not None:
                 args += [hb, hw]
             if resolve_flat:
@@ -2417,6 +2672,7 @@ class FastApriori:
                     tuple(c.shape for c in counts_t)
                     + tuple(p.size for p in padded),
                     u24,
+                    sparse_cap=sp_cap,
                 )
                 packed_dev, gathered = fn(tuple(args), counts_t, pos_t)
                 handle = PendingCounts(
@@ -2441,32 +2697,56 @@ class FastApriori:
             else:
                 fn = ctx.tail_miner(
                     scales, k0, m_cap, p_cap, cfg.tail_fuse_l_max,
-                    tail_chunks, heavy is not None,
+                    tail_chunks, heavy is not None, sparse_cap=sp_cap,
                 )
                 # lint: fetch-site -- the tail fold's single audited fetch, retry-wrapped; lint: waive G013 -- same logical site as the resolve-fold branch above: exactly one of the two exclusive dispatch shapes runs per mine
                 packed_out = retry.fetch(
                     lambda: np.asarray(fn(*args)), "tail"
                 )
-            rows, cols, counts, n_lvl, incomplete = (
+            rows, cols, counts, n_lvl, incomplete, snu = (
                 fused.unpack_tail_result(
                     packed_out, m_cap, cfg.tail_fuse_l_max
                 )
             )
+            if sp_cap is not None and snu > sp_cap:
+                # Union compaction overflowed at some tail level: that
+                # level carried the bad sentinel (the host resumes
+                # per-level from the last complete one — exact either
+                # way); memoize the true census so repeat runs size
+                # the budget right (the pair-cap-hint pattern).
+                ledger.record(
+                    "count_sparse_overflow", site="tail",
+                    n_union=int(snu), cap=sp_cap,
+                )
+                ctx.record_pair_cap(sp_key, _next_pow2(int(snu)))
             # MACs: per stored level, candidate gen (two [m_cap, m_cap]
             # f32 matmuls) + membership/counting over the compacted
             # [p_cap] prefix rows.
             n_iters = max(int(np.count_nonzero(n_lvl)), 1)
             d_eff = len(scales)
+            if sp_cap is not None:
+                from fastapriori_tpu.ops.count import sparse_psum_bytes
+
+                g_b, p_b = sparse_psum_bytes(
+                    p_cap * f_pad, sp_cap, ctx.txn_shards
+                )
+                psum_b = n_iters * p_b
+                gather_b = n_iters * g_b
+            else:
+                psum_b = n_iters * 4 * p_cap * f_pad
+                gather_b = 0
             met.update(
                 levels=int(np.count_nonzero(n_lvl)),
                 dispatches=1,
                 incomplete=bool(incomplete),
+                reduce="sparse" if sp_cap is not None else "dense",
                 macs=n_iters
                 * (
                     2 * m_cap * m_cap * f_pad
                     + (1 + d_eff) * t_pad * p_cap * f_pad
                 ),
-                psum_bytes=n_iters * 4 * p_cap * f_pad,
+                psum_bytes=psum_b,
+                gather_bytes=gather_b,
                 upload_bytes=seed.nbytes * ctx.n_devices,
             )
         lvls = fused.decode_level_matrices(
@@ -2491,6 +2771,7 @@ class FastApriori:
         defer_counts: bool = True,
         count_reduce: str = "dense",
         sparse_thr=None,
+        vertical: bool = False,
     ) -> Tuple[np.ndarray, object, dict]:
         """C8 for one level, transfer-minimal: greedy chunks of at most
         P_CAP prefixes / C_CAP candidates go through the compiled-once
@@ -2517,11 +2798,22 @@ class FastApriori:
         overlaps device counting.  Results are fetched only after every
         block is dispatched.  Returns the next level's lex-sorted
         matrix, its counts, and a stats dict (candidate count, kernel
-        dispatches, MAC count, psum bytes) for the per-level metrics."""
+        dispatches, MAC count, psum bytes) for the per-level metrics.
+
+        ``vertical``: ``bitmap`` is the tid-lane arena and ``w_digits``
+        the weight bit-planes (ops/vertical.py) — the SAME block/chunk
+        machinery feeds the AND+popcount kernel instead of the matmuls
+        (identical padding discipline: the zero column keeps padded
+        candidate counts at 0; the kernel remaps padded PREFIX entries
+        to its all-ones AND-identity row)."""
         cfg = self.config
         s = level.shape[1]
-        f_pad = bitmap.shape[1]
-        t_pad = bitmap.shape[0]
+        if vertical:
+            f_pad = bitmap.shape[0] - 1  # arena carries the identity row
+            t_pad = bitmap.shape[1] * 32
+        else:
+            f_pad = bitmap.shape[1]
+            t_pad = bitmap.shape[0]
         zcol = f_pad - 1  # guaranteed all-zero column (ops/bitmap.py)
         # Per-cand-shard capacities: the prefix rows and the candidate
         # gather are sharded over the mesh's cand axis (mesh.level_gather),
@@ -2547,7 +2839,10 @@ class FastApriori:
             "gather_bytes": 0,
             "reduce": "dense",
         }
-        sp_hint_key = ("sparse_level", t_pad, f_pad, min_count)
+        sp_hint_key = (
+            ("sparse_vlevel" if vertical else "sparse_level"),
+            t_pad, f_pad, min_count,
+        )
         inflight = []  # (placed, device out, counts buffer, sparse cap)
         blocks = []  # (x_idx, ys, counts buffer)
         for x_idx, ys in cand_blocks:
@@ -2680,30 +2975,48 @@ class FastApriori:
                         reason="tiny_candidate_set",
                         site="level", k=s + 1, c_cap=c_cap,
                     )
-            bits, counts_out = ctx.level_gather_batch(
-                bitmap,
-                w_digits,
-                scales,
-                np.stack(pcs),
-                s,
-                min_count,
-                np.stack(cis),
-                n_chunks,
-                heavy_b=hb,
-                heavy_w=hw,
-                fast_f32=fast_f32,
-                sparse_cap=sp_cap,
-                sparse_thr=sparse_thr,
-            )
+            if vertical:
+                bits, counts_out = ctx.vertical_level_gather_batch(
+                    bitmap,
+                    w_digits,
+                    scales,
+                    np.stack(pcs),
+                    min_count,
+                    np.stack(cis),
+                    self._vertical_chunk(c_cap),
+                    sparse_cap=sp_cap,
+                    sparse_thr=sparse_thr,
+                )
+            else:
+                bits, counts_out = ctx.level_gather_batch(
+                    bitmap,
+                    w_digits,
+                    scales,
+                    np.stack(pcs),
+                    s,
+                    min_count,
+                    np.stack(cis),
+                    n_chunks,
+                    heavy_b=hb,
+                    heavy_w=hw,
+                    fast_f32=fast_f32,
+                    sparse_cap=sp_cap,
+                    sparse_thr=sparse_thr,
+                )
             # Audited fetch issued NON-BLOCKING at dispatch time
             # (reliability/retry.py fetch_async): the ~C/8-byte survivor
             # mask crosses the link while the host preps the next block
             # (and, for the last block, while it runs the collect loop
             # below) — a congested link stalls the copy, not the host.
-            # Distinct labels per reduction engine: the sparse payload
-            # carries the union censuses too, and its failpoint must be
-            # armable independently (G013).
-            if sp_cap is not None:
+            # Distinct labels per reduction engine AND per mining
+            # engine: the sparse payload carries the union censuses
+            # too, and each site's failpoint must be armable
+            # independently (G013).
+            if vertical and sp_cap is not None:
+                bits_fu = retry.fetch_async(bits, "vlevel_bits_sparse")
+            elif vertical:
+                bits_fu = retry.fetch_async(bits, "vlevel_bits")
+            elif sp_cap is not None:
                 bits_fu = retry.fetch_async(bits, "level_bits_sparse")
             else:
                 bits_fu = retry.fetch_async(bits, "level_bits")
@@ -2716,7 +3029,21 @@ class FastApriori:
             # reduction moves either the dense 4·C psum payload or the
             # sparse mask-gather + compact-psum payloads per chunk.
             stats["dispatches"] += 1
-            stats["macs"] += nb_pad * (1 + d_eff) * t_pad * p_cap * f_pad
+            if vertical:
+                from fastapriori_tpu.ops.vertical import (
+                    vertical_level_word_ops,
+                )
+
+                stats["engine"] = "vertical"
+                stats["vops"] = stats.get(
+                    "vops", 0
+                ) + vertical_level_word_ops(
+                    nb_pad, p_cap, k_pad, c_cap, len(scales), t_pad // 32
+                )
+            else:
+                stats["macs"] += (
+                    nb_pad * (1 + d_eff) * t_pad * p_cap * f_pad
+                )
             if sp_cap is not None:
                 from fastapriori_tpu.ops.count import sparse_psum_bytes
 
@@ -2768,7 +3095,8 @@ class FastApriori:
             fetched.append((placed_all, mask, counts_out))
         if max_nu:
             ledger.record(
-                "count_sparse_overflow", site="level", k=s + 1,
+                "count_sparse_overflow",
+                site="vlevel" if vertical else "level", k=s + 1,
                 n_union=max_nu,
             )
             ctx.record_pair_cap(sp_hint_key, _next_pow2(max_nu))
@@ -2776,13 +3104,15 @@ class FastApriori:
                 ctx, bitmap, w_digits, scales, level,
                 gen_candidates_stream(level), min_count, n_chunks,
                 fast_f32, heavy, defer_counts=defer_counts,
-                count_reduce="dense",
+                count_reduce="dense", vertical=vertical,
             )
             # The wasted sparse dispatches still ran (and their bytes
             # still crossed the mesh) — account them on top of the
             # dense recount's own figures.
             stats_d["dispatches"] += stats["dispatches"]
             stats_d["macs"] += stats["macs"]
+            if stats.get("vops"):
+                stats_d["vops"] = stats_d.get("vops", 0) + stats["vops"]
             stats_d["psum_bytes"] += stats["psum_bytes"]
             stats_d["gather_bytes"] = (
                 stats_d.get("gather_bytes", 0) + stats["gather_bytes"]

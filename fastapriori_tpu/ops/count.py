@@ -775,6 +775,27 @@ def keep_bits(counts: jnp.ndarray, min_count: jnp.ndarray) -> jnp.ndarray:
     return pack_bits_msb(counts >= min_count)
 
 
+def keep_bits_with_census(
+    counts: jnp.ndarray,  # [NB, C] int32
+    min_count: jnp.ndarray,
+    nus: jnp.ndarray,  # [NB] int32 union censuses
+) -> jnp.ndarray:
+    """:func:`keep_bits` with the per-block union censuses appended as
+    4 little-endian trailing bytes per block — the ONE definition of
+    the sparse-engine bits payload (both mining engines' batch kernels
+    emit it and the collect loop in models/apriori.py decodes it; a
+    second fetch would cost a full link round trip just to carry NB
+    ints, and a second inline copy of the layout could silently
+    desynchronize the decode)."""
+    nu_bytes = jnp.stack(
+        [((nus >> s) & 0xFF).astype(jnp.uint8) for s in (0, 8, 16, 24)],
+        axis=1,
+    )
+    return jnp.concatenate(
+        [keep_bits(counts, min_count), nu_bytes], axis=1
+    )
+
+
 def local_item_supports(
     bitmap: jnp.ndarray,  # [T_local, F] int8
     w_digits: jnp.ndarray,  # [D, T_local] int8

@@ -457,6 +457,7 @@ def _tail_mine_local(
     min_count,  # () int32
     heavy_b,  # [Th, F] int8 or None
     heavy_w,  # [Th] int32 or None
+    sparse_thr=None,  # [S] int32 per-shard prune thresholds (sparse only)
     *,
     scales: Tuple[int, ...],
     k0: int,  # seed level depth (static: the compiled program is per-depth)
@@ -467,6 +468,7 @@ def _tail_mine_local(
     axis_name: Optional[str],
     slot_caps: Tuple[int, ...],  # per-tail-level row caps (static)
     cand_row_chunks: int = 1,
+    sparse_cap: Optional[int] = None,  # [p_cap, F] union slot budget
 ):
     """Shallow-tail fold (VERDICT r3 task 4): once the level engine's
     survivor count drops under the fold threshold, the REMAINING level
@@ -500,11 +502,21 @@ def _tail_mine_local(
       layout would be a 6 MB fetch over a tunnel down-link measured as
       low as 6.8 MB/s this round, vs ~1.6 MB compacted;
     - ``cand_row_chunks`` chunks the [M, M] candidate-gen intermediate
-      (see _gen_candidates_matmul), which is what admits 64K-row seeds.
+      (see _gen_candidates_matmul), which is what admits 64K-row seeds;
+    - ``sparse_cap`` (with ``sparse_thr``) runs each iteration's
+      [p_cap, F] count reduction as the threshold-sparse exchange
+      (ops/count.py local_sparse_psum, validity = the iteration's
+      candidate mask restricted to the compacted prefix rows) instead
+      of the dense psum — the PR-6 residue: the fold was the last
+      counting path still dense-psumming its per-iteration counts.  A
+      union-compaction overflow marks the level invalid exactly like a
+      p_cap overflow (the host resumes per-level and the max census
+      rides the output so repeat runs size the budget right).
 
     Returns a 1-D int32 array: per slot i the compacted
     ``rows[:cap_i] | cols[:cap_i] | counts[:cap_i]`` runs, then
-    ``n_per_level[l_max] | incomplete`` (unpack_tail_result)."""
+    ``n_per_level[l_max] | incomplete | max_union_census``
+    (unpack_tail_result; the census slot reads 0 on dense builds)."""
     from fastapriori_tpu.ops.count import (
         _weighted_matmul,
         heavy_level_correction,
@@ -542,7 +554,7 @@ def _tail_mine_local(
     slot_caps_arr = jnp.asarray(slot_caps, dtype=jnp.int32)
 
     def body(state):
-        s, m, k, o_rows, o_cols, o_counts, o_n, stop = state
+        s, m, k, o_rows, o_cols, o_counts, o_n, snu, stop = state
         valid_row = (jnp.arange(m_cap, dtype=jnp.int32) < m)[:, None]
         cand = _gen_candidates_matmul(
             s, k, col_ids, valid_row, row_chunks=cand_row_chunks
@@ -581,7 +593,21 @@ def _tail_mine_local(
             counts_p = counts_p + heavy_level_correction(
                 s_p, (k - 1).astype(jnp.int32), heavy_b, heavy_w, axis_name
             )
-        counts_p = psum(counts_p)
+        if sparse_cap is not None and axis_name is not None:
+            # Threshold-sparse exchange over the compacted [p_cap, F]
+            # counts (the PR-6 residue fold): validity restricted to
+            # the iteration's candidate extensions so dead (prefix,
+            # item) cells never enter the union.
+            from fastapriori_tpu.ops.count import local_sparse_psum
+
+            thr_s = sparse_thr[lax.axis_index(axis_name)]
+            counts_p, lvl_nu = local_sparse_psum(
+                counts_p, thr_s, sparse_cap, axis_name,
+                valid=cand[pr] & valid_p,
+            )
+        else:
+            counts_p = psum(counts_p)
+            lvl_nu = jnp.int32(0)
 
         surv = cand[pr] & (counts_p >= min_count) & valid_p
         n = jnp.sum(surv, dtype=jnp.int32)
@@ -594,16 +620,22 @@ def _tail_mine_local(
         )
         level_counts = counts_p[rows_p, cols] * valid[:, 0].astype(jnp.int32)
 
-        # Overflow: compaction or this slot's row cap exceeded -> this
-        # level's output is unusable; store a sentinel survivor count
-        # above m_cap so the host's decode stops before it.
+        # Overflow: compaction, this slot's row cap, or the sparse
+        # union budget exceeded -> this level's output is unusable;
+        # store a sentinel survivor count above m_cap so the host's
+        # decode stops before it.
         idx = k - k0 - 1  # tail level k0+1+i at slot i
         bad = (n_pref > p_cap) | (n > slot_caps_arr[idx])
+        if sparse_cap is not None:
+            bad = bad | (lvl_nu > jnp.int32(sparse_cap))
         o_rows = o_rows.at[idx].set(rows)
         o_cols = o_cols.at[idx].set(cols)
         o_counts = o_counts.at[idx].set(level_counts)
         o_n = o_n.at[idx].set(jnp.where(bad, jnp.int32(m_cap + 1), n))
-        return (s_next, n, k + 1, o_rows, o_cols, o_counts, o_n, stop | bad)
+        return (
+            s_next, n, k + 1, o_rows, o_cols, o_counts, o_n,
+            jnp.maximum(snu, lvl_nu), stop | bad,
+        )
 
     state = (
         s0,
@@ -613,11 +645,12 @@ def _tail_mine_local(
         out_cols,
         out_counts,
         out_n,
+        jnp.int32(0),
         jnp.bool_(False),
     )
-    s, m, k, out_rows, out_cols, out_counts, out_n, stop = lax.while_loop(
-        cond, body, state
-    )
+    (
+        s, m, k, out_rows, out_cols, out_counts, out_n, snu, stop
+    ) = lax.while_loop(cond, body, state)
     # incomplete: a bad level, or the l_max bound stopped a live loop —
     # either way the host resumes the per-level engine from the last
     # complete level.
@@ -627,6 +660,7 @@ def _tail_mine_local(
         parts += [out_rows[i, :c], out_cols[i, :c], out_counts[i, :c]]
     parts.append(out_n)
     parts.append(incomplete.astype(jnp.int32)[None])
+    parts.append(snu[None])
     return jnp.concatenate(parts)
 
 
@@ -661,11 +695,18 @@ def make_tail_miner(
     l_max: int,
     n_chunks: int,
     has_heavy: bool,
+    sparse_cap: Optional[int] = None,
 ):
     """Build the jitted shallow-tail program (see _tail_mine_local).
     Sharded over the txn mesh axis like the level kernels; the seed
-    table and outputs are replicated."""
+    table and outputs are replicated.  ``sparse_cap`` switches the
+    per-iteration [p_cap, F] count reduction to the threshold-sparse
+    exchange; the program then takes the replicated [S] per-shard
+    prune-threshold array after ``min_count`` (before the heavy
+    arrays)."""
     assert m_cap > l_max + 1, (m_cap, l_max)
+    if mesh is None:
+        sparse_cap = None  # the exchange is a mesh collective
     kernel = functools.partial(
         _tail_mine_local,
         scales=tuple(scales),
@@ -677,16 +718,23 @@ def make_tail_miner(
         axis_name=AXIS if mesh is not None else None,
         slot_caps=tail_slot_caps(m_cap, l_max),
         cand_row_chunks=tail_cand_row_chunks(m_cap),
+        sparse_cap=sparse_cap,
     )
 
-    def wrapped(bitmap, w_digits, seed_cols, n0, min_count, *hv):
-        hb, hw = hv if hv else (None, None)
-        return kernel(bitmap, w_digits, seed_cols, n0, min_count, hb, hw)
+    def wrapped(bitmap, w_digits, seed_cols, n0, min_count, *rest):
+        rest = list(rest)
+        thr = rest.pop(0) if sparse_cap is not None else None
+        hb, hw = rest if rest else (None, None)
+        return kernel(
+            bitmap, w_digits, seed_cols, n0, min_count, hb, hw, thr
+        )
 
     if mesh is None:
         return jax.jit(wrapped)
-    in_specs = (P(AXIS, None), P(None, AXIS), P(None, None), P(), P()) + (
-        (P(None, None), P(None)) if has_heavy else ()
+    in_specs = (
+        (P(AXIS, None), P(None, AXIS), P(None, None), P(), P())
+        + ((P(None),) if sparse_cap is not None else ())
+        + ((P(None, None), P(None)) if has_heavy else ())
     )
     return jax.jit(
         compat.shard_map(
@@ -700,9 +748,13 @@ def make_tail_miner(
 
 def unpack_tail_result(packed: np.ndarray, m_cap: int, l_max: int):
     """Split the tail miner's compact 1-D result (see _tail_mine_local)
-    into (rows_list, cols_list, counts_list, n_per_level, incomplete) —
-    the lists are per-slot 1-D arrays sized by :func:`tail_slot_caps`,
-    consumable by decode_level_matrices with ``max_rows=slot_caps``."""
+    into (rows_list, cols_list, counts_list, n_per_level, incomplete,
+    max_union_census) — the lists are per-slot 1-D arrays sized by
+    :func:`tail_slot_caps`, consumable by decode_level_matrices with
+    ``max_rows=slot_caps``.  The census is 0 for dense-reduction
+    builds; under the sparse reduction a census above the build's cap
+    names the overflowing union size (the host records it so repeat
+    runs size the compaction right)."""
     caps = tail_slot_caps(m_cap, l_max)
     rows, cols, counts = [], [], []
     off = 0
@@ -712,7 +764,12 @@ def unpack_tail_result(packed: np.ndarray, m_cap: int, l_max: int):
         counts.append(packed[off : off + c]); off += c
     n_lvl = packed[off : off + l_max]
     incomplete = bool(packed[off + l_max])
-    return rows, cols, counts, n_lvl, incomplete
+    snu = (
+        int(packed[off + l_max + 1])
+        if packed.shape[0] > off + l_max + 1
+        else 0
+    )
+    return rows, cols, counts, n_lvl, incomplete, snu
 
 
 def unpack_fused_result(

@@ -1,0 +1,476 @@
+"""Vertical (Eclat-style) mining kernels: per-item tid-lists as packed
+uint32 lanes, level-k support by lane-wise AND + popcount (ROADMAP item 3;
+*RDD-Eclat*, arxiv 1912.06415, with the packed-lane set-intersection
+layout of *A New Data Layout For Set Intersection on GPUs*, arxiv
+1102.1003, adapted to uint32 lanes).
+
+The horizontal bitmap-matmul engine (ops/count.py) counts a level as
+``(1+D) · T · P · F`` MXU MACs — every transaction column scanned for
+every possible extension, even when an itemset touches a few hundred
+tids (BENCH r3-r5: 0.2-0.8% MFU at k=2 on sparse long-tail corpora).
+The vertical engine inverts the layout: item ``f`` owns the packed
+bitset of the transactions containing it (``uint32[NL]``, 32 tids per
+lane, ``NL = T'/32``), a candidate's support is the popcount of the AND
+of its members' lanes, and only the ACTUAL candidates are counted —
+``(k·P + C·(1+B)) · NL`` word ops per level, a ``~32·F/k`` op reduction
+against the matmul form on wide-item corpora.  Levels k >= 3 run that
+AND+popcount form; k=2 — where EVERY pair is a candidate and
+per-candidate gathers degenerate — runs as per-plane Gram matmuls over
+lane chunks unpacked on the fly (RDD-Eclat likewise computes F2 from
+the horizontal layout before verticalizing).
+
+**Weighted counts via weight bit-planes.**  Multiplicity weights enter
+as base-2 bit-planes packed along the tid axis (``w_t = Σ_b 2^b·bit_b``,
+``planes uint32[B, NL]``), so a weighted support is
+``Σ_b 2^b · popcount(inter & plane_b)`` — exact integer arithmetic for
+any weight (no int8 saturation bound: unlike the matmul engines the
+vertical path needs neither the base-128 digit split nor the heavy-row
+correction, and stays exact at ANY lattice depth — there is no
+``wide_member`` analog).  Deduplicated corpora (all weights 1) have
+exactly one all-ones plane and the count is a pure popcount.
+
+**Layout (the arxiv 1102.1003 adaptation).**  The device-resident arena
+is dense ``uint32[F_pad+1, NL]`` (row ``F_pad`` is the all-ones AND
+identity for padded prefix positions; the guaranteed-zero column
+``F_pad-1`` of the horizontal bitmap keeps its role for padded
+CANDIDATE slots).  The tid-space is a sequence of dense 32-bit
+segments; an item's tids cluster into few of them on sparse corpora, so
+the HOST→DEVICE form is index-compressed: per item, the (segment index,
+segment word) pairs of its non-empty lanes, pow2-bucketed by active-
+segment count so a handful of static shapes serve every item
+(:func:`compress_arena`); one device dispatch scatters the buckets into
+the dense arena (parallel/mesh.py ``upload_tid_arena``).  Sharding is
+over the LANE axis — lane block ``s`` holds tids ``[s·T'/S, (s+1)·T'/S)``,
+the same contiguous transaction split as the horizontal engine's row
+sharding, so the weighted-pigeonhole shard thresholds of the sparse
+count reduction (models/apriori.py ``_sparse_thresholds``) apply
+unchanged and :func:`~fastapriori_tpu.ops.count.local_sparse_psum` is
+reused verbatim for the cross-shard reduction.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fastapriori_tpu.ops.bitmap import next_pow2, pad_axis
+from fastapriori_tpu.ops.count import (
+    TRI_F_CAP,
+    local_sparse_psum,
+    pair_threshold_pack,
+)
+
+ONES_WORD = np.uint32(0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# host-side arena construction
+
+
+def weight_bit_planes(
+    weights: np.ndarray, t_pad: int
+) -> Tuple[np.ndarray, List[int]]:
+    """Base-2 bit-planes of the multiplicity weights, packed along the
+    tid axis into uint32 lanes (LSB-first within each lane — the same
+    bit order :func:`build_tid_arena_csr` uses for item lanes, which is
+    the only thing that matters: AND/popcount never unpacks).
+
+    Returns ``(planes uint32[B, t_pad//32], scales)`` with
+    ``weights == Σ_b scales[b] · bit_b`` and ``scales[b] = 2**b``; B is
+    data-dependent but static per compilation (1 for fully-deduplicated
+    or weightless corpora, where plane 0 is the row-validity mask)."""
+    assert t_pad % 32 == 0, t_pad
+    w = np.zeros(t_pad, dtype=np.int64)
+    w[: len(weights)] = weights
+    b_planes = max(int(w.max()).bit_length(), 1)
+    shifts = np.arange(32, dtype=np.uint32)
+    planes = np.zeros((b_planes, t_pad // 32), dtype=np.uint32)
+    for b in range(b_planes):
+        bits = ((w >> b) & 1).astype(np.uint32).reshape(-1, 32)
+        planes[b] = (bits << shifts[None, :]).sum(axis=1, dtype=np.uint64)
+    return planes, [1 << b for b in range(b_planes)]
+
+
+def build_tid_arena_csr(
+    indices: np.ndarray,
+    offsets: np.ndarray,
+    num_items: int,
+    txn_multiple: int = 32,
+    item_multiple: int = 128,
+) -> Tuple[np.ndarray, int, int]:
+    """Build the dense tid-lane arena from the basket CSR: returns
+    ``(arena uint32[f_pad+1, NL], f_pad, t_pad)`` with
+    ``t_pad = pad_axis(T, lcm(txn_multiple, 32))`` and row ``f_pad`` the
+    all-ones AND identity.  One sorted segment-reduce builds every
+    item's lanes (``np.bitwise_or.reduceat`` over the (item, lane) runs
+    — C speed, no per-basket Python loop)."""
+    import math
+
+    t = len(offsets) - 1
+    mult = txn_multiple * 32 // math.gcd(txn_multiple, 32)
+    t_pad = pad_axis(t, mult)
+    f_pad = pad_axis(num_items + 1, item_multiple)
+    nl = t_pad // 32
+    arena = np.zeros((f_pad + 1, nl), dtype=np.uint32)
+    if t > 0 and len(indices) > 0:
+        rows = np.repeat(
+            np.arange(t, dtype=np.int64), np.diff(offsets).astype(np.int64)
+        )
+        word = rows // 32
+        bit = (np.uint32(1) << (rows % 32).astype(np.uint32)).astype(
+            np.uint32
+        )
+        key = indices.astype(np.int64) * nl + word
+        order = np.argsort(key, kind="stable")
+        skey = key[order]
+        uniq, start = np.unique(skey, return_index=True)
+        words = np.bitwise_or.reduceat(bit[order], start)
+        arena.reshape(-1)[uniq] = words
+    arena[f_pad, :] = ONES_WORD
+    return arena, f_pad, t_pad
+
+
+def compress_arena(
+    arena: np.ndarray, f_pad: int, build: bool = True
+) -> Tuple[list, int, dict]:
+    """Index-compressed, pow2-bucketed form of the arena's item rows
+    (the arxiv 1102.1003 host→device layout): items are grouped by the
+    pow2 bucket of their NON-EMPTY lane count, each bucket carrying
+    ``(item_ids int32[nb'], seg_idx int32[nb', S_b], words
+    uint32[nb', S_b])`` with ``nb'`` itself pow2-padded (padding rows
+    target the AND-identity row ``f_pad`` at segment 0 with word 0 —
+    absorbed by the scatter).  Returns ``(buckets, payload_bytes,
+    stats)``; ``payload_bytes`` is the host→device transfer the
+    compressed upload pays, versus the dense arena's ``4·F·NL``
+    (``stats['occupancy']`` = active lanes / total — the density signal
+    the engine auto-choice reads).  ``build=False`` returns the payload
+    estimate and stats WITHOUT materializing the buckets (the census is
+    vectorized numpy; the bucket fill is a per-item host loop) — the
+    caller decides dense-vs-compressed first and only pays the fill
+    when the compressed upload wins."""
+    nl = arena.shape[1]
+    if build:
+        items, segs = np.nonzero(arena[:f_pad])
+        counts = np.bincount(items, minlength=f_pad)
+        n_active = int(items.size)
+    else:
+        # Census-only pass: one vectorized reduction over the arena —
+        # no (item, seg) index materialization.
+        counts = np.count_nonzero(arena[:f_pad], axis=1)
+        n_active = int(counts.sum())
+    stats = {
+        "active_lanes": n_active,
+        "occupancy": round(float(n_active) / max(f_pad * nl, 1), 6),
+        "max_item_lanes": int(counts.max()) if counts.size else 0,
+    }
+    buckets = []
+    active = np.flatnonzero(counts)
+    if active.size == 0:
+        return buckets, 0, stats
+    pows = np.array([next_pow2(int(c)) for c in counts[active]])
+    sizes = sorted(set(pows.tolist()))
+    # Per bucket: nb' int32 ids + nb'·S_b (int32 seg_idx + uint32 word).
+    payload = sum(
+        next_pow2(int((pows == s_b).sum())) * (4 + 8 * s_b)
+        for s_b in sizes
+    )
+    if not build:
+        return buckets, payload, stats
+    run_start = np.concatenate([[0], np.cumsum(counts[active])[:-1]])
+    for s_b in sizes:
+        sel = np.flatnonzero(pows == s_b)
+        nb = next_pow2(sel.size)
+        ids = np.full(nb, f_pad, dtype=np.int32)
+        seg_idx = np.zeros((nb, s_b), dtype=np.int32)
+        words = np.zeros((nb, s_b), dtype=np.uint32)
+        for j, ai in enumerate(sel):
+            item = int(active[ai])
+            lo = run_start[ai]
+            n = counts[item]
+            ids[j] = item
+            seg_idx[j, :n] = segs[lo : lo + n]
+            words[j, :n] = arena[item, segs[lo : lo + n]]
+        buckets.append((ids, seg_idx, words))
+    return buckets, payload, stats
+
+
+def assemble_arena(buckets, f_pad: int, nl: int) -> jnp.ndarray:
+    """Device-side inverse of :func:`compress_arena`: scatter the
+    compressed buckets into the dense ``uint32[f_pad+1, NL]`` arena.
+    Each real (item, segment) pair appears exactly once, so a max-
+    scatter over the zero-initialized arena lands every word exactly
+    (bucket padding rows target the identity row with word 0 — a no-op
+    under max, and the identity row is overwritten to all-ones last)."""
+    arena = jnp.zeros((f_pad + 1, nl), dtype=jnp.uint32)
+    for ids, seg_idx, words in buckets:
+        arena = arena.at[ids[:, None], seg_idx].max(words)
+    return arena.at[f_pad].set(jnp.uint32(0xFFFFFFFF))
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+
+
+def _popcount_weighted(
+    inter: jnp.ndarray,  # [C, NL] uint32 intersection lanes
+    w_planes: jnp.ndarray,  # [B, NL] uint32 weight bit-planes
+    scales: Sequence[int],  # python ints, len B (static)
+) -> jnp.ndarray:
+    """``counts[c] = Σ_t w_t · [t ∈ inter_c]`` via per-plane popcounts
+    (int32; exact for any weight — popcounts are bounded by 32·NL and
+    the plane scales reassemble the integer weight exactly)."""
+    total = None
+    for b, scale in enumerate(scales):
+        pc = lax.population_count(inter & w_planes[b][None, :])
+        part = jnp.sum(pc.astype(jnp.int32), axis=1)
+        part = part if scale == 1 else part * jnp.int32(scale)
+        total = part if total is None else total + part
+    return total
+
+
+def _prefix_and(
+    arena: jnp.ndarray,  # [f_pad+1, NL] uint32
+    prefix_cols: jnp.ndarray,  # [P, K] int (padding -> zero column)
+) -> jnp.ndarray:
+    """AND of each prefix row's member lanes ([P, NL] uint32).  The
+    dispatch layer pads prefix positions (and whole padded rows) with
+    the horizontal engine's guaranteed-zero column ``f_pad - 1``; for
+    the AND that must be the IDENTITY, so those entries remap to the
+    all-ones row ``f_pad`` (the zero column is never a real item rank:
+    ``f_pad >= num_items + 1``).  Padded prefix ROWS therefore AND to
+    all-ones — harmless, because their candidate slots point at the
+    zero column as the EXTENSION and gather a 0 count."""
+    f_pad = arena.shape[0] - 1
+    cols = prefix_cols.astype(jnp.int32)
+    cols = jnp.where(cols == f_pad - 1, f_pad, cols)
+    acc = jnp.take(arena, cols[:, 0], axis=0)
+    for i in range(1, cols.shape[1]):
+        acc = acc & jnp.take(arena, cols[:, i], axis=0)
+    return acc
+
+
+def _chunked_candidate_counts(
+    pref: jnp.ndarray,  # [P, NL] uint32 prefix lanes (or the arena itself)
+    arena: jnp.ndarray,  # [f_pad+1, NL] uint32
+    w_planes: jnp.ndarray,
+    scales: Sequence[int],
+    cand_idx: jnp.ndarray,  # [C] int32 flat row·f_pad + y
+    cand_chunk: int,
+) -> jnp.ndarray:
+    """Per-candidate intersection counts, scanned in ``cand_chunk``
+    blocks so the [chunk, NL] gathered intermediates stay bounded in
+    HBM regardless of the candidate count.  Returns int32[C] local
+    (per-shard) counts."""
+    f_pad = arena.shape[0] - 1
+    c = cand_idx.shape[0]
+    assert c % cand_chunk == 0, (c, cand_chunk)
+
+    def step(carry, ix):
+        row = ix // f_pad
+        y = ix % f_pad
+        inter = jnp.take(pref, row, axis=0) & jnp.take(arena, y, axis=0)
+        return carry, _popcount_weighted(inter, w_planes, scales)
+
+    _, parts = lax.scan(
+        step, jnp.int32(0), cand_idx.reshape(c // cand_chunk, cand_chunk)
+    )
+    return parts.reshape(-1)
+
+
+def _unpack_lanes(lanes: jnp.ndarray) -> jnp.ndarray:
+    """uint32 [..., L] -> int8 [..., L*32] (LSB-first per lane — the
+    arena/plane bit order)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (lanes[..., :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*lanes.shape[:-1], lanes.shape[-1] * 32).astype(
+        jnp.int8
+    )
+
+
+def vertical_pair_local(
+    arena: jnp.ndarray,  # [f_pad+1, NL_local] uint32 (lanes sharded)
+    w_planes: jnp.ndarray,  # [B, NL_local] uint32
+    scales: Sequence[int],
+    min_count: jnp.ndarray,  # () int32 (traced)
+    num_items: jnp.ndarray,  # () int32 (traced)
+    cap: int,
+    n_chunks: int,
+    axis_name: Optional[str] = None,
+    fast_f32: bool = False,
+    sparse_thr: Optional[jnp.ndarray] = None,  # () int32 per-shard prune
+    sparse_cap: Optional[int] = None,
+) -> tuple:
+    """C6, vertical-arena form.  At k=2 EVERY pair is a candidate, so
+    per-candidate lane intersections degenerate to ``F²/2`` redundant
+    row gathers and lose to the MXU/BLAS Gram (measured 6x slower on
+    the sparse bench corpus) — RDD-Eclat itself computes F2 from the
+    horizontal layout before verticalizing (arxiv 1912.06415 §4).  So
+    the pair phase runs as per-PLANE Gram matmuls over lane chunks
+    unpacked on the fly: ``G = Σ_b 2^b · (A ⊙ plane_b) Aᵀ`` with ``A``
+    the arena's bit matrix — int8×int8→int32 (exact for any count), or
+    ONE f32 matmul with the reassembled weights folded in under
+    ``fast_f32`` (callers prove ``n_raw < 2^24``: entries are weighted
+    counts bounded by the raw transaction total).  The vertical win
+    starts at k=3, where only ACTUAL candidates are counted
+    (:func:`vertical_level_local`).
+
+    The counts land in the same ``[F, F]`` matrix the horizontal engine
+    produces, so everything downstream — ``pair_threshold_pack``, the
+    level-3 census, the resident-matrix overflow regather — is reused
+    verbatim and the engines cannot drift.  Returns
+    ``(packed, counts_mat)`` exactly like ``local_pair_gather`` (packed
+    gains the trailing union census under the sparse reduction)."""
+    f_pad = arena.shape[0] - 1
+    nl = arena.shape[1]
+    # Lane counts are not generally multiples of the chunk count (a
+    # prime local lane count must not degrade to per-lane scan steps):
+    # pad the scan axis with zero lanes — zero bits contribute nothing
+    # to any Gram entry, so the padded chunks are exact.
+    lc = -(-nl // n_chunks)
+    lanes = arena[:f_pad]
+    planes = w_planes
+    if lc * n_chunks > nl:
+        pad = lc * n_chunks - nl
+        lanes = jnp.pad(lanes, ((0, 0), (0, pad)))
+        planes = jnp.pad(planes, ((0, 0), (0, pad)))
+    lanes_c = lanes.reshape(f_pad, n_chunks, lc).transpose(1, 0, 2)
+    planes_c = planes.reshape(
+        planes.shape[0], n_chunks, lc
+    ).transpose(1, 0, 2)
+    def step(acc, xs):
+        lane_c, plane_c = xs  # [f_pad, lc] uint32, [B, lc] uint32
+        if fast_f32:
+            # ONE matmul with the reassembled f32 weights folded into
+            # the scaled side (the bitmap engine's _weights_f32 trick)
+            # — exact under the caller's n_raw < 2^24 gate (weighted
+            # counts are bounded by the raw transaction total).
+            bits = _unpack_lanes(lane_c).astype(jnp.float32)
+            w = None
+            for b, scale in enumerate(scales):
+                part = _unpack_lanes(plane_c[b]).astype(jnp.float32)
+                part = part if scale == 1 else part * jnp.float32(scale)
+                w = part if w is None else w + part
+            # lint: f32-gate -- fast_f32 callers prove n_raw < 2^24 (weighted counts bounded by the raw total)
+            part = lax.dot_general(
+                bits * w[None, :],
+                bits,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            return acc + part, None
+        # Integer path (TPU / counts past 2^24): one int8 matmul per
+        # weight bit-plane, int32 accumulation — exact for any count.
+        bits = _unpack_lanes(lane_c)  # int8
+        total = acc
+        for b, scale in enumerate(scales):
+            wb = _unpack_lanes(plane_c[b])  # [lc*32] int8
+            part = lax.dot_general(
+                bits * wb[None, :],
+                bits,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            part = part if scale == 1 else part * jnp.int32(scale)
+            total = total + part
+        return total, None
+
+    acc0 = jnp.zeros((f_pad, f_pad), jnp.int32)
+    if axis_name is not None:
+        from fastapriori_tpu import compat
+
+        acc0 = compat.pcast(acc0, (axis_name,), to="varying")
+    local, _ = lax.scan(step, acc0, (lanes_c, planes_c))
+    nu = None
+    if sparse_cap is not None and axis_name is not None:
+        iu = jnp.arange(f_pad)
+        cand = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
+        counts_mat, nu = local_sparse_psum(
+            local, sparse_thr, sparse_cap, axis_name, valid=cand
+        )
+    elif axis_name is not None:
+        counts_mat = lax.psum(local, axis_name)
+    else:
+        counts_mat = local
+    packed = pair_threshold_pack(
+        counts_mat, min_count, num_items, cap, census=f_pad <= TRI_F_CAP
+    )
+    if nu is not None:
+        packed = jnp.concatenate([packed, nu[None]])
+    return packed, counts_mat
+
+
+def vertical_level_local(
+    arena: jnp.ndarray,  # [f_pad+1, NL_local] uint32
+    w_planes: jnp.ndarray,  # [B, NL_local] uint32
+    scales: Sequence[int],
+    prefix_cols: jnp.ndarray,  # [P, K] int; padding -> zero column
+    cand_idx: jnp.ndarray,  # [C] int32 flat row·f_pad + y
+    cand_chunk: int,
+    axis_name: Optional[str] = None,
+    sparse_thr: Optional[jnp.ndarray] = None,
+    sparse_cap: Optional[int] = None,
+):
+    """C8, vertical form: one AND-reduction per prefix row, then per-
+    candidate lane intersections with the extension items — only the
+    ACTUAL candidates are counted (the matmul engine counts all P·F
+    possible extensions).  Same dispatch-layer contract as
+    ``local_level_gather``: padded prefix positions/rows and padded
+    candidate slots all resolve to zero counts; the prefix width K is
+    static per bucket but needs NO traced ``k1`` (the AND identity
+    handles padding, and popcounts are exact at any depth — no int8
+    membership bound, no ``wide_member`` widen).  Returns int32[C]
+    reduced counts, or ``(counts, n_union)`` under ``sparse_cap``."""
+    pref = _prefix_and(arena, prefix_cols)
+    local = _chunked_candidate_counts(
+        pref, arena, w_planes, scales, cand_idx, cand_chunk
+    )
+    if sparse_cap is not None and axis_name is not None:
+        return local_sparse_psum(local, sparse_thr, sparse_cap, axis_name)
+    if axis_name is not None:
+        return lax.psum(local, axis_name)
+    return local
+
+
+def vertical_level_batch(
+    arena: jnp.ndarray,
+    w_planes: jnp.ndarray,
+    scales: Sequence[int],
+    prefix_stack: jnp.ndarray,  # [NB, P, K]
+    cand_stack: jnp.ndarray,  # [NB, C]
+    cand_chunk: int,
+    axis_name: Optional[str] = None,
+    sparse_thr: Optional[jnp.ndarray] = None,
+    sparse_cap: Optional[int] = None,
+):
+    """A whole level's prefix blocks in ONE launch (the vertical twin of
+    ``local_level_gather_batch``): ``lax.scan`` over the stacked blocks,
+    each step one :func:`vertical_level_local`.  Returns ``[NB, C]``
+    counts — or ``([NB, C], [NB])`` union censuses under the sparse
+    reduction."""
+
+    def step(carry, xs):
+        pc, ci = xs
+        out = vertical_level_local(
+            arena, w_planes, scales, pc, ci, cand_chunk,
+            axis_name=axis_name, sparse_thr=sparse_thr,
+            sparse_cap=sparse_cap,
+        )
+        return carry, out
+
+    _, outs = lax.scan(step, jnp.int32(0), (prefix_stack, cand_stack))
+    return outs
+
+
+def vertical_level_word_ops(
+    nb: int, p_cap: int, k_pad: int, c_cap: int, n_planes: int, nl: int
+) -> int:
+    """uint32 word-op model of one vertical level launch (the metrics
+    analog of the matmul engines' ``macs`` — NOT MXU MACs, so it rides
+    the separate ``vops`` field and never inflates an MFU claim):
+    per block, K gather-ANDs over the [P, NL] prefix lanes plus
+    ``(1 + B)`` AND+popcount passes over the [C, NL] candidate
+    intersections."""
+    return nb * (k_pad * p_cap + (1 + n_planes) * c_cap) * nl

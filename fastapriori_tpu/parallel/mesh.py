@@ -445,9 +445,12 @@ class DeviceContext:
         l_max: int,
         n_chunks: int,
         has_heavy: bool,
+        sparse_cap: Optional[int] = None,
     ):
         """Jitted shallow-tail program (ops/fused.py make_tail_miner),
-        cached per static configuration (one compile per seed depth)."""
+        cached per static configuration (one compile per seed depth).
+        ``sparse_cap`` runs the per-iteration count reductions as the
+        threshold-sparse exchange (the PR-6 residue fold)."""
         if k0 + l_max - 1 >= 128:
             # Same widen as the fused engine, reached when the SEED depth
             # plus tail depth crosses int8's bound (ops/fused.py
@@ -458,14 +461,14 @@ class DeviceContext:
             )
         key = (
             "tail", tuple(scales), k0, m_cap, p_cap, l_max, n_chunks,
-            has_heavy,
+            has_heavy, sparse_cap,
         )
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import make_tail_miner
 
             self._fns[key] = make_tail_miner(
                 self.mesh, tuple(scales), k0, m_cap, p_cap, l_max,
-                n_chunks, has_heavy,
+                n_chunks, has_heavy, sparse_cap=sparse_cap,
             )
         return self._fns[key]
 
@@ -685,6 +688,256 @@ class DeviceContext:
             counts_dev,
             info,
         )
+
+    # -- vertical (Eclat) engine: tid-lane arena + AND/popcount kernels ----
+    def upload_tid_arena(self, arena_np: np.ndarray, buckets=None):
+        """Place the vertical engine's tid-lane arena
+        (``uint32[F_pad+1, NL]``, ops/vertical.py) with LANES sharded
+        over the txn axis — lane block s holds the same contiguous
+        transaction range as the horizontal engine's row shard s, so
+        the sparse count reduction's pigeonhole thresholds carry over
+        unchanged.  ``buckets``: the index-compressed pow2-bucketed
+        segment form (ops/vertical.py compress_arena) — the compact
+        host→device payload is scattered into the dense arena in ONE
+        device dispatch (the arxiv 1102.1003 layout's upload saving on
+        sparse corpora); None uploads the dense arena directly.
+        Returns ``(arena, upload_bytes)``."""
+        assert arena_np.shape[1] % self.txn_shards == 0, (
+            arena_np.shape,
+            self.txn_shards,
+        )
+        sharding = NamedSharding(self.mesh, P(None, AXIS))
+        if buckets is None:
+            return jax.device_put(arena_np, sharding), arena_np.nbytes
+        from fastapriori_tpu.ops.vertical import assemble_arena
+
+        f_pad = arena_np.shape[0] - 1
+        nl = arena_np.shape[1]
+        shapes = tuple(
+            (b[0].shape, b[1].shape) for b in buckets
+        )
+        key = ("varena", f_pad, nl, shapes)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(
+                lambda bk: assemble_arena(bk, f_pad, nl),
+                out_shardings=sharding,
+            )
+        dev = [
+            (
+                jax.device_put(ids),
+                jax.device_put(segs),
+                jax.device_put(words),
+            )
+            for ids, segs, words in buckets
+        ]
+        payload = sum(
+            ids.nbytes + segs.nbytes + words.nbytes
+            for ids, segs, words in buckets
+        )
+        return self._fns[key](dev), payload
+
+    def upload_lane_planes(self, planes_np: np.ndarray):
+        """Weight bit-planes (``uint32[B, NL]``) sharded over the lane
+        (txn) axis alongside the arena."""
+        return jax.device_put(
+            planes_np, NamedSharding(self.mesh, P(None, AXIS))
+        )
+
+    def vertical_pair_gather(
+        self, arena, w_planes, scales, min_count: int, num_items: int,
+        cap: int, txn_chunk: int, fast_f32: bool = False,
+        sparse_cap: Optional[int] = None, sparse_thr=None,
+    ):
+        """Vertical twin of :meth:`pair_gather` (ops/vertical.py
+        vertical_pair_local): per-plane Gram matmuls over lane chunks
+        unpacked on the fly (k=2 is the one level where EVERY pair is a
+        candidate, so the matmul beats per-candidate intersections —
+        RDD-Eclat computes F2 horizontally too), landing in the SAME
+        resident [F, F] count matrix — the packed host payload, the
+        level-3 census, the ``n2 > cap`` overflow retry
+        (:meth:`pair_regather`) and the sparse-reduction overflow
+        fallback are all shared with the horizontal engine.
+        ``txn_chunk`` bounds the per-chunk unpacked [F, tc] bit matrix.
+        Returns the same 6-tuple as :meth:`pair_gather`."""
+        f_pad = arena.shape[0] - 1
+        nl_local = arena.shape[1] // self.txn_shards
+        # The kernel zero-pads its scan axis to the chunk grid, so any
+        # chunk count works — size it purely from the [F, tc] bit
+        # intermediate budget.
+        n_chunks = max(1, -(-nl_local * 32 // max(txn_chunk, 32)))
+        key = (
+            "vpair", tuple(scales), f_pad, cap, n_chunks, fast_f32,
+            sparse_cap,
+        )
+        if key not in self._fns:
+            mesh = self.mesh
+            scl = tuple(scales)
+
+            def _local(arena, w_planes, min_count, num_items, *rest):
+                from fastapriori_tpu.ops.vertical import (
+                    vertical_pair_local,
+                )
+
+                thr = rest[0] if sparse_cap is not None else None
+                return vertical_pair_local(
+                    arena, w_planes, scl, min_count, num_items, cap,
+                    n_chunks,
+                    axis_name=AXIS,
+                    fast_f32=fast_f32,
+                    sparse_thr=(
+                        thr[lax.axis_index(AXIS)]
+                        if sparse_cap is not None
+                        else None
+                    ),
+                    sparse_cap=sparse_cap,
+                )
+
+            in_specs = (
+                (P(None, AXIS), P(None, AXIS), P(), P())
+                + ((P(None),) if sparse_cap is not None else ())
+            )
+            self._fns[key] = jax.jit(
+                compat.shard_map(
+                    _local,
+                    mesh=mesh,
+                    in_specs=in_specs,
+                    out_specs=(P(None), P(None, None)),
+                )
+            )
+        args = [
+            arena, w_planes, jnp.int32(min_count), jnp.int32(num_items),
+        ]
+        if sparse_cap is not None:
+            args += [jnp.asarray(sparse_thr, dtype=jnp.int32)]
+        packed, counts_dev = self._fns[key](*args)
+        n_cand = f_pad * f_pad
+        if sparse_cap is not None:
+            # lint: fetch-site -- vertical sparse-engine pair fetch (packed 2cap+3 ints incl. union census), retry-wrapped
+            out = retry.fetch(lambda: np.asarray(packed), "vpair_sparse")
+            nu = int(out[2 * cap + 2])
+            if nu > sparse_cap:
+                # Union compaction overflowed — the scattered counts
+                # are a subset of the union; redo this dispatch dense
+                # (ledger + memoized census, the pair_gather pattern).
+                ledger.record(
+                    "count_sparse_overflow", site="vpair",
+                    n_union=nu, cap=sparse_cap,
+                )
+                res = self.vertical_pair_gather(
+                    arena, w_planes, scales, min_count, num_items, cap,
+                    txn_chunk, fast_f32=fast_f32,
+                )
+                g_b, p_b = count_ops.sparse_psum_bytes(
+                    n_cand, sparse_cap, self.txn_shards
+                )
+                res[-1]["fallback"] = "sparse_overflow"
+                res[-1]["n_union"] = nu
+                res[-1]["psum_bytes"] += p_b
+                res[-1]["gather_bytes"] += g_b
+                return res
+            gather_b, psum_b = count_ops.sparse_psum_bytes(
+                n_cand, sparse_cap, self.txn_shards
+            )
+            info = {
+                "reduce": "sparse",
+                "psum_bytes": psum_b,
+                "gather_bytes": gather_b,
+                "n_union": nu,
+            }
+        else:
+            # lint: fetch-site -- the vertical pair phase's ONE audited fetch (packed 2cap+2 ints), retry-wrapped
+            out = retry.fetch(lambda: np.asarray(packed), "vpair")
+            info = {
+                "reduce": "dense",
+                "psum_bytes": 4 * n_cand,
+                "gather_bytes": 0,
+            }
+        return (
+            out[:cap],
+            out[cap : 2 * cap],
+            int(out[2 * cap]),
+            int(out[2 * cap + 1]),
+            counts_dev,
+            info,
+        )
+
+    def vertical_level_gather_batch(
+        self,
+        arena,
+        w_planes,
+        scales,
+        prefix_stack,
+        min_count: int,
+        cand_stack,
+        cand_chunk: int,
+        sparse_cap: Optional[int] = None,
+        sparse_thr=None,
+    ) -> tuple:
+        """Vertical twin of :meth:`level_gather_batch`: a whole level's
+        prefix blocks in one launch over the tid-lane arena
+        (ops/vertical.py vertical_level_batch), same host contract —
+        ``(bits [NB, C//8(+4)] uint8, counts [NB, C] int32)`` with the
+        per-block union censuses riding the bits payload under the
+        sparse reduction.  No ``k1``/heavy/wide_member machinery: the
+        AND identity handles prefix padding and popcounts are exact at
+        any depth."""
+        key = (
+            "vlevel_batch", tuple(scales), cand_chunk, sparse_cap,
+        )
+        if key not in self._fns:
+            mesh = self.mesh
+            scl = tuple(scales)
+            s_cap = sparse_cap
+
+            def _local(arena, w_planes, ps, mc, cs, *rest):
+                from fastapriori_tpu.ops.vertical import (
+                    vertical_level_batch,
+                )
+
+                thr = rest[0] if s_cap is not None else None
+                out = vertical_level_batch(
+                    arena, w_planes, scl, ps, cs, cand_chunk,
+                    axis_name=AXIS,
+                    sparse_thr=(
+                        thr[lax.axis_index(AXIS)]
+                        if s_cap is not None
+                        else None
+                    ),
+                    sparse_cap=s_cap,
+                )
+                if s_cap is not None:
+                    counts, nus = out
+                    return (
+                        count_ops.keep_bits_with_census(counts, mc, nus),
+                        counts,
+                    )
+                return count_ops.keep_bits(out, mc), out
+
+            in_specs = (
+                (
+                    P(None, AXIS),
+                    P(None, AXIS),
+                    P(None, None, None),
+                    P(),
+                    P(None, None),
+                )
+                + ((P(None),) if sparse_cap is not None else ())
+            )
+            self._fns[key] = jax.jit(
+                compat.shard_map(
+                    _local,
+                    mesh=mesh,
+                    in_specs=in_specs,
+                    out_specs=(P(None, None), P(None, None)),
+                )
+            )
+        args = [
+            arena, w_planes, prefix_stack, jnp.int32(min_count),
+            cand_stack,
+        ]
+        if sparse_cap is not None:
+            args += [jnp.asarray(sparse_thr, dtype=jnp.int32)]
+        return self._fns[key](*args)
 
     def ingest_pair_miner(self, block_rows, t_pad: int, cap: int,
                           census: bool, l3: Optional[Tuple[int, int, int]] = None):
@@ -913,21 +1166,12 @@ class DeviceContext:
                 if s_cap is not None:
                     counts, nus = out
                     # The per-block union censuses ride the ONE bits
-                    # fetch as 4 little-endian trailing bytes per block
-                    # (a second fetch would cost a full link round trip
-                    # just to carry NB ints).
-                    nu_bytes = jnp.stack(
-                        [
-                            ((nus >> s) & 0xFF).astype(jnp.uint8)
-                            for s in (0, 8, 16, 24)
-                        ],
-                        axis=1,
+                    # fetch (ops/count.py keep_bits_with_census — the
+                    # shared payload definition).
+                    return (
+                        count_ops.keep_bits_with_census(counts, mc, nus),
+                        counts,
                     )
-                    bits = jnp.concatenate(
-                        [count_ops.keep_bits(counts, mc), nu_bytes],
-                        axis=1,
-                    )
-                    return bits, counts
                 return count_ops.keep_bits(out, mc), out
 
             # Blocks unsharded (scanned on device); prefix rows and the
@@ -1079,6 +1323,7 @@ class DeviceContext:
         has_heavy: bool,
         gather_shapes: Tuple,
         u24: bool,
+        sparse_cap: Optional[int] = None,
     ):
         """The shallow-tail fold's program EXTENDED with the end-of-mine
         ``counts_resolve`` gather (ROADMAP pipeline follow-up): the tail
@@ -1098,11 +1343,12 @@ class DeviceContext:
         (and its jax_log_compiles signatures) covers the rest."""
         key = (
             "tail_resolve", tuple(scales), k0, m_cap, p_cap, l_max,
-            n_chunks, has_heavy, gather_shapes, u24,
+            n_chunks, has_heavy, gather_shapes, u24, sparse_cap,
         )
         if key not in self._fns:
             tail_fn = self.tail_miner(
-                tuple(scales), k0, m_cap, p_cap, l_max, n_chunks, has_heavy
+                tuple(scales), k0, m_cap, p_cap, l_max, n_chunks,
+                has_heavy, sparse_cap=sparse_cap,
             )
             gfn = _gather_counts_u24_jit if u24 else _gather_counts_jit
 

@@ -315,6 +315,92 @@ def test_env_sparse_cap_strictly_parsed(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# shallow-tail fold sparse reduction (ISSUE 7 satellite: the PR-6
+# residue — the fold was the last counting path still dense-psumming
+# its per-iteration [p_cap, F] counts)
+
+
+def _tail_lines():
+    return tokenized(
+        random_dataset(2, n_txns=150, max_len=8) + ["1 2 3 4 5 6 7"] * 20
+    )
+
+
+def _tail_mine(**cfg):
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.04, engine="level", num_devices=8,
+            tail_fuse_rows=1 << 20, **cfg,
+        )
+    )
+    got, _, _ = miner.run(_tail_lines())
+    return dict(got), miner
+
+
+def test_tail_fold_sparse_reduction_bitexact_with_bytes():
+    """The fold's per-iteration count reduction runs the threshold-
+    sparse exchange under count_reduce=sparse — bit-exact, with the
+    per-engine comms bytes on the tail_fuse record (and strictly below
+    the dense psum payload)."""
+    exp, md = _tail_mine(count_reduce="dense")
+    got, ms = _tail_mine(count_reduce="sparse", count_sparse_min=1)
+    assert got == exp
+    t_d = [r for r in md.metrics.records if r["event"] == "tail_fuse"]
+    t_s = [r for r in ms.metrics.records if r["event"] == "tail_fuse"]
+    assert t_d and t_d[0]["reduce"] == "dense"
+    assert t_s and t_s[0]["reduce"] == "sparse"
+    assert (
+        t_s[0]["psum_bytes"] + t_s[0]["gather_bytes"]
+        < t_d[0]["psum_bytes"]
+    )
+
+
+def test_tail_fold_sparse_overflow_resumes_per_level_exact():
+    """A forced-tiny union budget overflows inside the fold: the level
+    carries the bad sentinel, the host resumes per-level from the last
+    complete level (exact), the ledger names the tail site, and the
+    grown budget is memoized for repeat runs."""
+    exp, _ = _tail_mine(count_reduce="dense")
+    got, miner = _tail_mine(
+        count_reduce="sparse", count_sparse_min=1, count_sparse_cap=8
+    )
+    assert got == exp
+    ovf = [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_sparse_overflow" and e.get("site") == "tail"
+    ]
+    assert ovf and ovf[0]["n_union"] > 8
+    tails = [
+        r for r in miner.metrics.records if r["event"] == "tail_fuse"
+    ]
+    assert tails and tails[0]["incomplete"]
+    # The per-level engine finished the lattice after the failed fold.
+    assert [
+        r
+        for r in miner.metrics.records
+        if r["event"] == "level" and r.get("k", 0) >= 4
+    ]
+    # Memoized: a repeat mine on the same context folds clean.
+    ledger.reset()
+    got2, _, _ = FastApriori(
+        config=MinerConfig(
+            min_support=0.04, engine="level", num_devices=8,
+            tail_fuse_rows=1 << 20, count_reduce="sparse",
+            count_sparse_min=1, count_sparse_cap=8,
+        ),
+        context=miner.context,
+    ).run(_tail_lines())
+    assert dict(got2) == exp
+    assert not [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_sparse_overflow"
+        and e.get("site") == "tail"
+    ]
+
+
+# ---------------------------------------------------------------------------
 # the primitive itself
 
 

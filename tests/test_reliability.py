@@ -146,6 +146,10 @@ FETCH_SITE_INVENTORY = [
     "fetch.pair_sparse",  # parallel/mesh.py sparse-engine pair packed fetch
     "fetch.rule_mask",  # rules/gen.py device-engine survivor bitmask
     "fetch.rule_counts",  # rules/gen.py surviving-denominator gather
+    "fetch.vpair",  # parallel/mesh.py vertical-engine pair packed fetch
+    "fetch.vpair_sparse",  # parallel/mesh.py vertical pair + union census
+    "fetch.vlevel_bits",  # models/apriori.py vertical survivor bitmask
+    "fetch.vlevel_bits_sparse",  # models/apriori.py vertical bitmask + census
 ]
 
 
@@ -441,6 +445,81 @@ def test_sparse_engine_fetch_failpoints_retried_end_to_end():
         e["site"] for e in ledger.snapshot() if e["kind"] == "retry"
     }
     assert {"fetch.pair_sparse", "fetch.level_bits_sparse"} <= sites
+
+
+def test_vertical_engine_fetch_failpoints_retried_end_to_end():
+    """ISSUE 7 satellite: the vertical (Eclat) engine's survivor
+    fetches — the packed pair output and the per-level bitmask — are
+    audited sites; an injected transient on each must be absorbed
+    inside a real vertical mine, bit-exact against the bitmap run."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.vpair", "oom*1")
+    failpoints.arm("fetch.vlevel_bits", "oom*1")
+    miner = FastApriori(
+        config=_mine_config(mine_engine="vertical", count_reduce="dense")
+    )
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    sites = {
+        e["site"] for e in ledger.snapshot() if e["kind"] == "retry"
+    }
+    assert {"fetch.vpair", "fetch.vlevel_bits"} <= sites
+
+
+def test_vertical_sparse_fetch_failpoints_retried_end_to_end():
+    """Vertical + sparse count reduction: the census-carrying fetch
+    variants are their own armable sites (G013)."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.vpair_sparse", "oom*1")
+    failpoints.arm("fetch.vlevel_bits_sparse", "oom*1")
+    miner = FastApriori(
+        config=_sparse_config(mine_engine="vertical")
+    )
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    sites = {
+        e["site"] for e in ledger.snapshot() if e["kind"] == "retry"
+    }
+    assert {"fetch.vpair_sparse", "fetch.vlevel_bits_sparse"} <= sites
+
+
+def test_vertical_kill_resume_round_trip_bit_exact(tmp_path):
+    """ISSUE 7 satellite: kill-and-resume must stay byte-identical
+    under the vertical engine — interrupt after a completed level,
+    resume from the checkpoint with the vertical engine still
+    selected, writer output byte-equal to the uninterrupted bitmap
+    run's."""
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+    clean_sets, _, clean_items = FastApriori(config=_mine_config()).run(
+        txns
+    )
+    failpoints.arm("level.3", "abort")  # die right after level 3 commits
+    miner = FastApriori(
+        config=_mine_config(
+            mine_engine="vertical", checkpoint_prefix=prefix
+        )
+    )
+    with pytest.raises(failpoints.InjectedAbort):
+        miner.run(txns)
+    failpoints.disarm_all()
+    levels, meta = ckpt.load_checkpoint(prefix)
+    assert levels[-1][0].shape[1] == 3
+    resumed = FastApriori(config=_mine_config(mine_engine="vertical"))
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    got_sets, _, got_items = resumed.run(txns)
+    assert got_items == clean_items
+    out_a, out_b = str(tmp_path / "a_"), str(tmp_path / "b_")
+    writer.save_freq_itemsets(out_a, clean_sets, clean_items)
+    writer.save_freq_itemsets(out_b, got_sets, got_items)
+    assert (
+        open(out_a + "freqItemset", "rb").read()
+        == open(out_b + "freqItemset", "rb").read()
+    )
 
 
 def test_sparse_kill_resume_round_trip_bit_exact(tmp_path):

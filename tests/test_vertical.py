@@ -1,0 +1,479 @@
+"""Vertical (Eclat-style) tid-lane mining engine (ISSUE 7, ROADMAP
+item 3): the AND+popcount engine (ops/vertical.py) must be BIT-EXACT
+against the bitmap-matmul oracle on every corpus shape and mesh size,
+its engine selection/env/fallback contracts mirror the rule-engine and
+count-reduce tables (tests/test_rules_device.py,
+tests/test_count_sparse.py), and it composes with the PR-6 sparse count
+reduction (overflow fallback included)."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.reliability import failpoints, ledger
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+
+
+def _mine(lines, min_support, **cfg):
+    miner = FastApriori(
+        config=MinerConfig(min_support=min_support, **cfg)
+    )
+    got, _, _ = miner.run(lines)
+    return dict(got), miner
+
+
+def _engine_events():
+    return [
+        e for e in ledger.snapshot() if e["kind"] == "mine_engine"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# differential suite: vertical vs the bitmap oracle, bit-exact per corpus
+
+
+def _t10i4_shaped():
+    from fastapriori_tpu.utils.datagen import generate_transactions
+
+    return [
+        l.split()
+        for l in generate_transactions(
+            n_txns=1500, n_items=90, avg_txn_len=9, n_patterns=30,
+            avg_pattern_len=4, corruption=0.35, seed=11,
+        )
+    ]
+
+
+def _webdocs_shaped():
+    return tokenized(
+        random_dataset(23, n_txns=400, n_items=40, max_len=12)
+    )
+
+
+def _deep_lattice():
+    return tokenized(
+        random_dataset(13, n_txns=200, n_items=14, max_len=9)
+    )
+
+
+def _no_survivor_level():
+    return tokenized(random_dataset(3, n_txns=120))
+
+
+@pytest.mark.parametrize(
+    "lines_fn, min_support",
+    [
+        (_t10i4_shaped, 0.03),
+        (_webdocs_shaped, 0.04),
+        (_deep_lattice, 0.05),
+        (_no_survivor_level, 0.4),
+    ],
+    ids=["t10i4", "webdocs", "deep-lattice", "no-survivor"],
+)
+@pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
+def test_vertical_bitexact_vs_bitmap(lines_fn, min_support, n_devices):
+    lines = lines_fn()
+    exp, _ = _mine(
+        lines, min_support, engine="level", num_devices=n_devices,
+        mine_engine="bitmap",
+    )
+    got, miner = _mine(
+        lines, min_support, engine="level", num_devices=n_devices,
+        mine_engine="vertical",
+    )
+    assert got == exp
+    assert _engine_events()  # the choice landed on the ledger
+    # ...and the metrics stream names the engine per level.
+    lv = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "level" and r.get("k") == 2
+    ]
+    assert lv and lv[0].get("engine") == "vertical"
+
+
+def test_vertical_non_pow2_f_pad_levels():
+    """f_pad = 384 — a 128-multiple that is NOT a power of two: the
+    candidate scan-chunk must divide the clamped candidate budget
+    (regression: a pow2 chunk above the f_pad-clamped budget tripped
+    the kernel's divisibility assert at k>=3)."""
+    rng = np.random.RandomState(5)
+    lines = [
+        [str(x) for x in rng.choice(300, 4, replace=False)]
+        for _ in range(800)
+    ]
+    lines += [["1", "2", "3", "4"]] * 60
+    exp, _ = _mine(lines, 0.002, engine="level", mine_engine="bitmap")
+    got, miner = _mine(
+        lines, 0.002, engine="level", mine_engine="vertical"
+    )
+    assert got == exp
+    # The corpus really exercised the non-pow2 clamp.
+    assert miner._vertical_chunk(384) == 128
+
+
+def test_vertical_heavy_weights_exact():
+    """Multiplicity >= 128 rides the weight bit-planes (no base-128
+    digit split, no heavy-row correction) — exact against the bitmap
+    engine's heavy-split path."""
+    lines = tokenized(
+        ["1 2 3 4 5"] * 200 + ["1 2 3 4"] * 40 + ["2 3 4 5 6"] * 9
+        + ["6 7"] * 3
+    )
+    ms = 8.0 / len(lines)
+    exp, _ = _mine(lines, ms, engine="level", mine_engine="bitmap")
+    got, miner = _mine(lines, ms, engine="level", mine_engine="vertical")
+    assert got == exp
+    arena = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "arena_build"
+    ]
+    assert arena and arena[0]["planes"] >= 8  # weights up to 200
+
+
+def test_vertical_pair_cap_overflow_regather_exact():
+    """n2 above the pair budget: the overflow re-extracts at the exact
+    pow2 budget over the RESIDENT [F, F] matrix (the bitmap engine's
+    regather, shared verbatim)."""
+    lines = _t10i4_shaped()
+    exp, _ = _mine(lines, 0.03, engine="level", mine_engine="bitmap")
+    got, miner = _mine(
+        lines, 0.03, engine="level", mine_engine="vertical", pair_cap=8,
+    )
+    assert got == exp
+    kinds = [e["kind"] for e in ledger.snapshot()]
+    assert "pair_cap_overflow" in kinds
+
+
+# ---------------------------------------------------------------------------
+# composition with the sparse count reduction (ISSUE 6 machinery)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_vertical_sparse_count_reduce_bitexact(n_devices):
+    lines = _t10i4_shaped()
+    exp, _ = _mine(
+        lines, 0.03, engine="level", num_devices=n_devices,
+        mine_engine="bitmap", count_reduce="dense",
+    )
+    got, miner = _mine(
+        lines, 0.03, engine="level", num_devices=n_devices,
+        mine_engine="vertical", count_reduce="sparse",
+        count_sparse_min=1,
+    )
+    assert got == exp
+    lv = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "level" and r.get("reduce") == "sparse"
+    ]
+    assert lv  # at least one level actually ran the sparse exchange
+    assert all("gather_bytes" in r for r in lv)
+
+
+def test_vertical_sparse_overflow_falls_back_dense_and_stays_exact():
+    lines = _t10i4_shaped()
+    exp, _ = _mine(
+        lines, 0.03, engine="level", num_devices=8,
+        mine_engine="bitmap", count_reduce="dense",
+    )
+    got, miner = _mine(
+        lines, 0.03, engine="level", num_devices=8,
+        mine_engine="vertical", count_reduce="sparse",
+        count_sparse_min=1, count_sparse_cap=8,
+    )
+    assert got == exp
+    kinds = [e["kind"] for e in ledger.snapshot()]
+    assert "count_sparse_overflow" in kinds
+    # Budget memoized: a repeat mine on the same context pays no second
+    # overflow (the pair-cap-hint pattern).
+    ledger.reset()
+    got2, _, _ = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", num_devices=8,
+            mine_engine="vertical", count_reduce="sparse",
+            count_sparse_min=1, count_sparse_cap=8,
+        ),
+        context=miner.context,
+    ).run(lines)
+    assert dict(got2) == exp
+    assert not [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "count_sparse_overflow"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# engine selection / fallback / env strictness (the rule-engine table)
+
+
+def _sparse_corpus():
+    """Wide item axis, short baskets: density well under the auto
+    threshold with >= vertical_min_items frequent items."""
+    rng = np.random.RandomState(7)
+    return [
+        [str(x) for x in rng.choice(1500, size=rng.randint(2, 6),
+                                    replace=False)]
+        for _ in range(3000)
+    ]
+
+
+def test_auto_picks_vertical_on_sparse_corpus():
+    lines = _sparse_corpus()
+    _, miner = _mine(lines, 0.001, mine_engine="auto")
+    recs = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "mine_engine"
+    ]
+    assert recs and recs[0]["engine"] == "vertical"
+    ev = _engine_events()
+    assert ev and "density" in ev[0]  # the decision input is recorded
+
+
+def test_auto_stays_bitmap_on_dense_corpus():
+    lines = _deep_lattice()
+    _, miner = _mine(lines, 0.05, engine="level", mine_engine="auto")
+    recs = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "mine_engine"
+    ]
+    assert recs and recs[0]["engine"] == "bitmap"
+    assert not _engine_events()
+
+
+def test_auto_density_threshold_is_a_knob():
+    """Raising vertical_density_max flips the dense corpus to vertical;
+    zeroing it pins even the sparse corpus to bitmap."""
+    lines = _deep_lattice()
+    _, miner = _mine(
+        lines, 0.05, engine="level", mine_engine="auto",
+        vertical_density_max=1.0, vertical_min_items=1,
+    )
+    recs = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "mine_engine"
+    ]
+    assert recs and recs[0]["engine"] == "vertical"
+    _, miner2 = _mine(
+        _sparse_corpus(), 0.001, mine_engine="auto",
+        vertical_density_max=0.0,
+    )
+    recs2 = [
+        r
+        for r in miner2.metrics.records
+        if r.get("event") == "mine_engine"
+    ]
+    assert recs2 and recs2[0]["engine"] == "bitmap"
+
+
+def test_forced_vertical_on_cand_mesh_falls_back_with_ledger():
+    lines = _deep_lattice()
+    got, _ = _mine(
+        lines, 0.05, engine="level", num_devices=8, cand_devices=2,
+        mine_engine="vertical",
+    )
+    exp, _ = _mine(
+        lines, 0.05, engine="level", num_devices=8, cand_devices=2,
+        mine_engine="bitmap",
+    )
+    assert got == exp
+    falls = [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "mine_engine_fallback"
+    ]
+    assert falls and falls[0]["reason"] == "cand_mesh"
+
+
+def test_config_mine_engine_strictly_validated():
+    lines = _deep_lattice()
+    with pytest.raises(InputError, match="mine_engine"):
+        _mine(lines, 0.05, mine_engine="vretical")
+
+
+def test_env_mine_engine_strictly_parsed(monkeypatch):
+    from fastapriori_tpu.utils.env import env_choice
+
+    monkeypatch.setenv("FA_MINE_ENGINE", "  BITMAP ")
+    assert env_choice(
+        "FA_MINE_ENGINE", ("auto", "bitmap", "vertical")
+    ) == "bitmap"
+    monkeypatch.setenv("FA_MINE_ENGINE", "vreticle")  # the typo class
+    with pytest.raises(InputError, match="FA_MINE_ENGINE"):
+        env_choice("FA_MINE_ENGINE", ("auto", "bitmap", "vertical"))
+
+
+def test_env_overrides_config(monkeypatch):
+    """FA_MINE_ENGINE=bitmap beats a vertical config — no vertical
+    engine event lands on the ledger."""
+    monkeypatch.setenv("FA_MINE_ENGINE", "bitmap")
+    lines = _deep_lattice()
+    _, miner = _mine(
+        lines, 0.05, engine="level", mine_engine="vertical"
+    )
+    assert not _engine_events()
+    recs = [
+        r
+        for r in miner.metrics.records
+        if r.get("event") == "mine_engine"
+    ]
+    assert recs and recs[0]["engine"] == "bitmap"
+
+
+def test_env_vertical_chunk_strictly_parsed(monkeypatch):
+    monkeypatch.setenv("FA_VERTICAL_CHUNK", "4k")
+    lines = _deep_lattice()
+    with pytest.raises(InputError, match="FA_VERTICAL_CHUNK"):
+        _mine(lines, 0.05, engine="level", mine_engine="vertical")
+
+
+def test_forced_vertical_without_csr_falls_back(tmp_path):
+    """retain_csr=False capture ingest produces a CSR-less
+    CompressedData — a forced vertical mine of it falls back to bitmap
+    WITH a ledger event rather than mining an empty arena (and the
+    pipelined run_file path skips pipelining up front instead)."""
+    from fastapriori_tpu.preprocess import CompressedData
+
+    lines = _deep_lattice()
+    exp, _ = _mine(lines, 0.05, engine="level", mine_engine="bitmap")
+    from fastapriori_tpu.preprocess import preprocess
+
+    data = preprocess(lines, 0.05)
+    gutted = CompressedData(
+        n_raw=data.n_raw,
+        min_count=data.min_count,
+        freq_items=data.freq_items,
+        item_to_rank=data.item_to_rank,
+        item_counts=data.item_counts,
+        basket_indices=np.empty(0, np.int32),
+        basket_offsets=np.zeros(1, np.int64),
+        weights=data.weights,
+    )
+    assert not FastApriori._has_csr(gutted)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.05, engine="level", mine_engine="vertical"
+        )
+    )
+    eng, req = miner._mine_engine(gutted)
+    assert eng == "bitmap" and req == "vertical"
+    falls = [
+        e
+        for e in ledger.snapshot()
+        if e["kind"] == "mine_engine_fallback"
+    ]
+    assert falls and falls[0]["reason"] == "no_csr"
+
+
+def test_vertical_run_file_matches_bitmap(tmp_path):
+    """run_file with a forced vertical engine skips the pipelined
+    capture ingest (it pre-commits to the bitmap layout) and still
+    mines bit-exact."""
+    lines = _t10i4_shaped()
+    p = tmp_path / "d.dat"
+    p.write_text("\n".join(" ".join(l) for l in lines) + "\n")
+    exp = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", mine_engine="bitmap"
+        )
+    ).run_file(str(p))[0]
+    got = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", mine_engine="vertical"
+        )
+    ).run_file(str(p))[0]
+    assert dict(got) == dict(exp)
+
+
+# ---------------------------------------------------------------------------
+# the layout primitives
+
+
+def test_arena_matches_bitmap_transpose():
+    from fastapriori_tpu.ops.bitmap import build_bitmap_csr
+    from fastapriori_tpu.ops.vertical import build_tid_arena_csr
+
+    rng = np.random.RandomState(0)
+    baskets = [
+        np.unique(rng.randint(0, 10, rng.randint(1, 6)))
+        for _ in range(100)
+    ]
+    lens = np.array([len(b) for b in baskets])
+    indices = np.concatenate(baskets).astype(np.int32)
+    offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    arena, f_pad, t_pad = build_tid_arena_csr(indices, offsets, 10)
+    dense = build_bitmap_csr(indices, offsets, 10, t_pad, 128)
+    assert arena.shape == (f_pad + 1, t_pad // 32)
+    # LSB-first within each uint32 lane: tid t <-> lane t//32 bit t%32.
+    shifts = np.arange(32, dtype=np.uint32)
+    unpacked = (
+        (arena[:f_pad, :, None] >> shifts[None, None, :]) & 1
+    ).reshape(f_pad, t_pad)
+    assert (unpacked == dense.T[:f_pad, : t_pad]).all()
+    assert (arena[f_pad] == np.uint32(0xFFFFFFFF)).all()
+
+
+def test_compress_arena_roundtrip_and_payload():
+    import jax.numpy as jnp
+
+    from fastapriori_tpu.ops.vertical import (
+        assemble_arena,
+        build_tid_arena_csr,
+        compress_arena,
+    )
+
+    rng = np.random.RandomState(1)
+    baskets = [
+        np.unique(rng.randint(0, 200, rng.randint(1, 4)))
+        for _ in range(400)
+    ]
+    lens = np.array([len(b) for b in baskets])
+    indices = np.concatenate(baskets).astype(np.int32)
+    offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    arena, f_pad, t_pad = build_tid_arena_csr(indices, offsets, 200)
+    buckets, payload, stats = compress_arena(arena, f_pad)
+    # Sparse corpus: the pow2-bucketed segment form is much smaller
+    # than the dense arena.
+    assert payload < arena[:f_pad].nbytes
+    assert 0 < stats["occupancy"] < 0.5
+    re = np.asarray(
+        assemble_arena(
+            [
+                (jnp.asarray(i), jnp.asarray(s), jnp.asarray(w))
+                for i, s, w in buckets
+            ],
+            f_pad,
+            arena.shape[1],
+        )
+    )
+    assert (re == arena).all()
+
+
+def test_weight_bit_planes_reassemble():
+    from fastapriori_tpu.ops.vertical import weight_bit_planes
+
+    w = np.array([1, 2, 127, 128, 300, 65535], np.int32)
+    planes, scales = weight_bit_planes(w, 32)
+    assert scales == [1 << b for b in range(16)]
+    shifts = np.arange(32, dtype=np.uint32)
+    total = np.zeros(32, np.int64)
+    for p, s in zip(planes, scales):
+        bits = ((p[:, None] >> shifts[None, :]) & 1).reshape(-1)
+        total += bits.astype(np.int64) * s
+    assert (total[:6] == w).all() and (total[6:] == 0).all()

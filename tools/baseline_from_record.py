@@ -113,6 +113,23 @@ def render(parsed: dict) -> str:
                 )
             )
             out.append(f"- per-level ms: {lv}")
+    ec = parsed.get("engine_compare") or {}
+    if ec.get("vertical_vs_bitmap_wall") is not None:
+        out.append("")
+        line = (
+            f"Mining engines ({ec.get('config', 'clickstream-sparse')}, "
+            f"{ec.get('n_txns')} txns @ {ec.get('min_support')}): "
+            f"vertical {ec['vertical_vs_bitmap_wall']}x faster than "
+            f"bitmap wall-clock"
+        )
+        if ec.get("vertical_vs_bitmap_k_le3") is not None:
+            line += f", {ec['vertical_vs_bitmap_k_le3']}x at k<=3"
+        for n, row in sorted((ec.get("devices") or {}).items()):
+            b = (row.get("bitmap") or {}).get("wall_s")
+            v = (row.get("vertical") or {}).get("wall_s")
+            if b is not None and v is not None:
+                line += f"; {n}-dev {b}s vs {v}s"
+        out.append(line + ".")
     cal = parsed.get("calibration")
     if cal:
         out.append("")

@@ -30,4 +30,10 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_device.py -q \
     -p no:cacheprovider
 
+# Vertical-vs-bitmap mining-engine differential suite (ISSUE 7): the
+# tid-lane engine must stay bit-exact against the bitmap oracle on
+# every corpus/mesh shape.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_vertical.py -q \
+    -p no:cacheprovider
+
 env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
