@@ -432,6 +432,18 @@ def _emit_final(merged) -> int:
                 "vertical_vs_bitmap_k_le3"
             ),
         }
+    rsc = (merged.get("rules_full_scale") or {}).get("scaling") or {}
+    d4 = (rsc.get("devices") or {}).get("4") or {}
+    if d4.get("join_vs_1dev") is not None:
+        # The ISSUE 8 headline: sharded phase-2 join overhead at 4
+        # virtual devices (flat = ideal on a shared-core host) and the
+        # resident scan's zero-host-round-trip contract; the full
+        # per-device series lives in the record file.
+        compact["rule_scaling_4dev"] = {
+            "join_vs_1dev": d4["join_vs_1dev"],
+            "users_per_s": d4.get("users_per_s"),
+            "rule_table_host_bytes": d4.get("rule_table_host_bytes"),
+        }
     cal = (merged.get("calibration") or {}).get("start") or {}
     if cal.get("link_down_mbyte_s") is not None:
         compact["link_down_mbyte_s"] = cal["link_down_mbyte_s"]
@@ -444,6 +456,7 @@ def _emit_final(merged) -> int:
     for drop in (
         "webdocs_phases",
         "engine_compare",
+        "rule_scaling_4dev",
         "webdocs_link_probe_mbyte_s",
         "mfu_pct",
     ):
@@ -695,6 +708,39 @@ def _orchestrate(args) -> int:
                     except Exception as e:  # noqa: BLE001
                         print(
                             f"scaling attach skipped: {e}", file=sys.stderr
+                        )
+                    # Per-device-count rule-generation + resident-scan
+                    # children (ISSUE 8): rules_full_scale and the
+                    # movielens recommend row gain the join/sort/scan
+                    # scaling series.  Best-effort like the mining curve.
+                    try:
+                        rsc = _rule_scaling_measure(args, deadline)
+                        merged.setdefault("rules_full_scale", {})[
+                            "scaling"
+                        ] = rsc
+                        mv = (merged.get("configs") or {}).get(
+                            "movielens_recommend"
+                        )
+                        if mv is not None:
+                            mv["scaling"] = {
+                                n: {
+                                    k: d.get(k)
+                                    for k in (
+                                        "users_per_s",
+                                        "users_vs_1dev",
+                                        "scan_dispatches",
+                                        "shards",
+                                    )
+                                }
+                                for n, d in rsc.get(
+                                    "devices", {}
+                                ).items()
+                            }
+                    # lint: waive G006 -- attach is best-effort: skip is printed and the record stays valid
+                    except Exception as e:  # noqa: BLE001
+                        print(
+                            f"rule scaling attach skipped: {e}",
+                            file=sys.stderr,
                         )
                 if full:
                     # Per-mining-engine compare on the sparse-corpus
@@ -1273,10 +1319,23 @@ def _recommend_workload(args, raw, d_path) -> int:
             # accumulate per run, so the surviving values are the LAST
             # (steady-state) warm run's.
             phases["rule_upload_ms"] = r.get("rule_upload_ms")
-            phases["scan_dispatches"] = r.get("dispatches", 1)
+            phases["scan_dispatches"] = r.get(
+                "scan_dispatches", r.get("dispatches", 1)
+            )
             phases["scan_ms"] = r.get("scan_ms")
             phases["fetch_ms"] = r.get("fetch_ms")
             phases["chunks_run"] = r.get("chunks_run")
+            if r.get("resident_table"):
+                # ISSUE 8 acceptance fields: the table was BUILT on
+                # device (sharded rank-strided layout) and its bytes
+                # never cross the host link after the level-table
+                # upload — identically zero, recorded, not asserted.
+                phases["resident_table"] = True
+                phases["rule_table_host_bytes"] = r.get(
+                    "rule_table_host_bytes"
+                )
+                phases["scan_shards"] = r.get("shards")
+                phases["scan_psum_bytes"] = r.get("psum_bytes")
     phases["first_match_s"] = round(wall, 3)
     print(
         f"recommend: {n_users} users in {wall:.2f}s "
@@ -1508,6 +1567,196 @@ def _scaling_measure(args, deadline=None) -> dict:
     ov8 = (out["devices"].get("8") or {}).get("overhead_vs_1dev")
     if ov8 is not None:
         out["sharding_overhead_8dev"] = ov8
+    return out
+
+
+_RULE_SCALING_CHILD = """
+import json, os, sys, time
+n_dev = int(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_dev}"
+    ).strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", n_dev)
+except AttributeError:
+    pass
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.io.reader import tokenize_line
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.models.recommender import AssociationRules
+from fastapriori_tpu.utils.datagen import generate_user_baskets
+
+d_path, min_support, n_items, n_users = (
+    sys.argv[1], float(sys.argv[3]), int(sys.argv[4]), int(sys.argv[5])
+)
+# rule_engine="device" forces the device join engine below the auto size
+# floor (the scaling corpus is far under 2M rules); the shard count then
+# resolves to the mesh's FULL txn axis (rules/gen.py auto policy), so
+# n_dev=1 is the single-chip device-engine wall the ratios divide by.
+cfg = MinerConfig(min_support=min_support, engine="level",
+                  num_devices=n_dev, rule_engine="device")
+miner = FastApriori(config=cfg)
+levels, data = miner.run_file_raw(d_path)
+u_lines = [tokenize_line(l) for l in generate_user_baskets(
+    n_users=n_users, n_items=n_items, seed=7)]
+def fresh():
+    return AssociationRules(
+        [], data.freq_items, data.item_to_rank, config=cfg,
+        context=miner.context, levels=levels,
+        item_counts=data.item_counts)
+
+# Warm the compiles on a THROWAWAY instance (shared context: the
+# shard_map join/build/scan kernels land in ctx._fns + the jit cache),
+# so the measured instance's rule_gen_device / table_build_ms walls are
+# dispatch+decode, not XLA compile — the mining children's warm-run
+# convention (a compile 2x slower at n=8 would otherwise corrupt the
+# join_vs_1dev headline).  The warm run takes the FULL user list: the
+# scan's micro-batch shape follows the basket count (recommender
+# REC_MICROBATCH_ROWS cap), so a small warm batch would leave the
+# timed run's 4096-row compile inside the measured wall.
+fresh().run(u_lines, use_device=True)
+rec = fresh()
+rec.run(u_lines[:128], use_device=True)  # measured: warm gen + table build
+t0 = time.perf_counter()
+out = rec.run(u_lines, use_device=True)
+wall = time.perf_counter() - t0
+gen = [r for r in rec.metrics.records
+       if r.get("event") == "rule_gen_device"][-1]
+fms = [r for r in rec.metrics.records
+       if r.get("event") == "first_match" and r.get("device")]
+fm0, fm = fms[0], fms[-1]  # first run carries the one-off table build
+print(json.dumps({
+    "shards": gen.get("shards", 1),
+    "n_rules": rec.n_rules,
+    "resident_table": bool(fm.get("resident_table")),
+    "join_s": round(gen.get("wall_ms", 0.0) / 1e3, 3),
+    "join_dispatch_s": round(gen.get("dispatch_ms", 0.0) / 1e3, 3),
+    "join_dispatches": gen.get("dispatches"),
+    "sort_s": round(fm0.get("table_build_ms", 0.0) / 1e3, 3),
+    "join_gather_bytes": gen.get("gather_bytes", 0),
+    "join_psum_bytes": gen.get("psum_bytes", 0),
+    "comms": gen.get("comms", []),
+    "scan_dispatches": fm.get("scan_dispatches", fm.get("dispatches")),
+    "scan_psum_bytes": fm.get("psum_bytes", 0),
+    "rule_table_host_bytes": fm.get("rule_table_host_bytes"),
+    "scan_ms": fm.get("scan_ms"),
+    "fetch_ms": fm.get("fetch_ms"),
+    "users_per_s": round(n_users / wall, 1),
+}))
+"""
+
+
+def _rule_scaling_measure(args, deadline=None) -> dict:
+    """Sharded rule generation + device-resident recommend scan on
+    1/2/4/8-device virtual CPU meshes (ISSUE 8): per-device-count
+    join/sort walls, scan dispatches, collective bytes and users/s — the
+    scaling children of the ``rules_full_scale`` record and the
+    movielens recommend row.  Virtual devices share this host's core(s),
+    so — exactly like the mining curve's convention — the honest
+    recorded figure is the sharding OVERHEAD (``join_vs_1dev``: flat is
+    ideal; the ≤0.5x join-wall target is a real-chip claim), while the
+    per-level gather/psum-byte series and the zero-host-round-trip
+    contract (``rule_table_host_bytes == 0``) are exact and
+    chip-transferable."""
+    import copy
+    import os
+    import subprocess
+    import tempfile
+
+    small = copy.copy(args)
+    small.n_txns = min(args.n_txns, 50_000)
+    # Phase-2-bound support level: the mining scaling corpus at its
+    # default 0.01 survives only ~4.6K rules — a warm sharded join is
+    # then ~10 ms of pure dispatch overhead and the ratio series is
+    # noise.  0.002 yields ~67K itemsets -> ~190K rules / 9 levels on
+    # the same corpus (a real join load, ~0.1 s warm at 1 device)
+    # while the child still mines in bench-budget time.
+    small.min_support = min(args.min_support, 0.002)
+    raw = gen_lines(small)
+    f = tempfile.NamedTemporaryFile(mode="w", suffix=".dat", delete=False)
+    f.write("\n".join(raw) + "\n")
+    f.close()
+    n_users = 20_000
+    out = {
+        "platform": "virtual-cpu",
+        "n_txns": small.n_txns,
+        "n_users": n_users,
+        "min_support": small.min_support,
+        "devices": {},
+    }
+    try:
+        for n in (1, 2, 4, 8):
+            timeout = 1800.0
+            if deadline is not None:
+                timeout = min(timeout, max(deadline - time.monotonic(), 0))
+                if timeout < 60:
+                    print(
+                        f"rule scaling n={n} skipped: bench budget "
+                        "exhausted",
+                        file=sys.stderr,
+                    )
+                    break
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", _RULE_SCALING_CHILD, f.name,
+                     str(n), str(small.min_support), str(args.n_items),
+                     str(n_users)],
+                    capture_output=True,
+                    timeout=timeout,
+                )
+            except subprocess.TimeoutExpired:
+                # Keep the device counts already measured — one hung
+                # child must not discard the whole series.
+                print(
+                    f"rule scaling n={n} timed out after {timeout:.0f}s",
+                    file=sys.stderr,
+                )
+                continue
+            line = next(
+                (
+                    l
+                    for l in proc.stdout.decode().splitlines()
+                    if l.startswith("{")
+                ),
+                None,
+            )
+            if proc.returncode == 0 and line:
+                out["devices"][str(n)] = json.loads(line)
+            else:
+                print(
+                    f"rule scaling n={n} failed (rc={proc.returncode})",
+                    file=sys.stderr,
+                )
+    finally:
+        os.unlink(f.name)
+    base = (out["devices"].get("1") or {}).get("join_s")
+    base_u = (out["devices"].get("1") or {}).get("users_per_s")
+    for n, rec in out["devices"].items():
+        jv = (
+            round(rec["join_s"] / base, 3)
+            if base and rec.get("join_s") is not None
+            else None
+        )
+        rec["join_vs_1dev"] = jv
+        if base_u and rec.get("users_per_s"):
+            rec["users_vs_1dev"] = round(rec["users_per_s"] / base_u, 3)
+        print(
+            f"rule-scaling[virtual-cpu] n={n}: join {rec.get('join_s')}s "
+            f"(vs_1dev {jv}) sort {rec.get('sort_s')}s "
+            f"scan_dispatches={rec.get('scan_dispatches')} "
+            f"gather={rec.get('join_gather_bytes')} "
+            f"host_bytes={rec.get('rule_table_host_bytes')} "
+            f"users/s={rec.get('users_per_s')}",
+            file=sys.stderr,
+        )
+    jv4 = (out["devices"].get("4") or {}).get("join_vs_1dev")
+    if jv4 is not None:
+        out["join_overhead_4dev"] = jv4
     return out
 
 

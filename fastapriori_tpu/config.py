@@ -56,6 +56,16 @@ class MinerConfig:
     # table uploads, which only amortize on big levels (VERDICT r5
     # weak #8 is a 16.34M-rule workload; 2M is ~0.5 s of host joins).
     rule_device_min_rules: int = 1 << 21
+    # Phase-2 shard count over the txn mesh axis (rules/gen.py
+    # resolve_rule_shards): 0 = auto — shard the per-level rule joins
+    # (and the recommender's resident-table priority scan) over the
+    # FULL txn axis on eligible meshes (single process, no cand axis),
+    # falling back to the single-chip engine elsewhere; 1 pins phase 2
+    # to device 0 (the PR-4 engine); any other value must equal the
+    # mesh's txn shard count (InputError otherwise — phase 2 shards
+    # over the existing mesh, it cannot carve a sub-mesh).
+    # FA_RULE_SHARDS overrides, strictly parsed.
+    rule_shards: int = 0
     # Count-reduction engine for the mesh collectives (ops/count.py
     # local_sparse_psum): "auto" (default) runs the threshold-sparse
     # exchange — per-shard local prune at the weighted-pigeonhole

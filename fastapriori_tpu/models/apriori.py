@@ -333,16 +333,63 @@ class FastApriori:
         )
 
     @staticmethod
+    def _density_from_tables(
+        n_raw: int, num_items: int, occ_total: float
+    ) -> float:
+        """The ONE density definition (frequent-item occurrence mass
+        over the full ``T × F`` bitmap) — shared by the post-ingest
+        estimate and the pass-1 pipeline probe so the two sites can
+        never drift."""
+        if num_items <= 0 or n_raw <= 0:
+            return 1.0
+        return float(occ_total) / (float(n_raw) * num_items)
+
+    @staticmethod
     def _density_estimate(data: CompressedData) -> float:
         """Pair-phase density estimate: frequent-item occurrence mass
         over the full ``T × F`` bitmap — the fraction of bitmap cells
         the Gram matmul multiplies that are actually set.  Computed
         from the ingest's own tables (item_counts are the raw per-rank
         occurrence counts), so the choice costs no device work."""
-        f = data.num_items
-        if f <= 0 or data.n_raw <= 0:
-            return 1.0
-        return float(np.sum(data.item_counts)) / (float(data.n_raw) * f)
+        return FastApriori._density_from_tables(
+            data.n_raw, data.num_items,
+            # lint: host-data -- item counts are host numpy
+            float(np.sum(data.item_counts)),
+        )
+
+    def _pipeline_engine_probe(
+        self, n_raw: int, num_items: int, occ_total: float
+    ) -> str:
+        """Mining-engine LAYOUT choice from pass-1 tables alone (ISSUE 8
+        satellite: the density probe folded into pass-1 ingest, so
+        auto-vertical no longer forfeits the pipelined capture overlap —
+        the choice lands BEFORE any block commits to the bitmap
+        layout).  Same decision rule as :meth:`_mine_engine` (which
+        remains the post-ingest resolution for the non-pipelined
+        paths); the chosen path is ledger-recorded with the density the
+        probe saw."""
+        req = self._requested_mine_engine()
+        if req == "bitmap":
+            return "bitmap"
+        if req == "vertical":
+            ledger.record(
+                "mine_engine", once_key="vertical", engine="vertical",
+                probe="pass1",
+            )
+            return "vertical"
+        cfg = self.config
+        density = self._density_from_tables(n_raw, num_items, occ_total)
+        if (
+            num_items >= cfg.vertical_min_items
+            and density <= cfg.vertical_density_max
+        ):
+            ledger.record(
+                "mine_engine", once_key="auto_vertical",
+                engine="vertical", density=round(density, 6),
+                probe="pass1",
+            )
+            return "vertical"
+        return "bitmap"
 
     def _requested_mine_engine(self) -> str:
         """The strictly-parsed mining-engine REQUEST (``FA_MINE_ENGINE``
@@ -441,10 +488,16 @@ class FastApriori:
         oracle)."""
         from fastapriori_tpu.ops import vertical as vops
 
+        from fastapriori_tpu.preprocess import ingest_thread_count
+
         cfg = self.config
         ctx = self.context
         resume = self._take_resume(data)
         self._require_csr(data)
+        # Same thread pool policy as the segmented pass-1 ingest scan
+        # (FA_INGEST_THREADS): the arena build's reduceat pass splits
+        # run-aligned across it (PR-7 residue — it was single-threaded).
+        n_threads = ingest_thread_count(cfg.ingest_threads)
         with self.metrics.timed("arena_build") as m:
             arena_np, f_pad, t_pad = vops.build_tid_arena_csr(
                 data.basket_indices,
@@ -452,6 +505,7 @@ class FastApriori:
                 data.num_items,
                 32 * ctx.txn_shards,
                 cfg.item_tile,
+                n_threads=n_threads,
             )
             planes_np, scales = vops.weight_bit_planes(
                 # lint: host-data -- CompressedData weights are host numpy
@@ -477,6 +531,7 @@ class FastApriori:
                 planes=len(scales),
                 compressed=use_compressed,
                 occupancy=seg_stats["occupancy"],
+                threads=n_threads,
                 upload_bytes=upload_bytes + planes_np.nbytes,
             )
         # The pair phase folds the REASSEMBLED weights into one f32
@@ -652,15 +707,21 @@ class FastApriori:
         cfg = self.config
         if cfg.ingest_pipeline_blocks <= 1 or "://" in d_path:
             return False
-        # A FORCED vertical mine needs the basket CSR for the tid-lane
-        # arena — the pipelined capture ingest pre-commits to the
-        # horizontal bitmap layout (and the CLI drops the CSR), so it
-        # is skipped up front.  The "auto" choice keeps the pipeline:
-        # its density probe rides the ingest tables, and a pipelined
-        # bitmap already on device beats re-ingesting (folding the
-        # probe into pass 1 is ROADMAP residue).
+        # The capture ingest no longer pre-commits to the bitmap layout:
+        # the pass-1 density probe (loader on_pass1 /
+        # fa_preprocess_buffer_blocks2) picks the engine BEFORE any
+        # block callback fires, and vertical blocks retain their CSR for
+        # the arena build instead of packing bitmaps (ISSUE 8 satellite,
+        # PR-7 residue).  A forced-vertical mine therefore pipelines too
+        # — unless the .so predates the capture entry point, where the
+        # classic whole-file path still serves it.
         if self._requested_mine_engine() == "vertical":
-            return False
+            from fastapriori_tpu.native.loader import (
+                has_preprocess_buffer_blocks,
+            )
+
+            if not has_preprocess_buffer_blocks():
+                return False
         import jax
 
         if jax.process_count() != 1:
@@ -779,6 +840,56 @@ class FastApriori:
 
         if f < 2:
             return [], empty_data()
+
+        # Pass-1 density probe (ISSUE 8 satellite): this flavor has the
+        # merged tables in hand before pass 2, so the layout choice is a
+        # direct call — a vertical pick compresses the blocks threaded
+        # (the same overlap) and retains the CSR for the arena build
+        # instead of packing/uploading bitmaps.
+        if self._pipeline_engine_probe(
+            n_raw, f, float(np.sum(item_counts))
+        ) == "vertical":
+            self.metrics.emit(
+                "mine_engine", engine="vertical",
+                requested=self._requested_mine_engine(), probe="pass1",
+            )
+            with self.metrics.timed("csr_build") as m:
+                blocks = []
+                with ThreadPoolExecutor(n_threads) as cpool:
+                    ranges = [
+                        r
+                        for r in split_buffer_ranges(
+                            buf, max(cfg.ingest_pipeline_blocks, n_threads)
+                        )
+                        if r[1] > r[0]
+                    ]
+                    comp = [
+                        cpool.submit(
+                            lambda lo=lo, hi=hi: compress_with_ranks(
+                                buf[lo:hi], freq_items
+                            )
+                        )
+                        for lo, hi in ranges
+                    ]
+                    for fu in comp:
+                        _, bi, bo, bw = fu.result()
+                        if len(bw):
+                            blocks.append((bi, bo, bw))
+                if not blocks:
+                    return [], empty_data()
+                indices, offsets, w_np = self._concat_block_csr(blocks)
+                m.update(blocks=len(blocks), rows=len(w_np))
+            data = CompressedData(
+                n_raw=n_raw,
+                min_count=min_count,
+                freq_items=freq_items,
+                item_to_rank=item_to_rank,
+                item_counts=item_counts,
+                basket_indices=indices,
+                basket_offsets=offsets,
+                weights=w_np,
+            )
+            return self._mine_vertical(data), data
 
         # Static shapes fixed BEFORE the first upload: distinct rows are
         # bounded by n_raw, so an n_chunks derived from it can only be
@@ -984,6 +1095,23 @@ class FastApriori:
             weights=np.empty(0, np.int32),
         )
 
+    @staticmethod
+    def _concat_block_csr(blocks):
+        """Block-order concatenation of per-block ``(indices, offsets,
+        weights)`` CSRs into one global CSR — the ONE offset-rebase
+        definition (cross-block duplicate baskets stay separate weighted
+        rows, the sharded-ingest correctness rule; each block's
+        ``offsets[0] == 0``).  Shared by the bitmap assembly and both
+        vertical ingest flavors."""
+        w_np = np.concatenate([bw for _, _, bw in blocks])
+        indices = np.concatenate([bi for bi, _, _ in blocks])
+        offs = [np.zeros(1, dtype=np.int64)]
+        base = 0
+        for _, bo, _ in blocks:
+            offs.append(bo[1:].astype(np.int64) + base)
+            base += int(bo[-1])
+        return indices, np.concatenate(offs), w_np
+
     def _assemble_blocks(self, blocks, txn_multiple: int, f: int,
                          heavy_pre=None):
         """Host-side assembly of per-block CSRs: concatenated weights +
@@ -999,19 +1127,13 @@ class FastApriori:
 
         total = sum(len(bw) for _, _, bw in blocks)
         t_pad = pad_axis(total, txn_multiple)
-        w_np = np.concatenate([bw for _, _, bw in blocks])
         if heavy_pre is None:
-            indices = np.concatenate([bi for bi, _, _ in blocks])
-            offs = [np.zeros(1, dtype=np.int64)]
-            base = 0
-            for _, bo, _ in blocks:
-                offs.append(bo[1:].astype(np.int64) + base)
-                base += int(bo[-1])
-            offsets = np.concatenate(offs)
+            indices, offsets, w_np = self._concat_block_csr(blocks)
             w_digits_np, scales, heavy_b, heavy_w = self._split_weights(
                 w_np, t_pad, indices, offsets, f
             )
         else:
+            w_np = np.concatenate([bw for _, _, bw in blocks])
             indices = np.empty(0, np.int32)
             offsets = np.zeros(1, np.int64)
             w_digits_np, scales, heavy_b, heavy_w = self._split_weights(
@@ -1056,6 +1178,27 @@ class FastApriori:
         dev_futures = []
         w_futures = []  # raw int32 block weights (ingest-overlapped pair)
         state = {"f_pad": None, "upload_bytes": 0}
+        # Mining-engine layout, decided by the PASS-1 probe (ISSUE 8
+        # satellite): the native call fires on_pass1 once — after the
+        # global tables exist, before any block replays — so the block
+        # callbacks commit to bitmap packing OR CSR retention per the
+        # probe's choice instead of always pre-committing to the bitmap
+        # (the PR-7 residue that forfeited auto-vertical under this
+        # ingest).  A stale .so without the probe export keeps the
+        # bitmap commit; a FORCED vertical needs no probe at all.
+        from fastapriori_tpu.native.loader import has_pass1_probe
+
+        req_engine = self._requested_mine_engine()
+        engine_state = {"engine": "bitmap"}
+        use_probe = req_engine == "auto" and has_pass1_probe()
+        if req_engine == "vertical":
+            engine_state["engine"] = self._pipeline_engine_probe(0, 0, 0.0)
+
+        def on_pass1(n_raw_, min_count_, f_, counts_):
+            engine_state["engine"] = self._pipeline_engine_probe(
+                n_raw_, f_, float(counts_.sum())
+            )
+
         upool = ThreadPoolExecutor(max_workers=1)
         try:
             with self.metrics.timed("preprocess", path=d_path) as m:
@@ -1088,6 +1231,17 @@ class FastApriori:
                     state.setdefault(
                         "t_first_block", time.perf_counter()
                     )
+                    if engine_state["engine"] == "vertical":
+                        # Vertical layout: retain the block CSR for the
+                        # tid-lane arena build instead of packing a
+                        # bitmap (items may be a callback-lifetime arena
+                        # view under copy_items=False — copy it; the
+                        # offsets/weights copies are already owned).
+                        items_c = (
+                            items if items.flags.writeable else items.copy()
+                        )
+                        blocks.append((items_c, offsets, weights))
+                        return
                     tp0 = time.perf_counter()
                     pk, f_pad = build_packed_bitmap_csr(
                         items, offsets, f_, 1, cfg.item_tile
@@ -1149,6 +1303,7 @@ class FastApriori:
                         on_block,
                         n_threads=n_threads,
                         copy_items=cfg.retain_csr,
+                        on_pass1=on_pass1 if use_probe else None,
                     )
                 )
                 t_ingest1 = time.perf_counter()
@@ -1158,6 +1313,7 @@ class FastApriori:
                 m.update(
                     n_raw=n_raw, min_count=min_count, num_items=f,
                     pipelined=True, capture=True, threads=n_threads,
+                    engine=engine_state["engine"],
                     pass1_s=round(t_first - t_ingest0, 3),
                     pass2_s=round(t_ingest1 - t_first, 3),
                     pack_s=round(state.get("pack_s", 0.0), 3),
@@ -1166,6 +1322,26 @@ class FastApriori:
                 return [], self._empty_compressed(
                     n_raw, min_count, freq_items, item_to_rank, item_counts
                 )
+            if engine_state["engine"] == "vertical":
+                # Vertical mine off the retained block CSRs: no weight-
+                # digit or heavy-row machinery, the lane engine takes
+                # raw weights as bit-planes.
+                self.metrics.emit(
+                    "mine_engine", engine="vertical",
+                    requested=req_engine, probe="pass1",
+                )
+                indices, offsets, w_np = self._concat_block_csr(blocks)
+                data = CompressedData(
+                    n_raw=n_raw,
+                    min_count=min_count,
+                    freq_items=freq_items,
+                    item_to_rank=item_to_rank,
+                    item_counts=item_counts,
+                    basket_indices=indices,
+                    basket_offsets=offsets,
+                    weights=w_np,
+                )
+                return self._mine_vertical(data), data
             # Same phase accounting as the threaded path: assembly, the
             # upload-tail wait, and the device concat/unpack book under
             # bitmap_build (the native call above is preprocess).

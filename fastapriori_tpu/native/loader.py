@@ -136,6 +136,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _FA_BLOCK_CB,
             ctypes.c_void_p,
         ]
+    blocks2_fn = getattr(lib, "fa_preprocess_buffer_blocks2", None)
+    if blocks2_fn is not None:
+        blocks2_fn.restype = ctypes.POINTER(_FaResult)
+        blocks2_fn.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            _FA_PASS1_CB,
+            _FA_BLOCK_CB,
+            ctypes.c_void_p,
+        ]
     cand = getattr(lib, "fa_gen_candidates", None)
     if cand is not None:
         cand.restype = ctypes.POINTER(_FaCandidates)
@@ -170,6 +183,17 @@ _FA_BLOCK_CB = ctypes.CFUNCTYPE(
     ctypes.POINTER(ctypes.c_int64),
     ctypes.POINTER(ctypes.c_int32),
     ctypes.POINTER(ctypes.c_int32),
+)
+
+# void pass1_cb(ctx, n_raw, min_count, f, counts*) — fires once after
+# pass 1 / rank assignment, before any block replays.
+_FA_PASS1_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p,
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int64),
 )
 
 
@@ -376,9 +400,20 @@ def has_preprocess_buffer_blocks() -> bool:
     )
 
 
+def has_pass1_probe() -> bool:
+    """True when the .so exports the pass-1-callback flavor
+    (``fa_preprocess_buffer_blocks2``) — a stale build without it keeps
+    the probe-less capture path."""
+    lib = get_lib()
+    return (
+        lib is not None
+        and getattr(lib, "fa_preprocess_buffer_blocks2", None) is not None
+    )
+
+
 def preprocess_buffer_blocks(
     data: bytes, min_support: float, n_blocks: int, on_block,
-    n_threads: int = 1, copy_items: bool = True,
+    n_threads: int = 1, copy_items: bool = True, on_pass1=None,
 ):
     """Capture-replay pipelined preprocessing: pass 1 + rank assignment +
     per-block pass-2 id replay in ONE native call (the raw bytes are
@@ -391,7 +426,14 @@ def preprocess_buffer_blocks(
     callback (the copy is ~0.7 GB of memcpy at webdocs scale; callers
     that consume items inside the callback — bitmap packing, heavy-row
     extraction — skip it).  Returns the global tables
-    ``(n_raw, min_count, freq_items, item_counts)``."""
+    ``(n_raw, min_count, freq_items, item_counts)``.
+
+    ``on_pass1(n_raw, min_count, f, item_counts int64[f])`` fires ONCE
+    after pass 1 / rank assignment and before any block replays — the
+    hook the mining-engine density probe rides (models/apriori.py) so a
+    layout choice can steer the block callbacks without re-tokenizing;
+    requires the ``fa_preprocess_buffer_blocks2`` export
+    (:func:`has_pass1_probe`)."""
     from fastapriori_tpu.reliability import failpoints
 
     failpoints.fire("native.blocks")
@@ -400,6 +442,13 @@ def preprocess_buffer_blocks(
         raise RuntimeError(
             "native block-preprocess entry point unavailable; rebuild "
             "with `make -C fastapriori_tpu/native`"
+        )
+    if on_pass1 is not None and getattr(
+        lib, "fa_preprocess_buffer_blocks2", None
+    ) is None:
+        raise RuntimeError(
+            "native pass-1-probe entry point unavailable; rebuild with "
+            "`make -C fastapriori_tpu/native` (or call without on_pass1)"
         )
     # Accept bytes OR any readonly buffer (an mmap'd file via a numpy
     # view — the caller avoids copying a GB-scale file into a bytes
@@ -463,10 +512,33 @@ def preprocess_buffer_blocks(
         except BaseException as e:  # never unwind through the C frame
             errs.append(e)
 
-    res_ptr = lib.fa_preprocess_buffer_blocks(
-        data_arg, data_len, ctypes.c_double(min_support), n_blocks,
-        max(n_threads, 1), cb, None
-    )
+    if on_pass1 is not None:
+
+        @_FA_PASS1_CB
+        def p1cb(_ctx, n_raw, min_count, f, counts_p):
+            if errs:
+                return
+            try:
+                f = int(f)
+                counts = (
+                    np.ctypeslib.as_array(counts_p, shape=(f,)).copy()
+                    if f > 0
+                    else np.empty(0, dtype=np.int64)
+                )
+                on_pass1(int(n_raw), int(min_count), f, counts)
+            # lint: waive G006 -- captured into errs and re-raised after the C call
+            except BaseException as e:  # never unwind through the C frame
+                errs.append(e)
+
+        res_ptr = lib.fa_preprocess_buffer_blocks2(
+            data_arg, data_len, ctypes.c_double(min_support), n_blocks,
+            max(n_threads, 1), p1cb, cb, None
+        )
+    else:
+        res_ptr = lib.fa_preprocess_buffer_blocks(
+            data_arg, data_len, ctypes.c_double(min_support), n_blocks,
+            max(n_threads, 1), cb, None
+        )
     if not res_ptr:
         if errs:
             raise errs[0]

@@ -1486,10 +1486,20 @@ typedef void (*FaBlockCb)(void* ctx, int32_t f, int64_t n_baskets,
                           const int64_t* offsets, const int32_t* items,
                           const int32_t* weights);
 
-FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
-                                      double min_support, int32_t n_blocks,
-                                      int32_t n_threads, FaBlockCb cb,
-                                      void* cb_ctx) {
+// Pass-1-complete callback (fa_preprocess_buffer_blocks2): fires once
+// after the global tables exist and BEFORE any block replays — the
+// caller's chance to pick a layout (e.g. the vertical-engine density
+// probe, models/apriori.py) while keeping the capture pipeline's
+// tokenize-once property.  ``counts`` are the [f] occurrence counts in
+// rank order, valid only for the duration of the callback.
+typedef void (*FaPass1Cb)(void* ctx, int64_t n_raw, int64_t min_count,
+                          int32_t f, const int64_t* counts);
+
+}  // extern "C"
+
+static FaResult* preprocess_buffer_blocks_impl(
+    const char* data, int64_t len, double min_support, int32_t n_blocks,
+    int32_t n_threads, FaPass1Cb pass1_cb, FaBlockCb cb, void* cb_ctx) {
   PhaseTimer timer;
   std::string_view buf(data, static_cast<size_t>(len));
 
@@ -1500,6 +1510,14 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
   // run at ~n_threads the single-core rate, and replay workers overlap
   // the main thread's callback/packing/upload work.
   if (!p1.run(buf, min_support, timer, n_threads)) return nullptr;
+  if (pass1_cb) {
+    std::vector<int64_t> cnts(static_cast<size_t>(p1.f));
+    for (int32_t r = 0; r < p1.f; ++r) {
+      cnts[static_cast<size_t>(r)] = p1.freq[static_cast<size_t>(r)].count;
+    }
+    pass1_cb(cb_ctx, p1.n_raw, p1.min_count, p1.f,
+             cnts.empty() ? nullptr : cnts.data());
+  }
 
   // ---- pass 2: per-block replay + dedup + callback --------------------
   // Blocks split by TOKEN count (not line count) so work per block is
@@ -1680,6 +1698,24 @@ FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
   }
   timer.mark("marshal");
   return res;
+}
+
+extern "C" {
+
+FaResult* fa_preprocess_buffer_blocks(const char* data, int64_t len,
+                                      double min_support, int32_t n_blocks,
+                                      int32_t n_threads, FaBlockCb cb,
+                                      void* cb_ctx) {
+  return preprocess_buffer_blocks_impl(data, len, min_support, n_blocks,
+                                       n_threads, nullptr, cb, cb_ctx);
+}
+
+FaResult* fa_preprocess_buffer_blocks2(const char* data, int64_t len,
+                                       double min_support, int32_t n_blocks,
+                                       int32_t n_threads, FaPass1Cb pass1_cb,
+                                       FaBlockCb cb, void* cb_ctx) {
+  return preprocess_buffer_blocks_impl(data, len, min_support, n_blocks,
+                                       n_threads, pass1_cb, cb, cb_ctx);
 }
 
 }  // extern "C"

@@ -43,8 +43,9 @@ def local_first_match_chunk(
     #   all-zero bitmap column (F_pad - 1), padding ROWS are all-padding
     ant_size: jnp.ndarray,  # [Rc] int32
     consequent: jnp.ndarray,  # [Rc] int32
-    base: jnp.ndarray,  # () int32 — global index of this chunk's first rule
-    best: jnp.ndarray,  # [Nb_local] int32 — running best global rule index
+    base: jnp.ndarray,  # () int32 — global RANK of this chunk's first rule
+    best: jnp.ndarray,  # [Nb_local] int32 — running best global rule rank
+    step: int = 1,  # static — global rank stride between adjacent rows
 ) -> jnp.ndarray:
     """Fold one rule chunk into the running first-match.
 
@@ -62,7 +63,13 @@ def local_first_match_chunk(
     is a broadcast compare-and-sum, NOT a scatter: TPU scatters cost
     ~200 ns per index (40 s across a webdocs-scale 16M-rule no-match
     scan), while the [Rc, K, F] compare tree is plain VPU work that
-    XLA fuses into the matmul's operand."""
+    XLA fuses into the matmul's operand.
+
+    ``step``: the RANK-STRIDED table layout of the sharded scan (local
+    row ``i`` holds global rank ``i·step + s``); the caller folds the
+    shard offset into ``base``, so local row ``base/step + j`` maps to
+    global rank ``base + j·step``.  ``step=1`` is the replicated-table
+    scan (rank == row index)."""
     rc = ant_cols.shape[0]
     f = baskets.shape[1]
     # [Rc, F]; pad positions all point at the guaranteed all-zero bitmap
@@ -85,11 +92,8 @@ def local_first_match_chunk(
     size_ok = ant_size[None, :] <= basket_len[:, None]
     cons_in_basket = jnp.take(baskets, consequent, axis=1) > 0
     eligible = contained & size_ok & ~cons_in_basket
-    idx = jnp.where(
-        eligible,
-        jnp.arange(rc, dtype=jnp.int32)[None, :] + base,
-        jnp.int32(NO_MATCH),
-    )
+    ranks = jnp.arange(rc, dtype=jnp.int32) * jnp.int32(step) + base
+    idx = jnp.where(eligible, ranks[None, :], jnp.int32(NO_MATCH))
     return jnp.minimum(best, jnp.min(idx, axis=1))
 
 
@@ -403,3 +407,389 @@ def rule_level_kernel(
         num_keys=len(scols),
     )
     return packed, tuple(srt[:-1]), srt[-1], d_flat, surv_flat
+
+
+# ---------------------------------------------------------------------------
+# Multi-chip rule generation (ISSUE 8 tentpole): the per-level join/prune
+# above, sharded over the txn mesh axis.  Query rows (the k-itemset table)
+# are SHARDED — each shard searches only its N/S rows' k column deletions
+# against the REPLICATED sorted parent table — and the per-shard survivor
+# bitmask / denominator blocks are merged with the packed-mask exchange
+# (the byte layout of ops/count.py local_sparse_psum's union gather; here
+# the blocks are disjoint, so the merge is a tiled concatenation rather
+# than an OR).  The SNIPPETS custom_partitioning/sharding-constraint
+# pattern: replicated inputs, sharded compute, replicated outputs, so the
+# kernel is mesh-polymorphic (S=1 reproduces the single-chip engine).
+
+
+def _tiled_all_gather(x: jnp.ndarray, axis_name: str, axis: int):
+    """``all_gather`` of per-shard blocks, concatenated along ``axis`` in
+    shard order — the layout inverse of a P(AXIS)-sharded placement.
+    Spelled as stack+reshape (the 0.4.x-safe form under shard_map)."""
+    g = lax.all_gather(x, axis_name)  # [S, ...]
+    if axis == 0:
+        return g.reshape((-1,) + x.shape[1:])
+    assert axis == 1, axis
+    g = jnp.moveaxis(g, 0, 1)  # [d0, S, d1, ...]
+    return g.reshape(x.shape[0], -1, *x.shape[2:])
+
+
+def rule_level_shard_kernel(
+    mat: jnp.ndarray,  # [N_loc, k] int32 — THIS shard's lex-sorted rows
+    cnts: jnp.ndarray,  # [N_loc] int32 itemset counts (< 2^24, gated)
+    n_real: jnp.ndarray,  # () int32 — real row count (pow2·8S padding)
+    psorted,  # tuple of [Np_pad] uint32 — parent sorted keys (replicated)
+    porder: jnp.ndarray,  # [Np_pad] int32 — parent sort order (replicated)
+    pcnts: jnp.ndarray,  # [Np_pad] int32 — parent counts (replicated)
+    np_real: jnp.ndarray,  # () int32
+    prev_surv: jnp.ndarray,  # [(k-1)*Np_pad] bool — parent-rule survival
+    prev_d: jnp.ndarray,  # [(k-1)*Np_pad] int32 — parent-rule denominators
+    *,
+    k: int,
+    bits: int,
+    first: bool,
+    axis_name: str,
+    n_shards: int,
+):
+    """Sharded twin of :func:`rule_level_kernel`, still ONE dispatch per
+    level: each shard runs the k→(k-1) packed-key binary searches and the
+    dominance prune for ITS row block only (the O(N·k·log Np) join cost —
+    phase 2's dominant term — divides by S), then one packed-mask + one
+    denominator exchange reassemble the full j-major survivor state on
+    every shard, and the (cheap, O(N log N)) lex sort of the full table
+    runs replicated so the next level's parent keys need no further
+    exchange.  Returns the :func:`rule_level_kernel` tuple extended with
+    the gathered ``(mat_full, cnts_full)`` — device-resident inputs to
+    the next level's search and to the recommender's scan-table build
+    (rules/gen.py DeviceRuleState)."""
+    from fastapriori_tpu.ops.count import _unpack_bits_msb, pack_bits_msb
+
+    n_loc = mat.shape[0]
+    n_pad = n_loc * n_shards
+    s = lax.axis_index(axis_name)
+    row0 = s.astype(jnp.int32) * jnp.int32(n_loc)
+    valid_loc = (
+        jnp.arange(n_loc, dtype=jnp.int32) + row0
+    ) < n_real.astype(jnp.int32)
+    if first:
+        # k == 2: parents are the 1-itemset arange — the deleted single
+        # column IS the parent row index, no search.
+        rows = jnp.stack([mat[:, 1], mat[:, 0]])
+        found = jnp.broadcast_to(valid_loc[None, :], (k, n_loc))
+    else:
+        np_pad = porder.shape[0]
+        dels = [
+            jnp.concatenate([mat[:, :j], mat[:, j + 1 :]], axis=1)
+            for j in range(k)
+        ]
+        packed_q = [pack_rank_keys(d, bits) for d in dels]
+        n_cols = len(packed_q[0])
+        flat_q = [
+            jnp.stack([packed_q[j][ci] for j in range(k)]).reshape(-1)
+            for ci in range(n_cols)
+        ]
+        pos = lex_searchsorted(
+            psorted, np_real, flat_q, np_pad.bit_length() + 1
+        )
+        safe = jnp.clip(pos, 0, jnp.maximum(np_real - 1, 0))
+        eq = pos < np_real
+        for sc, qc in zip(psorted, flat_q):
+            eq = eq & (jnp.take(sc, safe) == qc)
+        found = eq.reshape(k, n_loc) & valid_loc[None, :]
+        rows = jnp.take(porder, safe).reshape(k, n_loc)
+    d = jnp.take(pcnts, rows.reshape(-1)).reshape(k, n_loc)
+    miss = jnp.sum(valid_loc[None, :] & ~found, dtype=jnp.int32)
+    if first:
+        ok = found
+    else:
+        np_pad = porder.shape[0]
+        oks = []
+        for j in range(k):
+            ok_j = found[j]
+            for e in range(k):
+                if e == j:
+                    continue
+                jp = j - (e < j)
+                pidx = jp * np_pad + rows[e]
+                ok_j = (
+                    ok_j
+                    & jnp.take(prev_surv, pidx)
+                    & frac_less24(d[e], jnp.take(prev_d, pidx), cnts, d[j])
+                )
+            oks.append(ok_j)
+        ok = jnp.stack(oks)
+    # Merge: per-shard [k, N/S] survivor blocks cross the axis bit-packed
+    # (N/S is a multiple of 8 by the dispatch layer's 8·S row padding, so
+    # per-block MSB-first packing concatenates into exactly the j-major
+    # bitmask the single-chip kernel emits); denominators go as int32.
+    ok_full = _unpack_bits_msb(
+        _tiled_all_gather(pack_bits_msb(ok), axis_name, 1)
+    )
+    d_full = _tiled_all_gather(d, axis_name, 1)  # [k, N_pad]
+    miss = lax.psum(miss, axis_name)
+    miss_u = miss.astype(jnp.uint32)
+    packed = jnp.concatenate(
+        [
+            pack_bits_msb(ok_full.reshape(-1)),
+            jnp.stack(
+                [(miss_u >> (8 * i)) & 0xFF for i in range(4)]
+            ).astype(jnp.uint8),
+        ]
+    )
+    # The one table exchange ("parent keys replicated via one all_gather
+    # at upload"): rows arrive sharded over the link, the full table is
+    # reassembled once over ICI, and the lex sort for the NEXT level's
+    # search runs replicated on it — identical on every shard.
+    mat_full = _tiled_all_gather(mat, axis_name, 0)  # [N_pad, k]
+    cnts_full = _tiled_all_gather(cnts, axis_name, 0)  # [N_pad]
+    valid_full = jnp.arange(n_pad, dtype=jnp.int32) < n_real.astype(
+        jnp.int32
+    )
+    scols = [
+        jnp.where(valid_full, c, jnp.uint32(0xFFFFFFFF))
+        for c in pack_rank_keys(mat_full, bits)
+    ]
+    srt = lax.sort(
+        tuple(scols) + (jnp.arange(n_pad, dtype=jnp.int32),),
+        num_keys=len(scols),
+    )
+    return (
+        packed,
+        tuple(srt[:-1]),
+        srt[-1],
+        d_full.reshape(-1),
+        ok_full.reshape(-1),
+        mat_full,
+        cnts_full,
+    )
+
+
+def rule_shard_bytes(k: int, n_pad: int, n_shards: int) -> tuple:
+    """(gather_bytes, psum_bytes) payload model of one sharded rule-level
+    dispatch — the per-level comms accounting rules/gen.py records next
+    to the mining collectives: the packed survivor-mask + denominator
+    block exchanges and the table reassembly land ``S×`` their payload
+    (every shard receives every block), the miss counter is one int32
+    psum."""
+    mask_b = k * (n_pad // 8)
+    den_b = 4 * k * n_pad
+    table_b = 4 * n_pad * k + 4 * n_pad  # mat_full + cnts_full
+    return n_shards * (mask_b + den_b + table_b), 4 * n_shards
+
+
+# ---------------------------------------------------------------------------
+# Device-resident priority scan (ISSUE 8 tentpole, part b): the sorted
+# rule table is BUILT on device from the join kernels' resident state —
+# the 16M-rule table never round-trips the host after the level-table
+# upload — with the confidence-descending order reproduced exactly by a
+# 49-bit rational sort key (the frac_less24 spacing argument, turned from
+# a comparator into an order-embedding integer), and the first-match scan
+# sharded over the mesh: rules rank-strided across shards, baskets
+# micro-batched and replicated, one pmin/pmax exchange merges the
+# per-shard argmin-over-rank.
+
+
+def conf_sort_keys(num: jnp.ndarray, den: jnp.ndarray) -> tuple:
+    """Exact 49-bit order embedding of the confidence ``num/den``
+    (positive int counts < 2^24, ``num <= den`` — support monotonicity
+    guarantees it for every rule): ``key = floor(num · 2^48 / den)``,
+    computed by 8-bit long division (six steps; every intermediate
+    ``r << 8`` fits uint32 because ``r < den < 2^24``), returned as
+    ``(hi, lo)`` uint32 holding bits [48..24] and [23..0].
+
+    Exactness (the frac_less24 spacing argument, reused as a KEY instead
+    of a comparator): two distinct rationals in (0, 1] with denominators
+    < 2^24 differ by more than 2^-48, so their keys differ by more than
+    1 and floor preserves strict order; equal rationals share the key.
+    The host's f64 sort order is the rational order (distinct rationals
+    round to distinct doubles at this spacing), so sorting by this key
+    descending IS the host ``np.lexsort((pr, -conf))`` confidence
+    component, bit-for-bit."""
+    n = num.astype(jnp.uint32)
+    d = jnp.maximum(den.astype(jnp.uint32), jnp.uint32(1))
+    q0 = n // d  # integer part: 1 iff num == den (conf <= 1 by gate)
+    r = n - q0 * d
+    frac_hi = jnp.zeros_like(n)
+    for _ in range(3):
+        r = r << 8
+        qi = r // d
+        r = r - qi * d
+        frac_hi = (frac_hi << 8) | qi
+    frac_lo = jnp.zeros_like(n)
+    for _ in range(3):
+        r = r << 8
+        qi = r // d
+        r = r - qi * d
+        frac_lo = (frac_lo << 8) | qi
+    return (q0 << 24) | frac_hi, frac_lo
+
+
+def rule_scan_build(
+    level_arrays,  # per level: (mat_full, cnts_full, d_flat, surv_flat)
+    offsets: jnp.ndarray,  # [L] int32 — emission offset per level (traced)
+    pr: jnp.ndarray,  # [F] int32 — consequent tie-priority per rank
+    *,
+    ks,  # static tuple of level sizes k
+    r_pad: int,
+    k_max: int,
+    zcol: int,
+    n_shards: int,
+):
+    """Build the priority-sorted compact scan table ON DEVICE from the
+    rule-join kernels' resident per-level state (one dispatch, once per
+    recommender instance): compact each level's j-major survivors to
+    their emission slots (a cumsum over the resident survivor flags —
+    the slot index IS the host pipeline's emission ordinal, which is
+    exactly np.lexsort's stability tie-break), derive each rule's
+    antecedent columns / size / consequent / (numerator, denominator)
+    from the resident tables, sort once by ``(padding, conf desc via
+    conf_sort_keys, consequent priority, emission ordinal)`` — the
+    host sort_rule_arrays order, key for key — and emit the table in
+    SHARD-MAJOR rank-strided layout (out row ``s·R/S + i`` = sorted
+    rank ``i·S + s``) so a P(AXIS) placement gives every shard the
+    rank-interleaved slice the strided scan kernel expects.
+
+    Returns ``(ant_cols [R_pad, k_max], ant_size [R_pad],
+    consequent [R_pad])`` — padding rows never match (size > any
+    basket); antecedent padding points at the zero column ``zcol``."""
+    ant = jnp.full((r_pad, k_max), jnp.int32(zcol))
+    size = jnp.full((r_pad,), jnp.int32(zcol + 2))  # > any basket length
+    cons = jnp.zeros((r_pad,), jnp.int32)
+    num = jnp.zeros((r_pad,), jnp.uint32)
+    den = jnp.ones((r_pad,), jnp.uint32)  # pad key = 0/1 -> sorts last
+    for li, (k, (mat, cnts, d_flat, surv)) in enumerate(
+        zip(ks, level_arrays)
+    ):
+        n_pad_l = mat.shape[0]
+        t = k * n_pad_l
+        sv = surv.astype(jnp.int32)
+        slot = jnp.where(
+            surv, offsets[li] + jnp.cumsum(sv) - 1, jnp.int32(r_pad)
+        )  # r_pad = out of bounds, dropped by the scatter
+        j = jnp.arange(t, dtype=jnp.int32) // n_pad_l
+        rr = jnp.arange(t, dtype=jnp.int32) % n_pad_l
+        ccols = jnp.arange(k - 1, dtype=jnp.int32)
+        gcols = ccols[None, :] + (ccols[None, :] >= j[:, None]).astype(
+            jnp.int32
+        )
+        ant_rows = mat[rr[:, None], gcols]  # [t, k-1] col-j-deleted rows
+        ant = ant.at[slot, : k - 1].set(ant_rows, mode="drop")
+        size = size.at[slot].set(jnp.int32(k - 1), mode="drop")
+        cons = cons.at[slot].set(mat[rr, j], mode="drop")
+        num = num.at[slot].set(
+            jnp.take(cnts, rr).astype(jnp.uint32), mode="drop"
+        )
+        den = den.at[slot].set(d_flat.astype(jnp.uint32), mode="drop")
+    khi, klo = conf_sort_keys(num, den)
+    # Descending confidence = ascending bitwise complement; padding rows
+    # (num=0 -> key 0 -> complement max) sort to the tail behind every
+    # real rule (real keys are >= 2^24: floor(n·2^48/d) with d < 2^24).
+    pr_cons = jnp.take(pr, jnp.clip(cons, 0, pr.shape[0] - 1))
+    idx = jnp.arange(r_pad, dtype=jnp.int32)
+    srt = lax.sort((~khi, ~klo, pr_cons, idx), num_keys=4)
+    perm = srt[-1]
+
+    def strided(x):
+        # Shard-major rank interleave: out[s·R/S + i] = sorted[i·S + s].
+        resh = x.reshape((r_pad // n_shards, n_shards) + x.shape[1:])
+        return jnp.swapaxes(resh, 0, 1).reshape(x.shape)
+
+    return (
+        strided(jnp.take(ant, perm, axis=0)),
+        strided(jnp.take(size, perm)),
+        strided(jnp.take(cons, perm)),
+    )
+
+
+def local_strided_match_scan(
+    baskets: jnp.ndarray,  # [mb, F] int8 — one micro-batch, REPLICATED
+    basket_len: jnp.ndarray,  # [mb] int32 (0 on padding rows)
+    ant_cols: jnp.ndarray,  # [R_loc, K] int32 — THIS shard's strided slice
+    ant_size: jnp.ndarray,  # [R_loc] int32
+    consequent: jnp.ndarray,  # [R_loc] int32
+    *,
+    chunk: int,
+    n_shards: int,
+    axis_name: str,
+):
+    """Sharded first-match over the rank-strided resident table: each
+    shard scans its R/S rule slice (local row i = global rank
+    ``i·S + s``, so every shard participates in the top-confidence
+    chunks and the early exit fires at the same table depth as the
+    replicated scan), keeping a per-basket argmin over GLOBAL rank;
+    one ``pmin`` merges the shard minima — later local chunks hold only
+    larger ranks, so a shard may stop as soon as every real basket has
+    some local match without affecting the merged minimum — and one
+    ``pmax`` selects the winning shard's consequent (global ranks are
+    unique across shards: rank mod S identifies the owner).  Returns
+    ``(best_rank [mb], consequent-or-minus-1 [mb], chunks_run ())``,
+    identical across shards."""
+    r_loc = ant_cols.shape[0]
+    n_chunks = r_loc // chunk
+    s = lax.axis_index(axis_name).astype(jnp.int32)
+    real = basket_len > 0
+
+    def cond(state):
+        c, best = state
+        return (c < n_chunks) & jnp.any(real & (best == jnp.int32(NO_MATCH)))
+
+    def body(state):
+        c, best = state
+        base = c * chunk
+        best = local_first_match_chunk(
+            baskets,
+            basket_len,
+            lax.dynamic_slice_in_dim(ant_cols, base, chunk, 0),
+            lax.dynamic_slice_in_dim(ant_size, base, chunk, 0),
+            lax.dynamic_slice_in_dim(consequent, base, chunk, 0),
+            base * jnp.int32(n_shards) + s,
+            best,
+            step=n_shards,
+        )
+        return c + 1, best
+
+    best0 = compat.pcast(
+        jnp.full(baskets.shape[0], NO_MATCH, dtype=jnp.int32),
+        (axis_name,),
+        to="varying",
+    )
+    c, best = lax.while_loop(cond, body, (jnp.int32(0), best0))
+    best_g = lax.pmin(best, axis_name)
+    # The winner's consequent: only the owning shard's local best equals
+    # the global minimum (ranks are unique mod S), so a masked pmax is
+    # an exact one-collective select.
+    local_row = jnp.clip(
+        (best - s) // jnp.int32(n_shards), 0, jnp.int32(r_loc - 1)
+    )
+    mine = (best == best_g) & (best < jnp.int32(NO_MATCH))
+    cons_l = jnp.where(mine, jnp.take(consequent, local_row), jnp.int32(-1))
+    cons_g = lax.pmax(cons_l, axis_name)
+    return best_g, cons_g, lax.pmax(c, axis_name)
+
+
+def make_strided_first_match_scan(mesh: Mesh, chunk: int, n_shards: int):
+    """shard_map-wrapped, jitted strided-table scan: the rule table
+    sharded over the mesh axis (R/S rows per shard — the table's HBM
+    footprint no longer replicates), basket micro-batches replicated,
+    outputs replicated after the pmin/pmax exchange."""
+    import functools
+
+    return jax.jit(
+        compat.shard_map(
+            functools.partial(
+                local_strided_match_scan,
+                chunk=chunk,
+                n_shards=n_shards,
+                axis_name=AXIS,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(None, None),
+                P(None),
+                P(AXIS, None),
+                P(AXIS),
+                P(AXIS),
+            ),
+            out_specs=(P(), P(), P()),
+        )
+    )

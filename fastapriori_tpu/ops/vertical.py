@@ -94,19 +94,32 @@ def weight_bit_planes(
     return planes, [1 << b for b in range(b_planes)]
 
 
+# Below this many (item, lane) runs the thread-pool split of the
+# reduceat pass costs more in pool setup than it saves.
+_ARENA_THREAD_MIN_RUNS = 1 << 16
+
+
 def build_tid_arena_csr(
     indices: np.ndarray,
     offsets: np.ndarray,
     num_items: int,
     txn_multiple: int = 32,
     item_multiple: int = 128,
+    n_threads: int = 1,
 ) -> Tuple[np.ndarray, int, int]:
     """Build the dense tid-lane arena from the basket CSR: returns
     ``(arena uint32[f_pad+1, NL], f_pad, t_pad)`` with
     ``t_pad = pad_axis(T, lcm(txn_multiple, 32))`` and row ``f_pad`` the
     all-ones AND identity.  One sorted segment-reduce builds every
     item's lanes (``np.bitwise_or.reduceat`` over the (item, lane) runs
-    — C speed, no per-basket Python loop)."""
+    — C speed, no per-basket Python loop).
+
+    ``n_threads > 1`` splits the reduceat pass over the same host
+    thread pool the pipelined ingest's segmented pass-1 scan uses
+    (FA_INGEST_THREADS, models/apriori.py): runs are independent and
+    write disjoint arena slots, so the split is a run-aligned partition
+    of the sorted stream — identical output (OR is associative and each
+    run stays whole), the PR-7 "single-threaded arena build" residue."""
     import math
 
     t = len(offsets) - 1
@@ -126,9 +139,37 @@ def build_tid_arena_csr(
         key = indices.astype(np.int64) * nl + word
         order = np.argsort(key, kind="stable")
         skey = key[order]
+        bit_sorted = bit[order]
         uniq, start = np.unique(skey, return_index=True)
-        words = np.bitwise_or.reduceat(bit[order], start)
-        arena.reshape(-1)[uniq] = words
+        flat = arena.reshape(-1)
+        n_runs = len(uniq)
+        if n_threads > 1 and n_runs >= _ARENA_THREAD_MIN_RUNS:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # Run-aligned partition: thread j owns runs [lo, hi) — its
+            # reduceat sees every element of its runs (the next
+            # thread's first run starts at start[hi]) and its scatter
+            # targets are disjoint uniq slots, so threads never race.
+            bounds = [
+                (n_runs * j) // n_threads for j in range(n_threads + 1)
+            ]
+            end = np.concatenate(
+                [start[1:], np.asarray([len(skey)], dtype=start.dtype)]
+            )
+
+            def _reduce(j):
+                lo, hi = bounds[j], bounds[j + 1]
+                if lo >= hi:
+                    return
+                base = start[lo]
+                flat[uniq[lo:hi]] = np.bitwise_or.reduceat(
+                    bit_sorted[base : end[hi - 1]], start[lo:hi] - base
+                )
+
+            with ThreadPoolExecutor(n_threads) as pool:
+                list(pool.map(_reduce, range(n_threads)))
+        else:
+            flat[uniq] = np.bitwise_or.reduceat(bit_sorted, start)
     arena[f_pad, :] = ONES_WORD
     return arena, f_pad, t_pad
 

@@ -1288,12 +1288,20 @@ class DeviceContext:
         )
 
     # -- device-resident rule generation (rules/gen.py device engine) ------
+    @staticmethod
+    def _fire_rule_upload():
+        """The ONE ``rules.upload`` failpoint site shared by the three
+        rule-table placements (device-0 / row-sharded / replicated):
+        a single label keeps arming one-shot across engines and the
+        ledger unambiguous about which phase the injection hit."""
+        failpoints.fire("rules.upload")
+
     def device0_put(self, x: np.ndarray) -> jax.Array:
         """Single-device placement for the rule-generation tables: the
         join/prune kernels are gather/sort work with no matmul to shard,
         and the rule phase runs after mining on one chip — device 0 of
         the mesh keeps them off the other shards' HBM."""
-        failpoints.fire("rules.upload")
+        self._fire_rule_upload()
         # lint: host-data -- numpy table upload, no device fetch
         return jax.device_put(x, self.mesh.devices.flat[0])
 
@@ -1309,6 +1317,126 @@ class DeviceContext:
                 functools.partial(
                     rule_level_kernel, k=k, bits=bits, first=first
                 )
+            )
+        return self._fns[key]
+
+    # -- sharded rule generation + device-resident priority scan -----------
+    def shard_rule_rows(self, x: np.ndarray) -> jax.Array:
+        """Row-sharded placement of a rule-phase table (the query rows of
+        the sharded join — parent keys replicate from these via the
+        in-kernel all_gather; same ``rules.upload`` failpoint site as the
+        single-chip upload)."""
+        self._fire_rule_upload()
+        assert x.shape[0] % self.txn_shards == 0, (x.shape, self.txn_shards)
+        spec = P(AXIS, *([None] * (x.ndim - 1)))
+        # lint: host-data -- numpy table upload, no device fetch
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    def replicate_rule_table(self, x: np.ndarray) -> jax.Array:
+        """Replicated placement for the small rule-phase side tables
+        (1-itemset counts, consequent priorities) — same failpoint site
+        as the sharded upload."""
+        self._fire_rule_upload()
+        return self.replicate(x)
+
+    def rule_level_join_sharded(self, k: int, bits: int, first: bool):
+        """Jitted shard_map-wrapped sharded rule join (ops/contain.py
+        rule_level_shard_kernel): query rows sharded over the txn axis,
+        parent state replicated, outputs replicated after the in-kernel
+        mask/denominator/table exchanges.  Mesh-polymorphic: a 1-shard
+        mesh reproduces the single-chip kernel bit for bit."""
+        key = ("rule_join_shard", k, bits, first)
+        if key not in self._fns:
+            from fastapriori_tpu.ops.contain import rule_level_shard_kernel
+
+            mesh = self.mesh
+            per = 32 // bits
+            n_pcols = 1 if first else max(1, -(-(k - 1) // per))
+            n_scols = max(1, -(-k // per))
+            fn = functools.partial(
+                rule_level_shard_kernel,
+                k=k,
+                bits=bits,
+                first=first,
+                axis_name=AXIS,
+                n_shards=self.txn_shards,
+            )
+            in_specs = (
+                P(AXIS, None),  # mat (query rows sharded)
+                P(AXIS),  # cnts
+                P(),  # n_real
+                tuple(P(None) for _ in range(n_pcols)),  # psorted
+                P(None),  # porder
+                P(None),  # pcnts
+                P(),  # np_real
+                P(None),  # prev_surv
+                P(None),  # prev_d
+            )
+            out_specs = (
+                P(None),  # packed mask + miss
+                tuple(P(None) for _ in range(n_scols)),  # skeys
+                P(None),  # order
+                P(None),  # d_flat
+                P(None),  # surv_flat
+                P(None, None),  # mat_full
+                P(None),  # cnts_full
+            )
+            self._fns[key] = jax.jit(
+                compat.shard_map(
+                    fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+                )
+            )
+        return self._fns[key]
+
+    def rule_scan_build(
+        self, ks, n_pads, r_pad: int, k_max: int, zcol: int
+    ):
+        """Jitted device-side scan-table build (ops/contain.py
+        rule_scan_build): consumes the join kernels' resident per-level
+        state, emits the priority-sorted compact table SHARDED over the
+        txn axis (rank-strided rows) via ``out_shardings`` — the one
+        resharding dispatch between rule generation and serving.  Cached
+        per static (level shapes, table bucket, mesh) profile; survivor
+        offsets arrive traced so repeat mines with equal buckets reuse
+        the compile."""
+        key = ("rule_scan_build", tuple(ks), tuple(n_pads), r_pad, k_max,
+               zcol)
+        if key not in self._fns:
+            from fastapriori_tpu.ops.contain import rule_scan_build
+
+            n_shards = self.txn_shards
+            rows = NamedSharding(self.mesh, P(AXIS, None))
+            vec = NamedSharding(self.mesh, P(AXIS))
+
+            def _build(level_arrays, offsets, pr):
+                return rule_scan_build(
+                    level_arrays,
+                    offsets,
+                    pr,
+                    ks=tuple(ks),
+                    r_pad=r_pad,
+                    k_max=k_max,
+                    zcol=zcol,
+                    n_shards=n_shards,
+                )
+
+            self._fns[key] = jax.jit(
+                _build, out_shardings=(rows, vec, vec)
+            )
+        return self._fns[key]
+
+    def strided_first_match_scan(self, chunk: int):
+        """The sharded-resident-table priority scan (ops/contain.py
+        local_strided_match_scan); returns ``(best_rank, consequent,
+        chunks_run)`` per micro-batch."""
+        key = ("strided_match_scan", chunk)
+        if key not in self._fns:
+            from fastapriori_tpu.ops.contain import (
+                make_strided_first_match_scan,
+            )
+
+            self._fns[key] = make_strided_first_match_scan(
+                self.mesh, chunk, self.txn_shards
             )
         return self._fns[key]
 

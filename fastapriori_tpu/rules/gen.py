@@ -63,6 +63,93 @@ def rule_engine_from_env() -> Optional[str]:
 _DEVICE_COUNT_CAP = 1 << 24
 
 
+def resolve_rule_shards(context, config) -> int:
+    """Phase-2 shard count over the txn mesh axis (ISSUE 8):
+    ``FA_RULE_SHARDS`` (strict, utils/env.py) over
+    ``config.rule_shards``, both with 0 = auto.  Auto uses the FULL txn
+    axis when the mesh is eligible (single process, no cand axis — the
+    sharded kernel's exchanges are 1-D-txn collectives); 1 pins phase 2
+    to device 0 (the PR-4 engine); any other explicit value must equal
+    the mesh's txn axis (a silent partial-mesh run would make the
+    recorded shard count a lie), and an explicit multi-shard request on
+    an ineligible mesh is an InputError rather than a silent pin."""
+    from fastapriori_tpu.utils.env import env_int
+
+    req = env_int("FA_RULE_SHARDS", 0, minimum=0)
+    src = "FA_RULE_SHARDS"
+    if req == 0:
+        src = "MinerConfig.rule_shards"
+        req = int(getattr(config, "rule_shards", 0) or 0) if config else 0
+        if req < 0:
+            raise InputError(
+                f"MinerConfig.rule_shards={req} is out of range: use 0 "
+                "(auto), 1 (single-device), or the mesh's txn shard count"
+            )
+    import jax
+
+    eligible = (
+        context is not None
+        and jax.process_count() == 1
+        and context.cand_shards == 1
+    )
+    if req == 0:
+        return context.txn_shards if eligible else 1
+    if req == 1:
+        return 1
+    if not eligible:
+        raise InputError(
+            f"{src}={req} needs a single-process 1-D txn mesh "
+            "(multi-process rule generation and cand meshes run the "
+            "device-0 engine; use 0/1 or unset)"
+        )
+    if req != context.txn_shards:
+        raise InputError(
+            f"{src}={req} does not match the mesh's txn axis "
+            f"({context.txn_shards} shards): phase 2 shards over the "
+            "existing mesh, it cannot carve a sub-mesh"
+        )
+    return req
+
+
+class DeviceRuleState:
+    """Device-resident phase-2 state the sharded rule engine leaves
+    behind for the recommender's scan-table build (ISSUE 8 part b):
+    per-level replicated ``(mat_full, cnts_full, d_flat, surv_flat)``
+    arrays plus the host-side survivor census — everything
+    ``ops/contain.py rule_scan_build`` needs to assemble the
+    priority-sorted compact table ON DEVICE, so the rule table never
+    crosses the host link again after the level-table upload."""
+
+    def __init__(self):
+        self.ready = False
+        self.shards = 1
+        self.ks: list = []  # level sizes k
+        self.n_pads: list = []
+        self.arrays: list = []  # (mat_full, cnts_full, d_flat, surv_flat)
+        self.offsets: list = []  # emission offset per level
+        self.total = 0  # surviving rule count R
+        self.gather_bytes = 0
+        self.psum_bytes = 0
+
+    def populate(self, shards, levels, offsets, total, gather_bytes,
+                 psum_bytes):
+        self.shards = shards
+        self.ks = [lv[0] for lv in levels]
+        self.n_pads = [lv[1] for lv in levels]
+        self.arrays = [lv[2] for lv in levels]
+        self.offsets = list(offsets)
+        self.total = int(total)
+        self.gather_bytes = int(gather_bytes)
+        self.psum_bytes = int(psum_bytes)
+        self.ready = True
+
+    def release(self):
+        """Drop the device references (the scan table, once built, is
+        the only resident consumer)."""
+        self.arrays = []
+        self.ready = False
+
+
 def _raw_rule_count(mats: Dict[int, Tuple[np.ndarray, np.ndarray]]) -> int:
     """Raw (pre-prune) rule count: every k-itemset emits k rules."""
     return sum(
@@ -260,6 +347,7 @@ def rule_arrays_from_tables(
     context=None,
     config=None,
     metrics=None,
+    scan_state: Optional[DeviceRuleState] = None,
 ) -> List[RuleArrays]:
     """Matrix-form rule generation + dominance prune: surviving rules as
     ``(antecedent int32 [N, w], consequent int32 [N], confidence f64
@@ -273,10 +361,26 @@ def rule_arrays_from_tables(
     key sorted gathers on the accelerator (ops/contain.py
     rule_level_kernel, one dispatch per level), bit-identical to this
     host path — which remains the differential oracle and the automatic
-    fallback below the size threshold."""
+    fallback below the size threshold.  On an eligible multi-device
+    mesh the joins shard over the txn axis (:func:`resolve_rule_shards`
+    / FA_RULE_SHARDS, ops/contain.py rule_level_shard_kernel);
+    ``scan_state`` (a :class:`DeviceRuleState`) additionally keeps the
+    per-level device state resident for the recommender's on-device
+    scan-table build."""
     engine = _pick_rule_engine(mats, context, config)
     if engine == "device":
-        return _rule_arrays_device(mats, context, metrics)
+        shards = resolve_rule_shards(context, config)
+        # The sharded kernel always splits rows over the FULL txn axis
+        # (shard_map owns the placement), so the resident-scan state is
+        # only kept when the resolved shard count covers the mesh — a
+        # rule_shards=1 pin on a multi-device mesh runs the device-0
+        # engine and the recommender's host-built-table scan instead
+        # (the 8·S row-padding layout would not match otherwise).
+        if shards != context.txn_shards:
+            scan_state = None
+        return _rule_arrays_device(
+            mats, context, metrics, shards=shards, state=scan_state
+        )
     return _rule_arrays_host(mats)
 
 
@@ -422,7 +526,11 @@ def _closure_error(k: int) -> InputError:
 
 
 def _rule_arrays_device(
-    mats: Dict[int, Tuple[np.ndarray, np.ndarray]], ctx, metrics=None
+    mats: Dict[int, Tuple[np.ndarray, np.ndarray]],
+    ctx,
+    metrics=None,
+    shards: int = 1,
+    state: Optional[DeviceRuleState] = None,
 ) -> List[RuleArrays]:
     """Device engine for :func:`rule_arrays_from_tables` (ISSUE 4
     tentpole): upload each level's itemset table ONCE, run the k→(k-1)
@@ -434,13 +542,24 @@ def _rule_arrays_device(
     path (parallel/mesh.py gather_level_counts_start).  Confidences are
     then the SAME host f64 divisions of the same ints the host engine
     performs — bit-identical output, pinned by the differential suite
-    (tests/test_rules_device.py)."""
+    (tests/test_rules_device.py).
+
+    ``shards > 1`` (or a ``state`` to fill) runs the SHARDED join engine
+    (ISSUE 8: ops/contain.py rule_level_shard_kernel — query rows split
+    over the txn mesh axis, parent keys replicated via one in-kernel
+    all_gather, survivor blocks merged with the packed-mask exchange) —
+    still one dispatch per level, bit-identical output, per-level
+    psum/gather bytes on the metrics event like the mining collectives;
+    ``state`` keeps the per-level device arrays resident for the
+    recommender's on-device scan-table build."""
     import time
 
     import jax.numpy as jnp
 
-    from fastapriori_tpu.ops.contain import rule_key_bits
+    from fastapriori_tpu.ops.bitmap import pad_axis as _pad_axis
+    from fastapriori_tpu.ops.contain import rule_key_bits, rule_shard_bytes
 
+    sharded = shards > 1 or state is not None
     t0 = time.perf_counter()
     f = 1 + max(
         (int(mat.max()) for mat, _ in mats.values() if mat.size), default=0
@@ -450,6 +569,9 @@ def _rule_arrays_device(
     if not ks:
         return []
     per_level: List[dict] = []
+    comms: List[dict] = []
+    gather_total = 0
+    psum_total = 0
     prev_keys = None  # (skeys tuple, order) — previous table, sorted
     prev_cnts_dev = None  # previous level's padded counts (= pcnts)
     prev_rules = None  # (surv_flat, d_flat) — previous RULE level
@@ -459,20 +581,32 @@ def _rule_arrays_device(
             raise _closure_error(k)
         mat, cnts = mats[k]
         n = mat.shape[0]
-        n_pad = max(8, _next_pow2(n))
+        if sharded:
+            # Rows divisible by 8·S so the per-shard survivor blocks
+            # pack to whole bytes (the mask exchange's layout contract).
+            n_pad = _pad_axis(_next_pow2(max(n, 8)), 8 * shards)
+        else:
+            n_pad = max(8, _next_pow2(n))
         mat_p = np.zeros((n_pad, k), np.int32)
         mat_p[:n] = mat
         cnts_p = np.ones(n_pad, np.int32)
         cnts_p[:n] = cnts
-        mat_dev = ctx.device0_put(mat_p)
-        cnts_dev = ctx.device0_put(cnts_p)
+        if sharded:
+            mat_dev = ctx.shard_rule_rows(mat_p)
+            cnts_dev = ctx.shard_rule_rows(cnts_p)
+        else:
+            mat_dev = ctx.device0_put(mat_p)
+            cnts_dev = ctx.device0_put(cnts_p)
         first = k == 2
         if first:
             # Parents are the 1-itemsets: an identity table — the kernel
             # skips the search, so only the counts upload is real.
-            pcnts_dev = ctx.device0_put(
-                # lint: host-data -- 1-itemset counts are host numpy
-                np.asarray(mats[1][1], dtype=np.int32)
+            # lint: host-data -- 1-itemset counts are host numpy
+            p1 = np.asarray(mats[1][1], dtype=np.int32)
+            pcnts_dev = (
+                ctx.replicate_rule_table(p1)
+                if sharded
+                else ctx.device0_put(p1)
             )
             dummy_u32 = jnp.zeros(1, jnp.uint32)
             psorted = (dummy_u32,)
@@ -485,8 +619,11 @@ def _rule_arrays_device(
             pcnts_dev = prev_cnts_dev
             prev_surv, prev_d = prev_rules
             np_real = prev_n
-        fn = ctx.rule_level_join(k, bits, first)
-        packed, skeys, order, d_flat, surv_flat = fn(
+        if sharded:
+            fn = ctx.rule_level_join_sharded(k, bits, first)
+        else:
+            fn = ctx.rule_level_join(k, bits, first)
+        out = fn(
             mat_dev,
             cnts_dev,
             jnp.int32(n),
@@ -497,6 +634,26 @@ def _rule_arrays_device(
             prev_surv,
             prev_d,
         )
+        if sharded:
+            packed, skeys, order, d_flat, surv_flat, mat_full, cnts_full = (
+                out
+            )
+            # Non-blocking audited fetch: the j-major survivor bitmask
+            # (+ 4-byte miss count) crosses the link while the next
+            # levels dispatch.  Distinct site from the single-chip
+            # engine so injection/coverage track the sharded path.
+            fetch = retry.fetch_async(packed, "rule_mask_shard")
+            g_b, p_b = rule_shard_bytes(k, n_pad, shards)
+            comms.append(
+                {"k": k, "gather_bytes": g_b, "psum_bytes": p_b}
+            )
+            gather_total += g_b
+            psum_total += p_b
+        else:
+            packed, skeys, order, d_flat, surv_flat = out
+            cnts_full = cnts_dev
+            mat_full = None
+            fetch = retry.fetch_async(packed, "rule_mask")
         per_level.append(
             {
                 "k": k,
@@ -505,14 +662,14 @@ def _rule_arrays_device(
                 "mat": mat,
                 "cnts": cnts,
                 "d_dev": d_flat,
-                # Non-blocking audited fetch: the j-major survivor
-                # bitmask (+ 4-byte miss count) crosses the link while
-                # the next levels dispatch.
-                "fetch": retry.fetch_async(packed, "rule_mask"),
+                "surv_dev": surv_flat,
+                "mat_dev": mat_full,
+                "cnts_dev": cnts_full,
+                "fetch": fetch,
             }
         )
         prev_keys = (skeys, order)
-        prev_cnts_dev = cnts_dev
+        prev_cnts_dev = cnts_full
         prev_rules = (surv_flat, d_flat)
         prev_n = n
     dispatch_ms = (time.perf_counter() - t0) * 1e3
@@ -521,6 +678,8 @@ def _rule_arrays_device(
     # collect each survivor's flat position for the ONE denominator
     # gather dispatch + fetch (u24: counts < 2^24 by the engine gate).
     pend = []
+    offsets = []
+    total_surv = 0
     for lv in per_level:
         out_b = lv["fetch"].result()
         miss = int.from_bytes(out_b[-4:].tobytes(), "little")
@@ -539,6 +698,10 @@ def _rule_arrays_device(
         lv["surv"] = surv
         rows = [np.flatnonzero(surv[j]) for j in range(lv["k"])]
         lv["rows"] = rows
+        # Emission offsets for the device scan-table build: slot base of
+        # this level's j-major survivor stream.
+        offsets.append(total_surv)
+        total_surv += int(surv.sum())
         pos = np.concatenate(
             [j * lv["n_pad"] + r for j, r in enumerate(rows)]
         ) if any(r.size for r in rows) else np.empty(0, np.int64)
@@ -576,15 +739,38 @@ def _rule_arrays_device(
                 np.concatenate(confs) if confs else np.zeros(0),
             )
         )
+    if state is not None and sharded:
+        state.populate(
+            shards=shards,
+            levels=[
+                (
+                    lv["k"],
+                    lv["n_pad"],
+                    (lv["mat_dev"], lv["cnts_dev"], lv["d_dev"],
+                     lv["surv_dev"]),
+                )
+                for lv in per_level
+            ],
+            offsets=offsets,
+            total=total_surv,
+            gather_bytes=gather_total,
+            psum_bytes=psum_total,
+        )
     if metrics is not None:
         metrics.emit(
             "rule_gen_device",
             levels=len(per_level),
+            shards=shards if sharded else 1,
             dispatches=len(per_level) + (1 if have else 0),
             raw_rules=_raw_rule_count(mats),
             survivors=sum(int(c.size) for _, c, _ in out),
             dispatch_ms=round(dispatch_ms, 1),
             wall_ms=round((time.perf_counter() - t0) * 1e3, 1),
+            # Per-level mesh collective payloads (the mining phases'
+            # psum/gather-byte convention); empty on the 1-chip engine.
+            gather_bytes=gather_total,
+            psum_bytes=psum_total,
+            comms=comms,
         )
     return out
 
@@ -603,18 +789,22 @@ def _rules_from_tables(
 
 
 def gen_rule_arrays_levels(
-    levels, item_counts, context=None, config=None, metrics=None
+    levels, item_counts, context=None, config=None, metrics=None,
+    scan_state=None,
 ) -> List[RuleArrays]:
     """Matrix-form twin of :func:`gen_rules_levels` returning survivor
     ARRAYS (see rule_arrays_from_tables) — the production recommender
     path never builds per-rule Python objects.  ``context``/``config``
     opt into the device join engine (bit-identical; host stays the
-    oracle and the small-input fallback)."""
+    oracle and the small-input fallback); ``scan_state`` keeps the
+    sharded engine's per-level device state resident for the
+    recommender's on-device scan-table build."""
     return rule_arrays_from_tables(
         _level_tables(levels, item_counts),
         context=context,
         config=config,
         metrics=metrics,
+        scan_state=scan_state,
     )
 
 

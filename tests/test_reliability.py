@@ -145,7 +145,9 @@ FETCH_SITE_INVENTORY = [
     "fetch.level_counts",  # models/apriori.py end-of-mine count fetch
     "fetch.pair_sparse",  # parallel/mesh.py sparse-engine pair packed fetch
     "fetch.rule_mask",  # rules/gen.py device-engine survivor bitmask
+    "fetch.rule_mask_shard",  # rules/gen.py SHARDED-engine survivor bitmask
     "fetch.rule_counts",  # rules/gen.py surviving-denominator gather
+    "fetch.rec_match",  # models/recommender.py resident-scan result batch
     "fetch.vpair",  # parallel/mesh.py vertical-engine pair packed fetch
     "fetch.vpair_sparse",  # parallel/mesh.py vertical pair + union census
     "fetch.vlevel_bits",  # models/apriori.py vertical survivor bitmask

@@ -1,0 +1,464 @@
+"""Sharded rule generation + device-resident priority scan differential
+suite (ISSUE 8 tentpole).
+
+The sharded join engine (rules/gen.py `_rule_arrays_device(shards=S)` +
+ops/contain.py `rule_level_shard_kernel`) must be BIT-IDENTICAL to the
+host oracle — same rule set, byte-identical f64 confidences, same order
+— on every corpus shape at 1/2/4/8 virtual devices, still one dispatch
+per level, with per-level psum/gather bytes recorded; and the
+recommender's device-resident scan (conf-desc 49-bit key device sort +
+rank-strided sharded first-match) must recommend exactly what the host
+scan recommends at every device count.  CPU-only."""
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.parallel.mesh import DeviceContext
+from fastapriori_tpu.preprocess import preprocess
+from fastapriori_tpu.reliability import failpoints, ledger
+from fastapriori_tpu.rules.gen import (
+    DeviceRuleState,
+    _level_tables,
+    _rule_arrays_device,
+    _rule_arrays_host,
+    resolve_rule_shards,
+)
+from fastapriori_tpu.utils.logging import MetricsLogger
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+
+
+_CTXS = {}
+
+
+def _ctx(n):
+    # Module-lifetime contexts: a DeviceContext caches its compiled
+    # kernels, so the 4 device counts compile once each.
+    if n not in _CTXS:
+        _CTXS[n] = DeviceContext(num_devices=n)
+    return _CTXS[n]
+
+
+def _mined_tables(seed, min_support, n_txns=250, max_len=8, lines=None):
+    lines = lines if lines is not None else tokenized(
+        random_dataset(seed, n_txns=n_txns, max_len=max_len)
+    )
+    data = preprocess(lines, min_support)
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=min_support, engine="level", num_devices=1
+        )
+    )
+    levels = miner.mine_levels_raw(data)
+    return _level_tables(levels, data.item_counts), data
+
+
+def _assert_bit_identical(host, dev):
+    assert len(host) == len(dev)
+    for (ha, hc, hf), (da, dc, df) in zip(host, dev):
+        assert np.array_equal(ha, da)
+        assert np.array_equal(hc, dc)
+        assert hf.tobytes() == df.tobytes()
+
+
+def _wide_key_remap(mats, mult, off, f_big):
+    out = {}
+    for k, (mat, cnts) in mats.items():
+        if k == 1:
+            m = np.arange(f_big, dtype=np.int32)[:, None]
+            c = np.ones(f_big, dtype=np.int64)
+            c[mats[1][0][:, 0] * mult + off] = mats[1][1]
+            out[1] = (m, c)
+        else:
+            out[k] = ((mat * mult + off).astype(np.int32), cnts)
+    return out
+
+
+def _corpus(shape):
+    """The 4 corpus shapes of the differential matrix."""
+    if shape == "random":
+        return _mined_tables(0, 0.05)[0]
+    if shape == "deep":
+        lines = tokenized(
+            ["1 2 3 4 5 6"] * 50
+            + ["1 2 3 4 5"] * 30
+            + ["2 3 4 5 6"] * 20
+            + random_dataset(5, n_txns=60, max_len=6)
+        )
+        mats = _mined_tables(0, 0.05, lines=lines)[0]
+        assert max(mats) >= 5
+        return mats
+    if shape == "wide_keys":
+        return _wide_key_remap(
+            _mined_tables(2, 0.05)[0], 600, 3, 600 * 20 + 10
+        )
+    assert shape == "no_rules"
+    lines = tokenized(random_dataset(9, n_txns=60, max_len=3))
+    mats = _mined_tables(9, 0.9, lines=lines)[0]
+    assert max(mats) == 1
+    return mats
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+@pytest.mark.parametrize(
+    "shape", ["random", "deep", "wide_keys", "no_rules"]
+)
+def test_sharded_join_bit_exact(shape, n_dev):
+    mats = _corpus(shape)
+    host = _rule_arrays_host(mats)
+    state = DeviceRuleState()
+    dev = _rule_arrays_device(
+        mats, _ctx(n_dev), shards=n_dev, state=state
+    )
+    _assert_bit_identical(host, dev)
+    if host:
+        assert state.ready
+        assert state.shards == n_dev
+        assert state.total == sum(len(c) for _, c, _ in host)
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_sharded_join_records_comms_and_one_dispatch_per_level(n_dev):
+    mats = _corpus("random")
+    metrics = MetricsLogger(enabled=False)
+    _rule_arrays_device(mats, _ctx(n_dev), metrics=metrics, shards=n_dev)
+    ev = [
+        r for r in metrics.records if r.get("event") == "rule_gen_device"
+    ][-1]
+    n_levels = len([k for k in mats if k >= 2])
+    assert ev["shards"] == n_dev
+    # Still one join dispatch per level (+1 denominator gather).
+    assert ev["dispatches"] == n_levels + 1
+    assert ev["gather_bytes"] > 0 and ev["psum_bytes"] == 4 * n_dev * (
+        n_levels
+    )
+    assert [c["k"] for c in ev["comms"]] == sorted(
+        k for k in mats if k >= 2
+    )
+    assert all(c["gather_bytes"] > 0 for c in ev["comms"])
+
+
+def test_conf_sort_keys_reproduce_f64_order():
+    """The 49-bit rational key must order random confidences exactly as
+    the host's f64 division does (the frac_less24 spacing argument as an
+    order embedding), including num == den and equal-ratio ties."""
+    import jax.numpy as jnp
+
+    from fastapriori_tpu.ops.contain import conf_sort_keys
+
+    rng = np.random.default_rng(0)
+    den = rng.integers(1, (1 << 24) - 1, size=4096, dtype=np.int64)
+    num = np.minimum(
+        rng.integers(1, 1 << 24, size=4096, dtype=np.int64), den
+    )
+    # Force some exact ties and num == den cases.
+    num[:64] = den[:64]
+    num[64:128], den[64:128] = 3, 9
+    num[128:192], den[128:192] = 1, 3
+    hi, lo = conf_sort_keys(jnp.asarray(num), jnp.asarray(den))
+    hi = np.asarray(hi).astype(np.uint64)
+    lo = np.asarray(lo).astype(np.uint64)
+    key = (hi << np.uint64(24)) | lo
+    conf = num.astype(np.float64) / den.astype(np.float64)
+    # Pairwise order on a sample: key order must equal f64 order, with
+    # exact-rational ties (3/9 vs 1/3, num == den) agreeing too.
+    idx = rng.integers(0, 4096, size=(20000, 2))
+    a, b = idx[:, 0], idx[:, 1]
+    f64_lt = conf[a] < conf[b]
+    key_lt = key[a] < key[b]
+    assert np.array_equal(f64_lt, key_lt)
+    f64_eq = conf[a] == conf[b]
+    key_eq = key[a] == key[b]
+    assert np.array_equal(f64_eq, key_eq)
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+def test_resident_scan_matches_host_oracle(n_dev):
+    """End-to-end recommend over the sharded engine: the device-built,
+    rank-strided resident scan table must produce exactly the host
+    scan's recommendations at every device count."""
+    from fastapriori_tpu.models.recommender import AssociationRules
+
+    d_lines = tokenized(random_dataset(6, n_txns=250, max_len=8))
+    u_lines = tokenized(random_dataset(60, n_txns=200))
+    data = preprocess(d_lines, 0.05)
+    cfg = MinerConfig(
+        min_support=0.05, engine="level", num_devices=n_dev,
+        rule_engine="device",
+    )
+    miner = FastApriori(config=cfg)
+    levels = miner.mine_levels_raw(data)
+    rec = AssociationRules(
+        [], data.freq_items, data.item_to_rank, config=cfg,
+        context=miner.context, levels=levels,
+        item_counts=data.item_counts,
+    )
+    out_dev = rec.run(u_lines, use_device=True)
+    # The resident table was built on device from the join state.
+    assert rec._scan_table is not None
+    assert rec._rule_dev is None  # the host-built table path never ran
+    out_host = rec.run(u_lines, use_device=False)
+    assert out_dev == out_host
+    fm = [
+        r for r in rec.metrics.records
+        if r.get("event") == "first_match" and r.get("device")
+    ][-1]
+    assert fm["resident_table"] is True
+    assert fm["rule_table_host_bytes"] == 0
+    assert fm["scan_dispatches"] >= 1
+    assert fm["shards"] == n_dev
+
+
+def test_resident_scan_repeat_run_reuses_table():
+    """The second run() must not rebuild the table (the serving-tier
+    contract: uploads once, scans forever)."""
+    from fastapriori_tpu.models.recommender import AssociationRules
+
+    d_lines = tokenized(random_dataset(6, n_txns=250, max_len=8))
+    u_lines = tokenized(random_dataset(61, n_txns=80))
+    data = preprocess(d_lines, 0.05)
+    cfg = MinerConfig(
+        min_support=0.05, engine="level", num_devices=2,
+        rule_engine="device",
+    )
+    miner = FastApriori(config=cfg)
+    levels = miner.mine_levels_raw(data)
+    rec = AssociationRules(
+        [], data.freq_items, data.item_to_rank, config=cfg,
+        context=miner.context, levels=levels,
+        item_counts=data.item_counts,
+    )
+    first = rec.run(u_lines, use_device=True)
+    table = rec._scan_table
+    assert table is not None
+    assert rec.run(u_lines, use_device=True) == first
+    assert rec._scan_table is table  # same resident arrays
+    fm = [
+        r for r in rec.metrics.records
+        if r.get("event") == "first_match" and r.get("device")
+    ][-1]
+    assert fm["rule_upload_ms"] == 0.0  # no rebuild on the warm run
+
+
+# ---------------------------------------------------------------------------
+# FA_RULE_SHARDS / config.rule_shards resolution
+
+
+def test_rule_shards_auto_uses_full_mesh():
+    assert resolve_rule_shards(_ctx(4), MinerConfig()) == 4
+    assert resolve_rule_shards(_ctx(1), MinerConfig()) == 1
+
+
+def test_rule_shards_one_pins_single_device(monkeypatch):
+    monkeypatch.setenv("FA_RULE_SHARDS", "1")
+    assert resolve_rule_shards(_ctx(4), MinerConfig()) == 1
+
+
+def test_rule_shards_env_strictly_parsed(monkeypatch):
+    monkeypatch.setenv("FA_RULE_SHARDS", "two")  # the typo class
+    with pytest.raises(InputError, match="FA_RULE_SHARDS"):
+        resolve_rule_shards(_ctx(2), MinerConfig())
+    monkeypatch.setenv("FA_RULE_SHARDS", "-1")
+    with pytest.raises(InputError, match="FA_RULE_SHARDS"):
+        resolve_rule_shards(_ctx(2), MinerConfig())
+
+
+def test_rule_shards_must_match_mesh(monkeypatch):
+    monkeypatch.setenv("FA_RULE_SHARDS", "4")
+    with pytest.raises(InputError, match="txn axis"):
+        resolve_rule_shards(_ctx(2), MinerConfig())
+    assert resolve_rule_shards(_ctx(4), MinerConfig()) == 4
+
+
+def test_rule_shards_config_validated():
+    with pytest.raises(InputError, match="rule_shards"):
+        resolve_rule_shards(_ctx(2), MinerConfig(rule_shards=-2))
+    assert resolve_rule_shards(_ctx(2), MinerConfig(rule_shards=2)) == 2
+
+
+def test_rule_shards_pin_one_on_multi_device_mesh_uses_host_table():
+    """rule_shards=1 on a multi-device mesh pins phase 2 to the PR-4
+    device-0 engine: the resident-scan state must NOT be kept (its 8·S
+    row-padding layout only matches the full-mesh sharded kernel), so
+    the recommender falls back to the host-built-table scan — and still
+    recommends exactly what the unpinned sharded path does."""
+    from fastapriori_tpu.models.recommender import AssociationRules
+
+    d_lines = tokenized(random_dataset(6, n_txns=250, max_len=8))
+    u_lines = tokenized(random_dataset(64, n_txns=80))
+    data = preprocess(d_lines, 0.05)
+    miner = FastApriori(
+        config=MinerConfig(min_support=0.05, engine="level", num_devices=2)
+    )
+    levels = miner.mine_levels_raw(data)
+
+    def run(shards):
+        cfg = MinerConfig(
+            min_support=0.05, engine="level", num_devices=2,
+            rule_engine="device", rule_shards=shards,
+        )
+        rec = AssociationRules(
+            [], data.freq_items, data.item_to_rank, config=cfg,
+            context=miner.context, levels=levels,
+            item_counts=data.item_counts,
+        )
+        return rec.run(u_lines, use_device=True), rec
+
+    out_pin, rec_pin = run(1)
+    assert rec_pin._scan_table is None  # host-built replicated table
+    assert rec_pin._rule_dev is not None
+    out_auto, rec_auto = run(0)
+    assert rec_auto._scan_table is not None
+    assert out_pin == out_auto
+
+
+def test_rule_shards_cand_mesh_falls_back_to_single_device():
+    ctx = DeviceContext(num_devices=4, cand_devices=2)
+    assert resolve_rule_shards(ctx, MinerConfig()) == 1
+    import os
+
+    os.environ["FA_RULE_SHARDS"] = "2"
+    try:
+        with pytest.raises(InputError, match="single-process"):
+            resolve_rule_shards(ctx, MinerConfig())
+    finally:
+        del os.environ["FA_RULE_SHARDS"]
+
+
+# ---------------------------------------------------------------------------
+# failpoints on the sharded upload/fetch path + kill-and-resume
+
+
+def test_sharded_upload_failpoint_fires():
+    mats = _corpus("random")
+    failpoints.arm("rules.upload", "io*1")
+    with pytest.raises(OSError, match="injected"):
+        _rule_arrays_device(mats, _ctx(2), shards=2)
+
+
+def test_sharded_mask_transient_fault_is_absorbed():
+    """A one-shot RESOURCE_EXHAUSTED on the sharded survivor-mask fetch
+    is absorbed by the audited retry path, output bit-identical."""
+    mats = _corpus("random")
+    clean = _rule_arrays_host(mats)
+    failpoints.arm("fetch.rule_mask_shard", "oom*1")
+    _assert_bit_identical(
+        clean, _rule_arrays_device(mats, _ctx(2), shards=2)
+    )
+    retries = [e for e in ledger.snapshot() if e["kind"] == "retry"]
+    assert retries and retries[0]["site"] == "fetch.rule_mask_shard"
+
+
+def test_rec_match_transient_fault_is_absorbed():
+    """A one-shot transient on the resident scan's result fetch is
+    absorbed mid-recommend; the output stays identical to a clean run."""
+    from fastapriori_tpu.models.recommender import AssociationRules
+
+    d_lines = tokenized(random_dataset(6, n_txns=250, max_len=8))
+    u_lines = tokenized(random_dataset(62, n_txns=80))
+    data = preprocess(d_lines, 0.05)
+    cfg = MinerConfig(
+        min_support=0.05, engine="level", num_devices=2,
+        rule_engine="device",
+    )
+    miner = FastApriori(config=cfg)
+    levels = miner.mine_levels_raw(data)
+
+    def fresh():
+        return AssociationRules(
+            [], data.freq_items, data.item_to_rank, config=cfg,
+            context=miner.context, levels=levels,
+            item_counts=data.item_counts,
+        )
+
+    clean = fresh().run(u_lines, use_device=True)
+    ledger.reset()
+    failpoints.arm("fetch.rec_match", "oom*1")
+    assert fresh().run(u_lines, use_device=True) == clean
+    retries = [e for e in ledger.snapshot() if e["kind"] == "retry"]
+    assert retries and retries[0]["site"] == "fetch.rec_match"
+
+
+def test_sharded_kill_and_resume_bit_exact(tmp_path):
+    """Hard abort on the sharded mask fetch mid-phase-2; the resumed run
+    regenerates from the checkpointed mining artifacts bit-identically
+    (the CLI --resume-from phase-1 restart shape, driven in-process)."""
+    from fastapriori_tpu.io import checkpoint as ckpt
+
+    lines = tokenized(random_dataset(4, n_txns=250, max_len=8))
+    data = preprocess(lines, 0.05)
+    miner = FastApriori(
+        config=MinerConfig(min_support=0.05, engine="level", num_devices=1)
+    )
+    levels = miner.mine_levels_raw(data)
+    prefix = str(tmp_path) + "/"
+    ckpt.save_checkpoint(
+        prefix,
+        levels,
+        {
+            "n_raw": data.n_raw,
+            "min_count": data.min_count,
+            "num_items": data.num_items,
+        },
+    )
+    mats = _level_tables(levels, data.item_counts)
+    ctx = _ctx(2)
+    clean = _rule_arrays_device(mats, ctx, shards=2)
+
+    failpoints.arm("fetch.rule_mask_shard", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        _rule_arrays_device(mats, ctx, shards=2)
+    failpoints.disarm_all()
+
+    got_levels, meta = ckpt.load_checkpoint(prefix)
+    ckpt.check_meta(
+        meta,
+        n_raw=data.n_raw,
+        min_count=data.min_count,
+        num_items=data.num_items,
+        prefix=prefix,
+    )
+    resumed = _rule_arrays_device(
+        _level_tables(got_levels, data.item_counts), ctx, shards=2
+    )
+    _assert_bit_identical(clean, resumed)
+
+
+def test_rec_match_kill_then_rerun_identical():
+    """An abort on the scan fetch kills the run(); a fresh run() on the
+    SAME instance (the resident table survives the failure) completes
+    and matches the clean output — the serving tier's crash-retry
+    shape."""
+    from fastapriori_tpu.models.recommender import AssociationRules
+
+    d_lines = tokenized(random_dataset(6, n_txns=250, max_len=8))
+    u_lines = tokenized(random_dataset(63, n_txns=80))
+    data = preprocess(d_lines, 0.05)
+    cfg = MinerConfig(
+        min_support=0.05, engine="level", num_devices=2,
+        rule_engine="device",
+    )
+    miner = FastApriori(config=cfg)
+    levels = miner.mine_levels_raw(data)
+    rec = AssociationRules(
+        [], data.freq_items, data.item_to_rank, config=cfg,
+        context=miner.context, levels=levels,
+        item_counts=data.item_counts,
+    )
+    clean = rec.run(u_lines, use_device=True)
+    failpoints.arm("fetch.rec_match", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        rec.run(u_lines, use_device=True)
+    failpoints.disarm_all()
+    assert rec.run(u_lines, use_device=True) == clean

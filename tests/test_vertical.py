@@ -382,9 +382,10 @@ def test_forced_vertical_without_csr_falls_back(tmp_path):
 
 
 def test_vertical_run_file_matches_bitmap(tmp_path):
-    """run_file with a forced vertical engine skips the pipelined
-    capture ingest (it pre-commits to the bitmap layout) and still
-    mines bit-exact."""
+    """run_file with a forced vertical engine (through whichever ingest
+    flavor serves this mesh — since ISSUE 8 the capture pipeline takes
+    vertical mines too, retaining block CSRs instead of packing
+    bitmaps) still mines bit-exact."""
     lines = _t10i4_shaped()
     p = tmp_path / "d.dat"
     p.write_text("\n".join(" ".join(l) for l in lines) + "\n")
@@ -399,6 +400,154 @@ def test_vertical_run_file_matches_bitmap(tmp_path):
         )
     ).run_file(str(p))[0]
     assert dict(got) == dict(exp)
+
+
+# ---------------------------------------------------------------------------
+# pass-1 density probe under the pipelined ingest (ISSUE 8 satellite)
+
+
+def _native_capture_available():
+    from fastapriori_tpu.native import native_available
+    from fastapriori_tpu.native.loader import (
+        has_pass1_probe,
+        has_preprocess_buffer_blocks,
+    )
+
+    return (
+        native_available()
+        and has_preprocess_buffer_blocks()
+        and has_pass1_probe()
+    )
+
+
+@pytest.mark.skipif(
+    not _native_capture_available(),
+    reason="native capture ingest with pass-1 probe not built",
+)
+def test_pipelined_capture_auto_probe_picks_vertical(tmp_path):
+    """Auto engine choice under the CAPTURE pipelined ingest: the pass-1
+    probe (native on_pass1 callback) picks vertical BEFORE any block
+    commits to the bitmap layout — the PR-7 residue where auto-vertical
+    forfeited the capture overlap — with the choice + density + probe
+    site ledger-recorded, and the mine bit-exact vs the bitmap oracle."""
+    lines = _sparse_corpus()
+    p = tmp_path / "d.dat"
+    p.write_text("\n".join(" ".join(l) for l in lines) + "\n")
+    exp = FastApriori(
+        config=MinerConfig(
+            min_support=0.001, engine="level", mine_engine="bitmap",
+            num_devices=1,
+        )
+    ).run_file(str(p))[0]
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.001, engine="level", mine_engine="auto",
+            num_devices=1,
+        )
+    )
+    got = miner.run_file(str(p))[0]
+    assert dict(got) == dict(exp)
+    pre = [
+        r for r in miner.metrics.records if r.get("event") == "preprocess"
+    ]
+    assert pre and pre[0].get("capture") and pre[0]["engine"] == "vertical"
+    ev = _engine_events()
+    assert ev and ev[0]["engine"] == "vertical"
+    assert ev[0].get("probe") == "pass1"
+    assert "density" in ev[0]
+
+
+@pytest.mark.skipif(
+    not _native_capture_available(),
+    reason="native capture ingest with pass-1 probe not built",
+)
+def test_pipelined_capture_forced_vertical_keeps_pipeline(tmp_path):
+    """A FORCED vertical mine no longer disables the pipelined capture
+    ingest: the blocks replay threaded and retain their CSRs, the arena
+    mines bit-exact, and the preprocess record shows the capture path."""
+    lines = _t10i4_shaped()
+    p = tmp_path / "d.dat"
+    p.write_text("\n".join(" ".join(l) for l in lines) + "\n")
+    exp = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", mine_engine="bitmap",
+            num_devices=1,
+        )
+    ).run_file(str(p))[0]
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", mine_engine="vertical",
+            num_devices=1,
+        )
+    )
+    got = miner.run_file(str(p))[0]
+    assert dict(got) == dict(exp)
+    pre = [
+        r for r in miner.metrics.records if r.get("event") == "preprocess"
+    ]
+    assert pre and pre[0].get("pipelined") and pre[0].get("capture")
+    assert pre[0]["engine"] == "vertical"
+    ev = _engine_events()
+    assert ev and ev[0].get("probe") == "pass1"
+
+
+@pytest.mark.skipif(
+    not _native_capture_available(),
+    reason="native capture ingest with pass-1 probe not built",
+)
+def test_pipelined_capture_dense_corpus_stays_bitmap(tmp_path):
+    """The probe must NOT flip dense corpora: the capture ingest keeps
+    the bitmap commit and the preprocess record says so."""
+    lines = _t10i4_shaped()
+    p = tmp_path / "d.dat"
+    p.write_text("\n".join(" ".join(l) for l in lines) + "\n")
+    miner = FastApriori(
+        config=MinerConfig(
+            min_support=0.03, engine="level", mine_engine="auto",
+            num_devices=1,
+        )
+    )
+    miner.run_file(str(p))
+    pre = [
+        r for r in miner.metrics.records if r.get("event") == "preprocess"
+    ]
+    assert pre and pre[0]["engine"] == "bitmap"
+    assert not _engine_events()
+
+
+# ---------------------------------------------------------------------------
+# threaded arena build (ISSUE 8 satellite: the PR-7 reduceat residue)
+
+
+def test_arena_build_threaded_identical(monkeypatch):
+    """The run-aligned thread split of the reduceat pass must produce a
+    byte-identical arena (OR is associative; runs stay whole per
+    thread) for thread counts that divide the runs evenly and not."""
+    from fastapriori_tpu.ops import vertical as vops
+
+    rng = np.random.RandomState(5)
+    t = 4000
+    sizes = rng.randint(1, 12, size=t)
+    indices = np.concatenate(
+        [
+            np.sort(rng.choice(600, size=s, replace=False))
+            for s in sizes
+        ]
+    ).astype(np.int32)
+    offsets = np.zeros(t + 1, dtype=np.int64)
+    offsets[1:] = np.cumsum(sizes)
+    base, f_pad, t_pad = vops.build_tid_arena_csr(
+        indices, offsets, 600, n_threads=1
+    )
+    # Drop the size floor so the small fixture actually exercises the
+    # thread split (production corpora clear it naturally).
+    monkeypatch.setattr(vops, "_ARENA_THREAD_MIN_RUNS", 1)
+    for n_threads in (2, 3, 8):
+        arena, f2, t2 = vops.build_tid_arena_csr(
+            indices, offsets, 600, n_threads=n_threads
+        )
+        assert (f2, t2) == (f_pad, t_pad)
+        assert arena.tobytes() == base.tobytes()
 
 
 # ---------------------------------------------------------------------------

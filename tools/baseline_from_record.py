@@ -65,8 +65,8 @@ def render(parsed: dict) -> str:
             f"| {name} | {ms} | **{val}** {unit} | {vs} | "
             f"{wall} {fmt_band(band)} |"
         )
-    rf = parsed.get("rules_full_scale")
-    if rf:
+    rf = parsed.get("rules_full_scale") or {}
+    if rf.get("value") is not None:
         eng = (
             f", engine {rf['engine']}" if rf.get("engine") else ""
         )
@@ -82,6 +82,29 @@ def render(parsed: dict) -> str:
             f"gen_rules {rf.get('gen_rules_s')} s{split} "
             f"(mine {rf.get('mine_s')} s) |"
         )
+    rsc = rf.get("scaling") or {}
+    if rsc.get("devices"):
+        out.append("")
+        out.append(
+            f"Rule engines per device count ({rsc.get('n_txns')} txns, "
+            f"{rsc.get('n_users')} users, {rsc.get('platform')} — "
+            "virtual devices share the host cores, so join_vs_1dev is "
+            "sharding overhead, flat = ideal):"
+        )
+        out.append("")
+        for n, d in sorted(
+            rsc["devices"].items(), key=lambda kv: int(kv[0])
+        ):
+            out.append(
+                f"- n={n} (shards {d.get('shards')}): join "
+                f"{d.get('join_s')} s (vs 1dev {d.get('join_vs_1dev')}), "
+                f"sort {d.get('sort_s')} s, scan_dispatches "
+                f"{d.get('scan_dispatches')}, join gather/psum "
+                f"{d.get('join_gather_bytes')}/{d.get('join_psum_bytes')} "
+                f"B, rule-table host bytes "
+                f"{d.get('rule_table_host_bytes')}, "
+                f"**{d.get('users_per_s')}** users/sec"
+            )
     ph = parsed.get("webdocs_phases")
     if ph:
         out.append("")

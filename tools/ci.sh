@@ -36,4 +36,11 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_device.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_vertical.py -q \
     -p no:cacheprovider
 
+# Sharded rule generation + device-resident priority scan differential
+# suite (ISSUE 8): the sharded join engine and the rank-strided
+# resident scan must stay bit-exact against the host oracle at
+# 1/2/4/8 virtual devices.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_shard.py -q \
+    -p no:cacheprovider
+
 env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
