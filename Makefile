@@ -3,7 +3,7 @@
 # failpoint smoke pass (reliability wiring under injected failure — see
 # tools/failpoint_smoke.py).
 
-.PHONY: lint test smoke ci baseline inventory native
+.PHONY: lint test smoke chaos ci baseline inventory native
 
 # Default paths cover the whole tree: fastapriori_tpu tests bench.py
 # __graft_entry__.py tools (tools/lint/cli.py DEFAULT_PATHS).
@@ -17,7 +17,14 @@ test:
 smoke:
 	env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
 
-ci: lint test smoke
+# Seeded chaos soak: deterministic failpoint schedules over the
+# censused site inventory, full-pipeline invariant check (ISSUE 9;
+# FA_CHAOS_SEED offsets the seed set).
+chaos:
+	env JAX_PLATFORMS=cpu python tools/chaos.py \
+	    --seeds 0,4,6,9 --scenarios 3 --budget-s 120
+
+ci: lint test smoke chaos
 
 # Ratchet reset — only alongside the change that justifies it.
 baseline:

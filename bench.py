@@ -190,6 +190,17 @@ def _phase_summary(records, cold_s=None):
             # retries — lands here, not just in a slower wall figure.
             d = ph.setdefault("degraded", {})
             d[r.get("kind", "?")] = d.get(r.get("kind", "?"), 0) + 1
+            if r.get("kind") == "cascade":
+                # The unified escalation chain (reliability/watchdog.py):
+                # the record file keeps the FULL ordered trail, so a run
+                # that walked any chain is reconstructible step by step.
+                ph.setdefault("cascade_trail", []).append(
+                    {
+                        k: r[k]
+                        for k in ("chain", "frm", "to", "reason", "site")
+                        if k in r
+                    }
+                )
     if levels_ms:
         ph["levels_ms"] = levels_ms
         ph["levels_total_ms"] = round(sum(levels_ms.values()), 1)
@@ -444,6 +455,19 @@ def _emit_final(merged) -> int:
             "users_per_s": d4.get("users_per_s"),
             "rule_table_host_bytes": d4.get("rule_table_host_bytes"),
         }
+    # ISSUE 9 satellite: the compact line ALWAYS carries the degraded
+    # event count (summed across every phase summary in the record), so
+    # a silently-degraded run can never masquerade as a clean perf
+    # number — the per-kind breakdown and the full cascade trail live
+    # in the record file's phase dicts.
+    degraded_total = 0
+    for key, val in merged.items():
+        if key == "phases" or key.endswith("_phases"):
+            if isinstance(val, dict):
+                degraded_total += sum(
+                    (val.get("degraded") or {}).values()
+                )
+    compact["degraded"] = degraded_total
     cal = (merged.get("calibration") or {}).get("start") or {}
     if cal.get("link_down_mbyte_s") is not None:
         compact["link_down_mbyte_s"] = cal["link_down_mbyte_s"]

@@ -106,8 +106,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="crash-safe mining: atomically rewrite "
         "<output>checkpoint.npz after every completed Apriori level so "
         "an interrupted mine resumes mid-lattice via --resume-from "
-        "(costs eager per-level count fetches and skips the fused "
-        "whole-loop engine)",
+        "(costs eager per-level count fetches; with --engine fused the "
+        "lattice mines in resumable device segments instead of "
+        "skipping the engine)",
+    )
+    p.add_argument(
+        "--checkpoint-cadence",
+        type=int,
+        default=1,
+        help="with --engine fused and --checkpoint-every-level: levels "
+        "mined per device segment between checkpoint commits (default "
+        "1 = a durable checkpoint after every level, matching the "
+        "level engine)",
     )
     p.add_argument(
         "--profile-dir",
@@ -172,6 +182,7 @@ def _run(args) -> int:
         checkpoint_prefix=(
             args.output if args.checkpoint_every_level else None
         ),
+        checkpoint_every_levels=max(args.checkpoint_cadence, 1),
     )
     if args.platform == "cpu":
         import jax

@@ -244,8 +244,22 @@ class MinerConfig:
     # level, so --resume-from restarts from the deepest completed level
     # instead of from scratch.  Costs: per-level counts resolve eagerly
     # (the deferred single-fetch optimization is incompatible with
-    # durable per-level state), and the whole-lattice fused engine is
-    # skipped (one opaque multi-level dispatch has no mid-points to
-    # checkpoint; the shallow-tail fold stays on — it checkpoints at the
-    # fold boundary).  None disables (the default).
+    # durable per-level state).  With engine="auto"/"level" the
+    # whole-lattice fused program is skipped (one opaque multi-level
+    # dispatch has no mid-points to checkpoint; the shallow-tail fold
+    # stays on — it checkpoints at the fold boundary); engine="fused"
+    # instead mines in SEGMENTS (below).  None disables (the default).
     checkpoint_prefix: Optional[str] = None
+    # Fused-engine checkpoint cadence (ISSUE 9): with engine="fused" AND
+    # checkpoint_prefix set, the lattice is mined in device SEGMENTS —
+    # seeded whole-loop dispatches (the ops/fused.py tail program with
+    # 2x row headroom and flat slot caps) of this many levels each, a
+    # durable checkpoint committed after every segment — so a fused
+    # mine kills-and-resumes byte-identically at the segment boundary
+    # instead of forfeiting the engine.  A segment whose level outgrows
+    # its row budget degrades to per-level dispatches (cascade event)
+    # until the lattice shrinks back under the failed seed.  1 (the
+    # default) checkpoints after every level, matching the level
+    # engine's durability; larger values trade checkpoint granularity
+    # for fewer dispatch round trips.
+    checkpoint_every_levels: int = 1

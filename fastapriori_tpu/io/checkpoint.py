@@ -141,6 +141,35 @@ def load_checkpoint(
     return levels, meta
 
 
+def validate_checkpoint(prefix: str) -> Dict[str, int]:
+    """Structural + manifest cross-validation of the checkpoint under
+    ``prefix`` (the chaos harness's no-corrupt-artifact assertion and
+    the multi-host resume test's process-side check): loads the
+    checkpoint through the full validation path, additionally verifies
+    the lattice is DOWNWARD-CONSISTENT — level i+1's width is level
+    i's plus one, counts are positive and at least ``min_count`` —
+    and returns the meta dict.  Raises
+    :class:`~fastapriori_tpu.errors.InputError` naming the violation:
+    a checkpoint that passes here is safe to seed a resume."""
+    levels, meta = load_checkpoint(prefix)
+    for i, (mat, cnt) in enumerate(levels):
+        if cnt.size and int(cnt.min()) < meta["min_count"]:
+            raise InputError(
+                f"corrupt checkpoint under {prefix!r}: level "
+                f"{i + 2} carries a count below min_count "
+                f"({int(cnt.min())} < {meta['min_count']})"
+            )
+        if mat.size and (
+            int(mat.min()) < 0 or int(mat.max()) >= meta["num_items"]
+        ):
+            raise InputError(
+                f"corrupt checkpoint under {prefix!r}: level "
+                f"{i + 2} references item ranks outside "
+                f"[0, {meta['num_items']})"
+            )
+    return meta
+
+
 def check_meta(meta: Dict[str, int], *, n_raw: int, min_count: int,
                num_items: int, prefix: str) -> None:
     """Reject a checkpoint written for different data or support."""

@@ -33,7 +33,7 @@ from fastapriori_tpu.ops.bitmap import (
 )
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import CompressedData, preprocess
-from fastapriori_tpu.reliability import failpoints, ledger, retry
+from fastapriori_tpu.reliability import failpoints, ledger, retry, watchdog
 from fastapriori_tpu.utils.logging import MetricsLogger
 
 ItemsetWithCount = Tuple[FrozenSet[int], int]
@@ -268,6 +268,9 @@ class FastApriori:
                     "count_reduce_fallback", once_key=reason,
                     reason=reason,
                 )
+                watchdog.downgrade(
+                    "count_reduce", "sparse", "dense", reason=reason
+                )
             return "dense", req
         ledger.record(
             "count_reduce_engine", once_key="sparse", engine="sparse"
@@ -440,6 +443,9 @@ class FastApriori:
                 ledger.record(
                     "mine_engine_fallback", once_key=reason, reason=reason
                 )
+                watchdog.downgrade(
+                    "mine_engine", "vertical", "bitmap", reason=reason
+                )
             return "bitmap", req
         if req == "vertical":
             ledger.record(
@@ -592,13 +598,32 @@ class FastApriori:
 
         return count_reduce, sparse_thr, build, hint_key
 
-    def _fused_fallback(self, partial: Optional[list]) -> None:
+    def _fused_fallback(
+        self, partial: Optional[list], reason: str = "row_budget_or_bound"
+    ) -> None:
         """One call per fused→level fallback: the legacy metrics event
-        (asserted by the engine tests / bench parsers) plus the
-        degradation-ledger entry."""
+        (asserted by the engine tests / bench parsers), the
+        degradation-ledger entry, and the unified cascade event
+        (reliability/watchdog.py — the ONE escalation policy every
+        engine fallback now reports through)."""
         n = len(partial) if partial else 0
         self.metrics.emit("fused_fallback", resume_levels=n)
         ledger.record("fused_fallback", resume_levels=n)
+        # The unified cascade records DEGRADATIONS, not choices: an
+        # engine="auto" run that never attempted the fused program
+        # simply chose the level engine (the engine_auto event), while
+        # a forced-fused run, a run whose fused ATTEMPT overflowed
+        # (partial salvage), or a transient-exhausted attempt genuinely
+        # walked the chain.
+        if (
+            self.config.engine == "fused"
+            or partial
+            or reason == "transient_exhausted"
+        ):
+            watchdog.downgrade(
+                "engine", "fused", "level", reason=reason,
+                resume_levels=n,
+            )
 
     @property
     def context(self) -> DeviceContext:
@@ -889,7 +914,7 @@ class FastApriori:
                 basket_offsets=offsets,
                 weights=w_np,
             )
-            return self._mine_vertical(data), data
+            return self._mine_vertical_safe(data), data
 
         # Static shapes fixed BEFORE the first upload: distinct rows are
         # bounded by n_raw, so an n_chunks derived from it can only be
@@ -1341,7 +1366,7 @@ class FastApriori:
                     basket_offsets=offsets,
                     weights=w_np,
                 )
-                return self._mine_vertical(data), data
+                return self._mine_vertical_safe(data), data
             # Same phase accounting as the threaded path: assembly, the
             # upload-tail wait, and the device concat/unpack book under
             # bitmap_build (the native call above is preprocess).
@@ -1523,18 +1548,33 @@ class FastApriori:
             engine, req = self._mine_engine(data)
             self.metrics.emit("mine_engine", engine=engine, requested=req)
             if engine == "vertical":
-                return self._mine_vertical(data)
+                # Transient exhaustion inside falls to the bitmap level
+                # loop via the cascade (_mine_vertical_safe), with the
+                # consumed resume state restored first.
+                return self._mine_vertical_safe(data)
             # Mid-mine resume and per-level checkpointing both force the
             # level engine: the whole-lattice fused dispatch has no
-            # mid-points to seed from or checkpoint at.
+            # mid-points to seed from or checkpoint at (engine="fused"
+            # under a checkpoint prefix mines in resumable SEGMENTS
+            # inside _level_loop instead).
             if self.config.engine in ("fused", "auto") and not (
                 self._resume_levels or self.config.checkpoint_prefix
             ):
-                levels, partial = self._mine_fused(
-                    data, auto=self.config.engine == "auto"
-                )
+                fused_reason = "row_budget_or_bound"
+                try:
+                    levels, partial = self._mine_fused(
+                        data, auto=self.config.engine == "auto"
+                    )
+                except Exception as exc:
+                    # Transient exhaustion at the fused fetch site:
+                    # walk the chain to the level engine (its fetches
+                    # carry their own retry budgets) rather than dying.
+                    if not watchdog.transient(exc):
+                        raise
+                    levels, partial = None, None
+                    fused_reason = "transient_exhausted"
                 if levels is None:  # row budget / level bound / auto choice
-                    self._fused_fallback(partial)
+                    self._fused_fallback(partial, reason=fused_reason)
                     levels = self._mine_levels(data, resume=partial or None)
             else:
                 levels = self._mine_levels(data)
@@ -1888,6 +1928,10 @@ class FastApriori:
                     "count_sparse_overflow", site="fused",
                     m_cap=m_cap, caps=list(caps), n_union=sparse_nu,
                 )
+                watchdog.downgrade(
+                    "count_reduce", "sparse", "dense",
+                    reason="union_overflow", site="fused",
+                )
                 if sparse_hint_key is not None and sparse_nu > 0:
                     # Memoize the true union size (the pair-cap-hint
                     # pattern): repeat runs size the compaction right
@@ -2007,6 +2051,61 @@ class FastApriori:
             sparse_hint_key=sp_hint_key,
         )
         return lv, partial, False
+
+    def _mine_vertical_safe(
+        self, data: CompressedData
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """:meth:`_mine_vertical` with the transient-exhaustion arm of
+        the cascade — EVERY vertical entry point (mine() and both
+        file-pipeline ingest paths) goes through here, so the
+        walk-the-chain contract holds on the real CLI path too: a
+        vertical failure that survived its retry budgets falls to the
+        bitmap level loop (bit-exact by the differential contract)
+        instead of killing the mine.  The mid-mine resume state the
+        vertical attempt consumed (:meth:`_take_resume`) is restored
+        first, so a resumed run re-seeds the fallback from its
+        checkpoint instead of re-mining the lattice from scratch."""
+        resume_state = (
+            self._resume_levels, self._resume_meta, self._resume_label
+        )
+        try:
+            return self._mine_vertical(data)
+        except Exception as exc:
+            if not watchdog.transient(exc):
+                raise
+            (
+                self._resume_levels,
+                self._resume_meta,
+                self._resume_label,
+            ) = resume_state
+            watchdog.downgrade(
+                "mine_engine", "vertical", "bitmap",
+                reason="transient_exhausted",
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
+            ledger.record(
+                "mine_engine_fallback",
+                once_key="transient_exhausted",
+                reason="transient_exhausted",
+            )
+            return self._mine_levels(data)
+
+    def _fused_resident_safe(self, *args, **kw):
+        """:meth:`_fused_resident` with the transient-exhaustion arm of
+        the cascade: a fused fetch that survived its retry budget walks
+        the chain to the level engine (whose fetches carry their own
+        budgets) instead of killing the mine."""
+        try:
+            return self._fused_resident(*args, **kw)
+        except Exception as exc:
+            if not watchdog.transient(exc):
+                raise
+            watchdog.downgrade(
+                "engine", "fused", "level",
+                reason="transient_exhausted",
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
+            return None, None, False
 
     # ------------------------------------------------------------------
     def _mine_levels(
@@ -2253,14 +2352,14 @@ class FastApriori:
             # choice) resolves the engine without any pair pre-pass —
             # repeat runs of a fused-able dataset go straight to the ONE
             # mining dispatch.
-            lv, partial, need_n2 = self._fused_resident(
+            lv, partial, need_n2 = self._fused_resident_safe(
                 data, bitmap, n_chunks, t_pad
             )
             if lv is None and need_n2 and pair_pre is not None:
                 # Cold path with the overlapped pair in flight: its
                 # n2/census ARE the sizing pre-pass — no extra dispatch.
                 _idx, _cnt, n2, tri = pair_fetch()
-                lv, partial, _ = self._fused_resident(
+                lv, partial, _ = self._fused_resident_safe(
                     data, bitmap, n_chunks, t_pad, n2=n2, tri=tri
                 )
                 need_n2 = False
@@ -2404,7 +2503,7 @@ class FastApriori:
                 # engine's sizing pre-pass (it IS level 2 if the choice
                 # lands on the level engine — no wasted dispatch either
                 # way).
-                lv, partial, _ = self._fused_resident(
+                lv, partial, _ = self._fused_resident_safe(
                     data, bitmap, n_chunks, t_pad, n2=n2, tri=tri
                 )
                 if lv is not None:
@@ -2519,6 +2618,24 @@ class FastApriori:
             and ctx.cand_shards == 1
             and data.shard is None
         )
+        # Fused-engine checkpointing (ISSUE 9 tentpole a): with
+        # engine="fused" under a checkpoint prefix the lattice mines in
+        # SEGMENTS — seeded whole-loop dispatches of
+        # ``checkpoint_every_levels`` depth (the tail program with 2x
+        # row headroom and flat slot caps, ops/fused.py), a durable
+        # checkpoint after each — so a fused mine kills-and-resumes
+        # byte-identically at the segment boundary instead of
+        # forfeiting the engine (the ROADMAP reliability residue).  A
+        # segment whose first level outgrows its budget walks the
+        # cascade to per-level dispatches until the lattice shrinks
+        # back under the failed seed.
+        fused_ckpt = (
+            cfg.engine == "fused"
+            and bool(cfg.checkpoint_prefix)
+            and not vertical
+            and ctx.cand_shards == 1
+            and data.shard is None
+        )
         k = cur.shape[1] + 1
         prev_rows = None  # previous level's row count (shrink signal)
         fold_attempts = 2  # an early incomplete fold keeps one retry
@@ -2527,39 +2644,98 @@ class FastApriori:
             # k > 3: never fold straight off the pair level — small
             # lattices that fit a whole-loop program are the fused
             # engine's job (the auto choice), and the fold's seed should
-            # be a level the per-level engine already counted.
-            if (
-                tail_ok
-                and fold_attempts > 0
-                and k > 3
-                and cur.shape[0] <= tail_rows
-                and self._tail_entry_ok(auto_tail, cur.shape[0], prev_rows)
-                and (
+            # be a level the per-level engine already counted.  Fused
+            # checkpoint segments are exempt from every heuristic gate:
+            # the engine was FORCED, so segments run whenever the seed
+            # fits memory and the last segment at this size didn't fail.
+            if fused_ckpt:
+                want_fold = (
                     last_fold_seed is None
                     or cur.shape[0] < last_fold_seed
                 )
-            ):
-                tail, complete, dispatched = self._mine_tail(
-                    data, bitmap, w_digits, scales, cur, n_chunks, heavy,
-                    pending_state=(
-                        (pending_map, drained, pending_bytes)
-                        if defer
-                        else None
-                    ),
-                    count_reduce=count_reduce,
-                    sparse_thr=sparse_thr,
+            else:
+                want_fold = (
+                    tail_ok
+                    and fold_attempts > 0
+                    and k > 3
+                    and cur.shape[0] <= tail_rows
+                    and self._tail_entry_ok(
+                        auto_tail, cur.shape[0], prev_rows
+                    )
+                    and (
+                        last_fold_seed is None
+                        or cur.shape[0] < last_fold_seed
+                    )
                 )
+            if want_fold:
+                fold_err = False
+                try:
+                    tail, complete, dispatched = self._mine_tail(
+                        data, bitmap, w_digits, scales, cur, n_chunks,
+                        heavy,
+                        pending_state=(
+                            (pending_map, drained, pending_bytes)
+                            if defer
+                            else None
+                        ),
+                        count_reduce=count_reduce,
+                        sparse_thr=sparse_thr,
+                        l_max=(
+                            cfg.checkpoint_every_levels
+                            if fused_ckpt
+                            else None
+                        ),
+                        segment=fused_ckpt,
+                    )
+                except Exception as exc:
+                    # Repeated transients at the fold's fetch walk the
+                    # cascade to per-level dispatches instead of
+                    # killing the mine (the per-level fetches are their
+                    # own audited sites with their own retry budgets).
+                    if not watchdog.transient(exc):
+                        raise
+                    watchdog.downgrade(
+                        "engine", "tail", "level",
+                        reason="transient_exhausted",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                    )
+                    tail, complete, dispatched = [], False, True
+                    fold_err = True
                 if dispatched:
-                    fold_attempts -= 1
+                    if not fused_ckpt:
+                        fold_attempts -= 1
                     last_fold_seed = cur.shape[0]
                     if tail:
                         levels.extend(tail)
                         cur = tail[-1][0]
                         k = cur.shape[1] + 1
                         self._checkpoint_levels(levels, data)
+                        if fused_ckpt:
+                            # Progress: the next segment folds again
+                            # regardless of the new seed's size.
+                            last_fold_seed = None
                     if complete:
                         return finish(levels)
+                    if fused_ckpt and not tail and not fold_err:
+                        # Segment overflowed at its first level: walk
+                        # the chain — per-level dispatches (each still
+                        # checkpointed) carry the lattice until it
+                        # shrinks back under the failed seed.
+                        watchdog.downgrade(
+                            "engine", "fused", "level",
+                            reason="segment_overflow", k=int(k),
+                            seed_rows=int(cur.shape[0]),
+                        )
                     continue  # incomplete: per-level from last good level
+                if fused_ckpt:
+                    # Memory model rejected the segment seed outright:
+                    # per-level (checkpointed) until it fits.
+                    watchdog.downgrade(
+                        "engine", "fused", "level",
+                        reason="segment_memory",
+                        seed_rows=int(cur.shape[0]),
+                    )
+                    last_fold_seed = cur.shape[0]
                 # Not dispatched (memory model rejected this seed): fall
                 # through to the per-level dispatch — a later, smaller
                 # seed may fit where this one didn't.
@@ -2718,6 +2894,8 @@ class FastApriori:
         pending_state: Optional[tuple] = None,
         count_reduce: str = "dense",
         sparse_thr=None,
+        l_max: Optional[int] = None,
+        segment: bool = False,
     ) -> Tuple[list, bool, bool]:
         """Shallow-tail fold: mine every remaining level in ONE dispatch
         seeded from the current level matrix (ops/fused.py
@@ -2741,24 +2919,36 @@ class FastApriori:
         this was the last counting path still dense); a union overflow
         marks the level invalid like a p_cap overflow and the host
         resumes per-level, recording the census so repeat runs size
-        the budget right."""
+        the budget right.
+
+        ``segment`` (with ``l_max`` = the checkpoint cadence) is the
+        fused-CHECKPOINT shape (ISSUE 9): the dispatch is one segment
+        of an engine="fused" mine under checkpoint_prefix, so the seed
+        may sit mid-lattice where levels still GROW — the row budget
+        takes 2x headroom, the slot caps go flat (ops/fused.py
+        tail_slot_caps), and the prefix budget is uncompacted (every
+        seed row may extend)."""
         from fastapriori_tpu.ops import fused
 
         cfg = self.config
         ctx = self.context
         n0, k0 = cur.shape
         t_pad, f_pad = bitmap.shape
+        if l_max is None:
+            l_max = cfg.tail_fuse_l_max
         # No 2x headroom (unlike the fused engine's budget): in a
         # shrinking tail the SEED is the largest level, and the [m, m]
         # candidate-gen intermediates are the memory wall (8·m² bytes —
         # headroom at webdocs' 12042-row fold point is the difference
         # between 2.1 GB and an infeasible 8.6 GB).  A growing tail
         # overflows the budget and falls back per-level, exact either
-        # way.
+        # way.  Checkpoint SEGMENTS take the headroom: their seeds sit
+        # mid-lattice where growth is the common case, and the cadence
+        # keeps them shallow.
         m_cap = max(
-            _next_pow2(n0),
+            _next_pow2(2 * n0 if segment else n0),
             cfg.min_prefix_bucket,
-            _next_pow2(cfg.tail_fuse_l_max + 2),
+            _next_pow2(l_max + 2),
         )
         # The memory model is the fused engine's (conservative: the tail
         # counts over p_cap rows, not m_cap) — skip the fold rather than
@@ -2774,11 +2964,17 @@ class FastApriori:
         # cap (tuned for the legacy 16K regime) would trip the in-kernel
         # abort on every run.  At or below 16K the knob keeps its exact
         # configured meaning (tests force tiny caps to drive the abort
-        # path).
-        p_cap = cfg.tail_fuse_p_cap
-        if m_cap > 16384:
-            p_cap = max(p_cap, m_cap // 8)
-        p_cap = min(p_cap, m_cap)
+        # path).  Checkpoint segments skip the compaction gamble
+        # entirely (p_cap = m_cap): a mid-lattice level can extend from
+        # every row, and a tripped prefix abort would waste the whole
+        # segment dispatch.
+        if segment:
+            p_cap = m_cap
+        else:
+            p_cap = cfg.tail_fuse_p_cap
+            if m_cap > 16384:
+                p_cap = max(p_cap, m_cap // 8)
+            p_cap = min(p_cap, m_cap)
         # The level engine's chunk count bounds a [t_c, P] intermediate
         # sized for its own prefix caps; the tail's [t_c, p_cap] is
         # narrower, so consolidate chunks (fewer scan steps per
@@ -2820,9 +3016,20 @@ class FastApriori:
                 for counts_dev, pos in pending_map[idx]:
                     if pos.size:
                         resolve_flat.append((idx, counts_dev, pos))
+        # The resolve-fold build below does not thread flat_caps, so a
+        # checkpoint SEGMENT must never carry deferred counts — today
+        # guaranteed because checkpointing forces eager fetches (defer
+        # is off under checkpoint_prefix); if that gate ever changes,
+        # fail loudly here instead of unpacking with mismatched slot
+        # offsets.
+        assert not (segment and resolve_flat), (
+            "fused-checkpoint segment with deferred counts: "
+            "tail_miner_with_resolve lacks flat_caps"
+        )
         with self.metrics.timed(
             "tail_fuse", k0=k0, m_cap=m_cap, p_cap=p_cap,
-            n_chunks=tail_chunks,
+            n_chunks=tail_chunks, l_max=l_max,
+            checkpoint_segment=segment,
         ) as met:
             args = [
                 bitmap, w_digits, ctx.replicate(seed), jnp.int32(n0),
@@ -2843,7 +3050,7 @@ class FastApriori:
                 counts_t = tuple(c for _, c, _ in resolve_flat)
                 pos_t = tuple(jnp.asarray(p) for p in padded)
                 fn = ctx.tail_miner_with_resolve(
-                    scales, k0, m_cap, p_cap, cfg.tail_fuse_l_max,
+                    scales, k0, m_cap, p_cap, l_max,
                     tail_chunks, heavy is not None,
                     tuple(c.shape for c in counts_t)
                     + tuple(p.size for p in padded),
@@ -2872,8 +3079,9 @@ class FastApriori:
                 )
             else:
                 fn = ctx.tail_miner(
-                    scales, k0, m_cap, p_cap, cfg.tail_fuse_l_max,
+                    scales, k0, m_cap, p_cap, l_max,
                     tail_chunks, heavy is not None, sparse_cap=sp_cap,
+                    flat_caps=segment,
                 )
                 # lint: fetch-site -- the tail fold's single audited fetch, retry-wrapped; lint: waive G013 -- same logical site as the resolve-fold branch above: exactly one of the two exclusive dispatch shapes runs per mine
                 packed_out = retry.fetch(
@@ -2881,7 +3089,7 @@ class FastApriori:
                 )
             rows, cols, counts, n_lvl, incomplete, snu = (
                 fused.unpack_tail_result(
-                    packed_out, m_cap, cfg.tail_fuse_l_max
+                    packed_out, m_cap, l_max, flat=segment
                 )
             )
             if sp_cap is not None and snu > sp_cap:
@@ -2893,6 +3101,10 @@ class FastApriori:
                 ledger.record(
                     "count_sparse_overflow", site="tail",
                     n_union=int(snu), cap=sp_cap,
+                )
+                watchdog.downgrade(
+                    "count_reduce", "sparse", "dense",
+                    reason="union_overflow", site="tail",
                 )
                 ctx.record_pair_cap(sp_key, _next_pow2(int(snu)))
             # MACs: per stored level, candidate gen (two [m_cap, m_cap]
@@ -2927,7 +3139,7 @@ class FastApriori:
             )
         lvls = fused.decode_level_matrices(
             rows, cols, counts, n_lvl,
-            max_rows=fused.tail_slot_caps(m_cap, cfg.tail_fuse_l_max),
+            max_rows=fused.tail_slot_caps(m_cap, l_max, flat=segment),
             prev=cur,
         )
         return lvls, not bool(incomplete), True
@@ -3255,27 +3467,51 @@ class FastApriori:
         # survivor state is built from it.
         fetched = []
         max_nu = 0
-        for placed_all, bits_fu, counts_out, sp_cap in inflight:
-            mask = bits_fu.result()  # consume the async fetch (retried)
-            if sp_cap is not None:
-                nus = mask[:, -4:].astype(np.int64)
-                nus = (
-                    nus[:, 0]
-                    | (nus[:, 1] << 8)
-                    | (nus[:, 2] << 16)
-                    | (nus[:, 3] << 24)
-                )
-                if nus.size and int(nus.max()) > sp_cap:
-                    max_nu = max(max_nu, int(nus.max()))
-                mask = mask[:, :-4]
-            fetched.append((placed_all, mask, counts_out))
+        recount = None
+        try:
+            for placed_all, bits_fu, counts_out, sp_cap in inflight:
+                mask = bits_fu.result()  # consume the async fetch (retried)
+                if sp_cap is not None:
+                    nus = mask[:, -4:].astype(np.int64)
+                    nus = (
+                        nus[:, 0]
+                        | (nus[:, 1] << 8)
+                        | (nus[:, 2] << 16)
+                        | (nus[:, 3] << 24)
+                    )
+                    if nus.size and int(nus.max()) > sp_cap:
+                        max_nu = max(max_nu, int(nus.max()))
+                    mask = mask[:, :-4]
+                fetched.append((placed_all, mask, counts_out))
+        except Exception as exc:
+            # Transient exhaustion on a SPARSE-engine fetch walks the
+            # cascade: recount the whole level dense (its fetch is a
+            # separate audited site with a fresh retry budget) instead
+            # of killing the mine.  Dense-engine exhaustion has nowhere
+            # further to walk and re-raises classified.
+            if count_reduce != "sparse" or not watchdog.transient(exc):
+                raise
+            recount = "transient_exhausted"
+            watchdog.downgrade(
+                "count_reduce", "sparse", "dense",
+                reason="transient_exhausted",
+                site="vlevel" if vertical else "level", k=s + 1,
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
         if max_nu:
+            recount = "union_overflow"
             ledger.record(
                 "count_sparse_overflow",
                 site="vlevel" if vertical else "level", k=s + 1,
                 n_union=max_nu,
             )
+            watchdog.downgrade(
+                "count_reduce", "sparse", "dense",
+                reason="union_overflow",
+                site="vlevel" if vertical else "level", k=s + 1,
+            )
             ctx.record_pair_cap(sp_hint_key, _next_pow2(max_nu))
+        if recount:
             nxt_d, cnts_d, stats_d = self._count_level(
                 ctx, bitmap, w_digits, scales, level,
                 gen_candidates_stream(level), min_count, n_chunks,
@@ -3294,7 +3530,8 @@ class FastApriori:
                 stats_d.get("gather_bytes", 0) + stats["gather_bytes"]
             )
             stats_d["candidates"] = stats["candidates"]
-            stats_d["sparse_overflow"] = max_nu
+            if max_nu:
+                stats_d["sparse_overflow"] = max_nu
             return nxt_d, cnts_d, stats_d
         pending = []  # (counts_dev [NB, C], flat positions int64[n])
         for (placed_all, mask, counts_out), blk in zip(fetched, blocks):

@@ -664,13 +664,24 @@ def _tail_mine_local(
     return jnp.concatenate(parts)
 
 
-def tail_slot_caps(m_cap: int, l_max: int) -> Tuple[int, ...]:
+def tail_slot_caps(
+    m_cap: int, l_max: int, flat: bool = False
+) -> Tuple[int, ...]:
     """Descending per-tail-level row caps: slot i reserves m_cap >> i
     rows (floor 4096, never above m_cap) — a fold's levels shrink, and
     the compact output keeps the host fetch ~1.6 MB even at 64K-row
     seeds.  A level that violates the assumption trips the in-kernel
     ``bad`` sentinel and the host resumes per-level (exact either
-    way)."""
+    way).
+
+    ``flat``: every slot reserves the full m_cap — the fused-checkpoint
+    SEGMENT shape (models/apriori.py, ISSUE 9): a segment seeded
+    mid-lattice can grow level over level, so the descending-caps
+    assumption would trip the bad sentinel on perfectly minable levels;
+    segments are shallow (the checkpoint cadence) and their seeds
+    modest, so the flat fetch stays small."""
+    if flat:
+        return tuple(m_cap for _ in range(l_max))
     return tuple(
         min(m_cap, max(m_cap >> i, 4096)) for i in range(l_max)
     )
@@ -696,6 +707,7 @@ def make_tail_miner(
     n_chunks: int,
     has_heavy: bool,
     sparse_cap: Optional[int] = None,
+    flat_caps: bool = False,
 ):
     """Build the jitted shallow-tail program (see _tail_mine_local).
     Sharded over the txn mesh axis like the level kernels; the seed
@@ -703,7 +715,8 @@ def make_tail_miner(
     per-iteration [p_cap, F] count reduction to the threshold-sparse
     exchange; the program then takes the replicated [S] per-shard
     prune-threshold array after ``min_count`` (before the heavy
-    arrays)."""
+    arrays).  ``flat_caps`` reserves the full m_cap per slot (the
+    fused-checkpoint segment shape — see :func:`tail_slot_caps`)."""
     assert m_cap > l_max + 1, (m_cap, l_max)
     if mesh is None:
         sparse_cap = None  # the exchange is a mesh collective
@@ -716,7 +729,7 @@ def make_tail_miner(
         l_max=l_max,
         n_chunks=n_chunks,
         axis_name=AXIS if mesh is not None else None,
-        slot_caps=tail_slot_caps(m_cap, l_max),
+        slot_caps=tail_slot_caps(m_cap, l_max, flat=flat_caps),
         cand_row_chunks=tail_cand_row_chunks(m_cap),
         sparse_cap=sparse_cap,
     )
@@ -746,16 +759,18 @@ def make_tail_miner(
     )
 
 
-def unpack_tail_result(packed: np.ndarray, m_cap: int, l_max: int):
+def unpack_tail_result(
+    packed: np.ndarray, m_cap: int, l_max: int, flat: bool = False
+):
     """Split the tail miner's compact 1-D result (see _tail_mine_local)
     into (rows_list, cols_list, counts_list, n_per_level, incomplete,
     max_union_census) — the lists are per-slot 1-D arrays sized by
-    :func:`tail_slot_caps`, consumable by decode_level_matrices with
-    ``max_rows=slot_caps``.  The census is 0 for dense-reduction
-    builds; under the sparse reduction a census above the build's cap
-    names the overflowing union size (the host records it so repeat
-    runs size the compaction right)."""
-    caps = tail_slot_caps(m_cap, l_max)
+    :func:`tail_slot_caps` (``flat`` must match the build), consumable
+    by decode_level_matrices with ``max_rows=slot_caps``.  The census
+    is 0 for dense-reduction builds; under the sparse reduction a
+    census above the build's cap names the overflowing union size (the
+    host records it so repeat runs size the compaction right)."""
+    caps = tail_slot_caps(m_cap, l_max, flat=flat)
     rows, cols, counts = [], [], []
     off = 0
     for c in caps:

@@ -446,11 +446,14 @@ class DeviceContext:
         n_chunks: int,
         has_heavy: bool,
         sparse_cap: Optional[int] = None,
+        flat_caps: bool = False,
     ):
         """Jitted shallow-tail program (ops/fused.py make_tail_miner),
         cached per static configuration (one compile per seed depth).
         ``sparse_cap`` runs the per-iteration count reductions as the
-        threshold-sparse exchange (the PR-6 residue fold)."""
+        threshold-sparse exchange (the PR-6 residue fold); ``flat_caps``
+        builds the fused-checkpoint segment shape (full-m_cap slot
+        caps, ops/fused.py tail_slot_caps)."""
         if k0 + l_max - 1 >= 128:
             # Same widen as the fused engine, reached when the SEED depth
             # plus tail depth crosses int8's bound (ops/fused.py
@@ -461,7 +464,7 @@ class DeviceContext:
             )
         key = (
             "tail", tuple(scales), k0, m_cap, p_cap, l_max, n_chunks,
-            has_heavy, sparse_cap,
+            has_heavy, sparse_cap, flat_caps,
         )
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import make_tail_miner
@@ -469,6 +472,7 @@ class DeviceContext:
             self._fns[key] = make_tail_miner(
                 self.mesh, tuple(scales), k0, m_cap, p_cap, l_max,
                 n_chunks, has_heavy, sparse_cap=sparse_cap,
+                flat_caps=flat_caps,
             )
         return self._fns[key]
 

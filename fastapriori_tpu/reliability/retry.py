@@ -194,15 +194,20 @@ def call_with_retries(
     body (so an ``oom*1`` spec is a genuine transient: fails once, passes
     on retry).  Transient errors back off and retry up to the policy
     bound, recording each retry in the degradation ledger; user/fatal
-    errors — and exhaustion — re-raise unchanged."""
-    from fastapriori_tpu.reliability import ledger
+    errors — and exhaustion — re-raise unchanged.  Each attempt runs
+    under the dispatch watchdog (reliability/watchdog.py): with
+    ``FA_DISPATCH_TIMEOUT_S`` set, a hung fetch is abandoned after the
+    bound and surfaces as a transient ``DEADLINE_EXCEEDED`` — retried
+    like any other flap, so a wedged link can stall the pipeline for at
+    most attempts × timeout instead of forever."""
+    from fastapriori_tpu.reliability import ledger, watchdog
 
     policy = policy or policy_from_env()
     attempt = 0
     while True:
         try:
             failpoints.fire(site)
-            return thunk()
+            return watchdog.guard(thunk, site)
         except Exception as exc:
             kind = classify(exc)
             if kind != "transient" or attempt >= policy.max_attempts - 1:

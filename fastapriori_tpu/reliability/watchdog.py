@@ -1,0 +1,199 @@
+"""Dispatch watchdog + the unified degradation cascade (ISSUE 9).
+
+Two failure-handling pieces the per-subsystem fallbacks never had:
+
+**Watchdog.**  A device dispatch (or its blocking fetch) can hang
+forever — a wedged tunnel link, a deadlocked collective, a runtime bug
+— and nothing in the retry layer fires, because retries only see
+*raised* errors.  :func:`guard` bounds every audited fetch with a wall
+clock: past ``FA_DISPATCH_TIMEOUT_S`` (strictly parsed; unset/0 =
+disabled, the default) the blocked call is abandoned and a
+:class:`DispatchTimeout` is raised whose message carries the
+``DEADLINE_EXCEEDED`` status — so :func:`~fastapriori_tpu.reliability.
+retry.classify` sees a *transient*, the bounded retry policy gets its
+shot (the thunks are pure re-runnable host materializations), and
+exhaustion surfaces as a classified error naming the site instead of a
+silent multi-hour hang.  Every trip lands on the degradation ledger as
+a ``watchdog_timeout`` event.  The abandoned worker thread is daemonic:
+it cannot be killed (Python offers no safe cross-thread abort of a
+blocked C call), but it no longer blocks the pipeline — the closest
+in-process analog of Spark's speculative-task abandon.
+
+**Cascade.**  The engines already degrade in half a dozen places —
+fused→level salvage, vertical→bitmap, sparse→dense redo, device
+rules→host — but each fallback grew its own ad-hoc ledger kind, so "how
+degraded is this run" required knowing every kind.  :data:`CHAINS` is
+the ONE escalation policy: each subsystem's explicit downgrade order,
+and :func:`downgrade` the one event shape every fallback now ALSO
+emits (kind ``cascade`` with chain/from/to/rank fields), forward-only
+by construction — a downgrade can never silently "upgrade" back up a
+chain mid-mine.  The chain decisions are therefore uniformly visible in
+``--metrics`` streams, bench's ``degraded`` summary, and the chaos
+harness's invariant check (tools/chaos.py): a run that walked any chain
+can never masquerade as a clean one.
+
+Repeated *transient* failures walk these chains instead of killing the
+mine: the engine layers (models/apriori.py, rules/gen.py) catch a
+transient-classified error that survived its retry budget at a
+downgradeable site and step the chain — fused→level, vertical→bitmap,
+sparse→dense — re-running the exact-by-construction fallback engine.
+:func:`transient` is the shared classification predicate for those
+catch sites.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple, TypeVar
+
+from fastapriori_tpu.reliability import ledger
+
+T = TypeVar("T")
+
+# The unified escalation policy: per subsystem, the explicit downgrade
+# order (most capable first, the always-defined oracle last).  Every
+# stage name matches the vocabulary the engine-selection ledger events
+# already use; tests pin this ordering (a reordering is a semantic
+# change to every fallback site).
+CHAINS: Dict[str, Tuple[str, ...]] = {
+    # Mining control flow: whole-lattice fused program -> seeded tail
+    # fold -> one dispatch per level.
+    "engine": ("fused", "tail", "level"),
+    # Mining layout: Eclat tid-lanes -> horizontal bitmap matmuls.
+    "mine_engine": ("vertical", "bitmap"),
+    # Mesh count reduction: threshold-sparse exchange -> dense psum.
+    "count_reduce": ("sparse", "dense"),
+    # Phase-2 rule generation: sharded device join -> device-0 join ->
+    # host numpy oracle.
+    "rule_engine": ("sharded", "device", "host"),
+    # Recommender first-match scan: resident device table -> host scan.
+    "rule_scan": ("device", "host"),
+}
+
+
+def chain_rank(chain: str, stage: str) -> int:
+    """Position of ``stage`` in its chain (0 = most capable)."""
+    return CHAINS[chain].index(stage)
+
+
+def downgrade(
+    chain: str,
+    frm: str,
+    to: str,
+    reason: str,
+    once_key: Optional[str] = None,
+    **fields: Any,
+) -> None:
+    """Record one walk down an escalation chain.  ``frm``/``to`` must
+    both belong to ``chain`` and the walk must be FORWARD (toward the
+    oracle end) — a backward call is a wiring bug and raises
+    immediately rather than logging an impossible trail.  The event
+    lands on the degradation ledger (kind ``cascade``) and therefore in
+    ``--metrics`` and bench's ``degraded``/cascade-trail fields."""
+    order = CHAINS.get(chain)
+    if order is None:
+        raise ValueError(f"unknown cascade chain {chain!r}")
+    i, j = order.index(frm), order.index(to)
+    if j <= i:
+        raise ValueError(
+            f"cascade {chain}: {frm!r} -> {to!r} walks backward "
+            f"(chain order {order})"
+        )
+    ledger.record(
+        "cascade",
+        once_key=once_key or f"{chain}:{frm}>{to}:{reason}",
+        chain=chain,
+        frm=frm,
+        to=to,
+        rank=j,
+        reason=reason,
+        **fields,
+    )
+
+
+def transient(exc: BaseException) -> bool:
+    """True when ``exc`` is transient-classified (retry.classify) — the
+    shared predicate for the chain-walking catch sites.  Deliberately
+    narrow: user errors and fatal errors must keep propagating (walking
+    a chain cannot fix a malformed input or a shape bug), and
+    BaseExceptions (InjectedAbort, KeyboardInterrupt) never reach these
+    ``except Exception`` sites at all."""
+    from fastapriori_tpu.reliability import retry
+
+    return retry.classify(exc) == "transient"
+
+
+class DispatchTimeout(RuntimeError):
+    """A watchdog-abandoned dispatch/fetch.  The message leads with the
+    ``DEADLINE_EXCEEDED`` status so retry.classify sees a transient —
+    the same contract a real XLA deadline error carries."""
+
+
+_timeout_memo: Optional[float] = None
+
+
+def dispatch_timeout_s() -> float:
+    """The process-wide watchdog bound (seconds): ``FA_DISPATCH_TIMEOUT_S``,
+    strictly parsed (a typo'd value raises InputError — the FA_NO_PALLAS
+    contract); 0/unset disables.  Parsed once per process; tests use
+    :func:`reload_from_env`."""
+    global _timeout_memo
+    if _timeout_memo is None:
+        from fastapriori_tpu.utils.env import env_float
+
+        _timeout_memo = env_float(
+            "FA_DISPATCH_TIMEOUT_S", 0.0, minimum=0.0
+        )
+    return _timeout_memo
+
+
+def reload_from_env() -> None:
+    """Re-read ``FA_DISPATCH_TIMEOUT_S`` (tests; otherwise read once)."""
+    global _timeout_memo
+    _timeout_memo = None
+
+
+def guard(
+    thunk: Callable[[], T],
+    site: str,
+    timeout_s: Optional[float] = None,
+) -> T:
+    """Run ``thunk`` under the watchdog bound.  Disabled (the default)
+    this is a plain call — zero threads, zero overhead beyond one memo
+    read.  Enabled, the thunk runs on a fresh daemon thread and the
+    caller waits at most ``timeout_s``; past it the thread is abandoned
+    and :class:`DispatchTimeout` raises (classified transient, ledger
+    ``watchdog_timeout`` event).  Exceptions from the thunk — including
+    BaseExceptions like an injected abort — re-raise on the caller."""
+    bound = dispatch_timeout_s() if timeout_s is None else timeout_s
+    if not bound:
+        return thunk()
+    box: list = []
+
+    def run() -> None:
+        try:
+            box.append(("ok", thunk()))
+        # lint: waive G006 -- captured into the box and re-raised verbatim on the caller thread below
+        except BaseException as exc:
+            box.append(("err", exc))
+
+    worker = threading.Thread(
+        target=run, name=f"fa-watchdog:{site}", daemon=True
+    )
+    worker.start()
+    worker.join(bound)
+    if not box:
+        ledger.record(
+            "watchdog_timeout", once_key=site, site=site,
+            timeout_s=bound,
+        )
+        raise DispatchTimeout(
+            f"DEADLINE_EXCEEDED: dispatch watchdog abandoned {site!r} "
+            f"after {bound}s (FA_DISPATCH_TIMEOUT_S) — the in-flight "
+            "device work may still complete; the retried thunk is a "
+            "pure re-runnable materialization"
+        )
+    kind, payload = box[0]
+    if kind == "err":
+        raise payload
+    return payload

@@ -30,7 +30,7 @@ import numpy as np
 
 from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.ops.bitmap import next_pow2 as _next_pow2
-from fastapriori_tpu.reliability import ledger, retry
+from fastapriori_tpu.reliability import ledger, retry, watchdog
 
 Rule = Tuple[FrozenSet[int], int, float]  # (antecedent, consequent, confidence)
 
@@ -190,6 +190,10 @@ def _pick_rule_engine(mats, context, config) -> str:
             ledger.record(
                 "rule_gen_fallback", reason="no_device_context", raw_rules=raw
             )
+            watchdog.downgrade(
+                "rule_engine", "device", "host",
+                reason="no_device_context",
+            )
         return "host"
     if _max_count(mats) >= _DEVICE_COUNT_CAP:
         if engine == "device":
@@ -197,6 +201,10 @@ def _pick_rule_engine(mats, context, config) -> str:
                 "rule_gen_fallback",
                 reason="counts_exceed_2^24",
                 raw_rules=raw,
+            )
+            watchdog.downgrade(
+                "rule_engine", "device", "host",
+                reason="counts_exceed_2^24",
             )
         return "host"
     if engine == "auto":
@@ -378,9 +386,25 @@ def rule_arrays_from_tables(
         # (the 8·S row-padding layout would not match otherwise).
         if shards != context.txn_shards:
             scan_state = None
-        return _rule_arrays_device(
-            mats, context, metrics, shards=shards, state=scan_state
-        )
+        try:
+            return _rule_arrays_device(
+                mats, context, metrics, shards=shards, state=scan_state
+            )
+        except Exception as exc:
+            # Repeated transients at the device joins' fetch sites walk
+            # the cascade to the host oracle (bit-identical by the
+            # differential contract) instead of killing phase 2.
+            if not watchdog.transient(exc):
+                raise
+            watchdog.downgrade(
+                "rule_engine",
+                "sharded" if shards > 1 else "device",
+                "host",
+                reason="transient_exhausted",
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
+            if scan_state is not None:
+                scan_state.release()
     return _rule_arrays_host(mats)
 
 

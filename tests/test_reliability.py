@@ -22,7 +22,7 @@ from fastapriori_tpu.io import resume as resume_io
 from fastapriori_tpu.io import writer
 from fastapriori_tpu.io.reader import tokenize_line
 from fastapriori_tpu.models.apriori import FastApriori
-from fastapriori_tpu.reliability import failpoints, ledger, retry
+from fastapriori_tpu.reliability import failpoints, ledger, retry, watchdog
 from fastapriori_tpu.utils.logging import MetricsLogger
 
 
@@ -30,9 +30,11 @@ from fastapriori_tpu.utils.logging import MetricsLogger
 def _clean_reliability_state():
     failpoints.disarm_all()
     ledger.reset()
+    watchdog.reload_from_env()
     yield
     failpoints.disarm_all()
     ledger.reset()
+    watchdog.reload_from_env()
 
 
 # ---------------------------------------------------------------------------
@@ -1027,3 +1029,619 @@ def test_kill_mid_drain_then_resume_bit_exact(tmp_path):
         open(out_a + "freqItemset", "rb").read()
         == open(out_b + "freqItemset", "rb").read()
     )
+
+
+# ---------------------------------------------------------------------------
+# dispatch watchdog (FA_DISPATCH_TIMEOUT_S) — ISSUE 9
+
+
+def test_watchdog_disabled_is_passthrough():
+    assert watchdog.dispatch_timeout_s() == 0.0
+    assert watchdog.guard(lambda: 41 + 1, "fetch.x") == 42
+
+
+def test_watchdog_timeout_classified_transient_and_recorded():
+    import time as _time
+
+    with pytest.raises(watchdog.DispatchTimeout) as ei:
+        watchdog.guard(
+            lambda: _time.sleep(0.5) or 1, "fetch.hang", timeout_s=0.05
+        )
+    # The contract: the abandoned dispatch classifies TRANSIENT (the
+    # retry policy gets its bounded shot) and names the site.
+    assert retry.classify(ei.value) == "transient"
+    assert "fetch.hang" in str(ei.value)
+    kinds = [e["kind"] for e in ledger.snapshot()]
+    assert "watchdog_timeout" in kinds
+
+
+def test_watchdog_propagates_thunk_errors():
+    def boom():
+        raise ValueError("real bug")
+
+    with pytest.raises(ValueError, match="real bug"):
+        watchdog.guard(boom, "fetch.x", timeout_s=5.0)
+
+
+def test_watchdog_env_strictly_parsed(monkeypatch):
+    monkeypatch.setenv("FA_DISPATCH_TIMEOUT_S", "fast")
+    watchdog.reload_from_env()
+    with pytest.raises(InputError, match="FA_DISPATCH_TIMEOUT_S"):
+        watchdog.dispatch_timeout_s()
+    monkeypatch.setenv("FA_DISPATCH_TIMEOUT_S", "-1")
+    watchdog.reload_from_env()
+    with pytest.raises(InputError, match="out of range"):
+        watchdog.dispatch_timeout_s()
+    monkeypatch.setenv("FA_DISPATCH_TIMEOUT_S", "2.5")
+    watchdog.reload_from_env()
+    assert watchdog.dispatch_timeout_s() == 2.5
+
+
+def test_watchdog_bounds_retried_fetch_end_to_end(monkeypatch):
+    """The guard rides INSIDE call_with_retries: a hung fetch times out,
+    retries (transient), and exhaustion raises the classified
+    DispatchTimeout — a bounded stall, never a hang."""
+    import time as _time
+
+    monkeypatch.setenv("FA_DISPATCH_TIMEOUT_S", "0.05")
+    watchdog.reload_from_env()
+    calls = []
+
+    def hang():
+        calls.append(1)
+        _time.sleep(0.4)
+        return 7
+
+    with pytest.raises(watchdog.DispatchTimeout):
+        retry.call_with_retries(
+            hang, "fetch.hang2",
+            policy=retry.RetryPolicy(max_attempts=2, base_delay_s=0.0),
+            sleep=lambda s: None,
+        )
+    assert len(calls) == 2  # first try + one retry, both bounded
+    kinds = [e["kind"] for e in ledger.snapshot()]
+    assert "watchdog_timeout" in kinds and "retry" in kinds
+
+
+def test_watchdog_recovered_fetch_succeeds(monkeypatch):
+    """A timeout on attempt 1 followed by a fast attempt 2 = the flap
+    the watchdog+retry pairing exists for."""
+    import time as _time
+
+    monkeypatch.setenv("FA_DISPATCH_TIMEOUT_S", "0.08")
+    watchdog.reload_from_env()
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            _time.sleep(0.5)
+        return 7
+
+    out = retry.call_with_retries(
+        flaky, "fetch.flap",
+        policy=retry.RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        sleep=lambda s: None,
+    )
+    assert out == 7
+
+
+# ---------------------------------------------------------------------------
+# unified degradation cascade — ISSUE 9
+
+
+def test_cascade_chain_ordering_pinned():
+    """The escalation policy is ONE table; reordering it changes the
+    semantics of every fallback site, so the exact orders are pinned."""
+    assert watchdog.CHAINS == {
+        "engine": ("fused", "tail", "level"),
+        "mine_engine": ("vertical", "bitmap"),
+        "count_reduce": ("sparse", "dense"),
+        "rule_engine": ("sharded", "device", "host"),
+        "rule_scan": ("device", "host"),
+    }
+    assert watchdog.chain_rank("engine", "fused") == 0
+    assert watchdog.chain_rank("engine", "level") == 2
+
+
+def test_cascade_forward_only():
+    watchdog.downgrade("engine", "fused", "level", reason="test")
+    with pytest.raises(ValueError, match="backward"):
+        watchdog.downgrade("engine", "level", "fused", reason="up")
+    with pytest.raises(ValueError, match="backward"):
+        watchdog.downgrade("engine", "tail", "tail", reason="noop")
+    with pytest.raises(ValueError, match="unknown cascade chain"):
+        watchdog.downgrade("nope", "a", "b", reason="x")
+
+
+def test_cascade_event_shape_reaches_metrics():
+    m = MetricsLogger(enabled=False).bind_global_ledger()
+    watchdog.downgrade(
+        "count_reduce", "sparse", "dense", reason="union_overflow",
+        site="level", k=4,
+    )
+    ev = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert ev == [
+        {
+            "kind": "cascade", "chain": "count_reduce", "frm": "sparse",
+            "to": "dense", "rank": 1, "reason": "union_overflow",
+            "site": "level", "k": 4,
+        }
+    ]
+    degraded = [r for r in m.records if r.get("event") == "degraded"]
+    assert degraded and degraded[0]["chain"] == "count_reduce"
+
+
+def _deep_dataset():
+    """A lattice reaching k=6 (a planted 6-itemset) plus noise — deep
+    enough for multi-segment fused checkpointing and tail folds."""
+    rng_lines = random_dataset(5, n_txns=110)
+    return [
+        tokenize_line(l)
+        for l in (["1 2 3 4 5 6"] * 50 + rng_lines)
+    ]
+
+
+def test_fused_transient_exhaustion_cascades_to_level_engine():
+    """Unlimited oom at fetch.fused exhausts the retry budget; the
+    cascade walks engine fused->level and the mine still succeeds,
+    bit-exact."""
+    txns = _deep_dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.fused", "oom")  # every attempt
+    miner = FastApriori(
+        config=MinerConfig(min_support=0.08, engine="fused")
+    )
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    casc = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert any(
+        e["chain"] == "engine"
+        and e["frm"] == "fused"
+        and e["to"] == "level"
+        and e["reason"] == "transient_exhausted"
+        for e in casc
+    )
+
+
+def test_tail_transient_exhaustion_cascades_to_level_engine():
+    """Unlimited oom at fetch.tail: the fold's fetch exhausts, the
+    cascade records tail->level, and the per-level engine finishes the
+    lattice bit-exact."""
+    txns = _deep_dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.tail", "oom")
+    miner = FastApriori(
+        config=_mine_config(tail_fuse_rows=1 << 20)  # force folding
+    )
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    casc = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert any(
+        e["chain"] == "engine" and e["frm"] == "tail" and e["to"] == "level"
+        for e in casc
+    )
+
+
+def test_vertical_transient_exhaustion_cascades_to_bitmap():
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.vpair", "oom")  # every attempt
+    miner = FastApriori(
+        config=_mine_config(mine_engine="vertical", count_reduce="dense")
+    )
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    casc = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert any(
+        e["chain"] == "mine_engine"
+        and e["frm"] == "vertical"
+        and e["to"] == "bitmap"
+        and e["reason"] == "transient_exhausted"
+        for e in casc
+    )
+
+
+def test_vertical_transient_exhaustion_cascades_on_file_pipeline(
+    tmp_path,
+):
+    """The walk-the-chain contract must hold on the REAL ingest path:
+    run_file's pipelined paths enter the vertical engine directly, not
+    through mine() — regression for the cascade arm living only on the
+    mine() entry point."""
+    lines = random_dataset(7, n_txns=120)
+    d_path = tmp_path / "D.dat"
+    d_path.write_text("".join(l + "\n" for l in lines))
+    clean = FastApriori(config=_mine_config()).run_file(str(d_path))[0]
+    ledger.reset()
+    failpoints.arm("fetch.vpair", "oom")  # every attempt
+    miner = FastApriori(
+        config=_mine_config(mine_engine="vertical", count_reduce="dense")
+    )
+    got = miner.run_file(str(d_path))[0]
+    assert sorted(got) == sorted(clean)
+    casc = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert any(
+        e["chain"] == "mine_engine"
+        and e["frm"] == "vertical"
+        and e["to"] == "bitmap"
+        and e["reason"] == "transient_exhausted"
+        for e in casc
+    )
+
+
+def test_vertical_transient_cascade_preserves_resume_state(tmp_path):
+    """A resumed mine that cascades vertical->bitmap must re-seed the
+    fallback from its checkpoint, not re-mine the lattice from scratch:
+    the vertical attempt consumes the one-shot resume state before it
+    fails, and the cascade arm restores it (regression — progress loss
+    would still be byte-identical, so pin the level events too).  A
+    planted 5-deep itemset keeps the lattice mining PAST the level-3
+    kill point, so the resumed run genuinely dispatches (and floods)
+    the deep-level fetch."""
+    txns = _dataset() + [["1", "2", "3", "4", "5"]] * 30
+    prefix = str(tmp_path) + "/"
+    clean_sets, _, clean_items = FastApriori(config=_mine_config()).run(
+        txns
+    )
+    failpoints.arm("level.3", "abort")  # die right after level 3 commits
+    miner = FastApriori(config=_mine_config(checkpoint_prefix=prefix))
+    with pytest.raises(failpoints.InjectedAbort):
+        miner.run(txns)
+    failpoints.disarm_all()
+    ledger.reset()
+    levels, meta = ckpt.load_checkpoint(prefix)
+    # The resumed vertical mine starts at level 4 (pair level skipped),
+    # so the deep-level fetch is the site to flood.
+    failpoints.arm("fetch.vlevel_bits", "oom")  # every attempt
+    resumed = FastApriori(
+        config=_mine_config(mine_engine="vertical", count_reduce="dense")
+    )
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    got_sets, _, got_items = resumed.run(txns)
+    assert got_items == clean_items
+    assert sorted(got_sets) == sorted(clean_sets)
+    casc = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert any(
+        e["chain"] == "mine_engine" and e["reason"] == "transient_exhausted"
+        for e in casc
+    )
+    # The checkpointed levels were honored: the bitmap fallback never
+    # recounted a level the checkpoint already carried.
+    ks = [
+        r["k"]
+        for r in resumed.metrics.records
+        if r.get("event") == "level" and "k" in r
+    ]
+    assert ks and min(ks) > 3, ks
+
+
+def test_sparse_transient_exhaustion_recounts_dense():
+    """Unlimited oom at the sparse level fetch: the level recounts
+    DENSE (cascade count_reduce sparse->dense) instead of dying — the
+    dense fetch is its own audited site with a fresh budget."""
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    ledger.reset()
+    failpoints.arm("fetch.level_bits_sparse", "oom")
+    miner = FastApriori(config=_sparse_config())
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    casc = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert any(
+        e["chain"] == "count_reduce"
+        and e["frm"] == "sparse"
+        and e["to"] == "dense"
+        and e["reason"] == "transient_exhausted"
+        for e in casc
+    )
+
+
+def test_forced_vertical_fallback_records_cascade():
+    """A forced vertical engine on an ineligible shape (no CSR) walks
+    mine_engine vertical->bitmap with the unified event, alongside the
+    legacy mine_engine_fallback kind."""
+    from fastapriori_tpu.preprocess import preprocess
+
+    txns = _dataset()
+    data = preprocess(txns, 0.08)
+    data.basket_offsets = data.basket_offsets[:1]  # simulate no CSR
+    miner = FastApriori(
+        config=_mine_config(mine_engine="vertical")
+    )
+    ledger.reset()
+    engine, _req = miner._mine_engine(data)
+    assert engine == "bitmap"
+    kinds = {e["kind"] for e in ledger.snapshot()}
+    assert {"mine_engine_fallback", "cascade"} <= kinds
+
+
+# ---------------------------------------------------------------------------
+# fused-engine checkpointing: resumable segments — ISSUE 9 tentpole (a)
+
+
+def _fused_ckpt_config(prefix, cadence):
+    return MinerConfig(
+        min_support=0.08, engine="fused",
+        checkpoint_prefix=prefix, checkpoint_every_levels=cadence,
+    )
+
+
+def clean_sets_depth(sets):
+    return max(len(s) for s, _c in sets)
+
+
+@pytest.mark.parametrize(
+    "cadence,kill_site",
+    [(1, "level.3"), (2, "level.4"), (4, "level.6")],
+)
+def test_fused_checkpoint_kill_resume_byte_identical(
+    tmp_path, cadence, kill_site
+):
+    """Acceptance (ISSUE 9): engine=fused under --checkpoint-every-level
+    mines in segments; killing right after a segment commit and
+    resuming produces BYTE-identical writer output, at every checkpoint
+    cadence.  The kill site tracks the cadence — a segment of depth c
+    commits (and fires) only its deepest level's hook."""
+    txns = _deep_dataset()
+    prefix = str(tmp_path) + "/"
+    clean_sets, _, clean_items = FastApriori(config=_mine_config()).run(
+        txns
+    )
+    failpoints.arm(kill_site, "abort")  # first segment commit at depth c
+    miner = FastApriori(config=_fused_ckpt_config(prefix, cadence))
+    with pytest.raises(failpoints.InjectedAbort):
+        miner.run(txns)
+    failpoints.disarm_all()
+    levels, meta = ckpt.load_checkpoint(prefix)
+    assert levels[-1][0].shape[1] >= 3
+    resumed = FastApriori(config=_fused_ckpt_config(prefix, cadence))
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    got_sets, _, got_items = resumed.run(txns)
+    assert got_items == clean_items
+    out_a, out_b = str(tmp_path / "a_"), str(tmp_path / "b_")
+    writer.save_freq_itemsets(out_a, clean_sets, clean_items)
+    writer.save_freq_itemsets(out_b, got_sets, got_items)
+    assert (
+        open(out_a + "freqItemset", "rb").read()
+        == open(out_b + "freqItemset", "rb").read()
+    )
+    # The resumed mine really ran fused SEGMENTS, not the level loop
+    # (except at the deepest-possible kill, where the lattice is
+    # already complete and resume has nothing left to dispatch).
+    segs = [
+        r for r in resumed.metrics.records
+        if r.get("event") == "tail_fuse" and r.get("checkpoint_segment")
+    ]
+    if levels[-1][0].shape[1] < clean_sets_depth(clean_sets):
+        assert segs, "no fused checkpoint segment dispatched on resume"
+
+
+def test_fused_checkpoint_cadence_controls_segments(tmp_path):
+    """Cadence 1 dispatches one segment per level; a larger cadence
+    folds several levels into each segment (fewer dispatches, same
+    lattice) — and every segment commit is a durable checkpoint."""
+    txns = _deep_dataset()
+    counts = {}
+    for cadence in (1, 3):
+        prefix = str(tmp_path / f"c{cadence}") + "/"
+        os.makedirs(prefix)
+        miner = FastApriori(config=_fused_ckpt_config(prefix, cadence))
+        miner.run(txns)
+        segs = [
+            r for r in miner.metrics.records
+            if r.get("event") == "tail_fuse"
+            and r.get("checkpoint_segment")
+        ]
+        assert segs and all(r["l_max"] == cadence for r in segs)
+        # Each segment mines at most `cadence` levels.
+        assert all(r["levels"] <= cadence for r in segs)
+        counts[cadence] = len(segs)
+        assert ckpt.checkpoint_available(prefix)
+    assert counts[1] > counts[3]
+
+
+def test_cli_fused_checkpoint_kill_resume(tmp_path):
+    """The CLI spelling of the same acceptance: --engine fused
+    --checkpoint-every-level --checkpoint-cadence 2, killed and resumed
+    byte-identically."""
+    from fastapriori_tpu.cli import main
+
+    d_raw = ["1 2 3 4 5 6"] * 50 + random_dataset(5, n_txns=110)
+    u_raw = random_dataset(13, n_txns=20)
+    inp = _write_inputs(tmp_path, d_raw, u_raw)
+    out_clean = str(tmp_path / "clean") + "/"
+    out_ckpt = str(tmp_path / "ckpt") + "/"
+    os.makedirs(out_clean)
+    os.makedirs(out_ckpt)
+    assert main([inp, out_clean, "--min-support", "0.08"]) == 0
+
+    failpoints.arm("level.4", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        main(
+            [inp, out_ckpt, "--min-support", "0.08",
+             "--engine", "fused", "--checkpoint-every-level",
+             "--checkpoint-cadence", "2"]
+        )
+    failpoints.disarm_all()
+    assert os.path.exists(out_ckpt + "checkpoint.npz")
+    rc = main(
+        [inp, out_ckpt, "--min-support", "0.08", "--engine", "fused",
+         "--checkpoint-every-level", "--checkpoint-cadence", "2",
+         "--resume-from", out_ckpt]
+    )
+    assert rc == 0
+    for name in ("freqItemset", "recommends"):
+        assert (
+            open(out_ckpt + name, "rb").read()
+            == open(out_clean + name, "rb").read()
+        )
+
+
+def test_fused_checkpoint_segment_overflow_degrades_to_per_level(
+    tmp_path,
+):
+    """A segment whose level outgrows the (headroomed) row budget walks
+    the cascade to per-level dispatches — ledger-visible — and the mine
+    stays bit-exact.  min_prefix_bucket pins the budget floor tiny so
+    the planted lattice overflows it."""
+    txns = _deep_dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    prefix = str(tmp_path) + "/"
+    ledger.reset()
+    cfg = MinerConfig(
+        min_support=0.08, engine="fused", checkpoint_prefix=prefix,
+        checkpoint_every_levels=2, min_prefix_bucket=1,
+        fused_hbm_budget_bytes=1 << 14,  # starve the memory model
+    )
+    got = FastApriori(config=cfg).run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    casc = [e for e in ledger.snapshot() if e["kind"] == "cascade"]
+    assert any(
+        e["chain"] == "engine" and e["to"] == "level" for e in casc
+    )
+
+
+# ---------------------------------------------------------------------------
+# chaos harness determinism — ISSUE 9 tentpole (c)
+
+
+def test_chaos_schedule_deterministic():
+    from tools import chaos
+
+    s1 = chaos.make_schedule(42)
+    s2 = chaos.make_schedule(42)
+    assert s1 == s2
+    assert s1["failpoints"]  # never an empty schedule
+    assert any(
+        chaos.make_schedule(seed) != s1 for seed in (43, 44, 45)
+    )
+
+
+def test_chaos_sites_enroll_from_lint_census():
+    """The schedule space is drawn from the lint-censused inventory:
+    every censused fetch site is armable, so a NEW fetch site joins the
+    soak the moment the inventory regenerates."""
+    from tools import chaos
+
+    sites = chaos.enrolled_sites()
+    census = chaos.fetch_sites_from_inventory()
+    assert set(census) <= set(sites)
+    # Spot-pin the core engine sites (present since PR 2-8).
+    for s in ("fetch.fused", "fetch.tail", "fetch.pair", "fetch.vpair"):
+        assert s in sites
+    for seed in range(20):
+        sch = chaos.make_schedule(seed, sites)
+        assert sch["failpoints"]
+        assert set(sch["failpoints"]) <= set(sites)
+        if any(v.startswith("abort") for v in sch["failpoints"].values()):
+            assert sch["checkpoint"], "abort schedules must checkpoint"
+
+
+def test_chaos_schedule_respects_kind_menu():
+    from tools import chaos
+
+    sites = chaos.enrolled_sites()
+    for seed in range(30):
+        for site, spec in chaos.make_schedule(seed, sites)[
+            "failpoints"
+        ].items():
+            kind = spec.split("@")[0].split("*")[0]
+            assert kind in sites[site], (site, spec)
+            failpoints.parse_spec(f"{site}:{spec}")  # armable
+
+
+# ---------------------------------------------------------------------------
+# multi-host checkpoint path (simulated; the real 2-process case is
+# version-gated in tests/test_distributed.py) — ISSUE 9 satellite
+
+
+def test_multiprocess_checkpoint_only_process0_writes(
+    tmp_path, monkeypatch
+):
+    """The process-0-writes discipline (ROADMAP residue): a non-zero
+    process under a checkpoint prefix must mine identically but NEVER
+    write the checkpoint — two processes racing the same atomic rename
+    is exactly the torn-artifact class the committer exists to kill."""
+    import jax
+
+    txns = _dataset()
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    prefix = str(tmp_path) + "/"
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    miner = FastApriori(config=_mine_config(checkpoint_prefix=prefix))
+    got = miner.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    assert not os.path.exists(prefix + "checkpoint.npz")
+    # The level.<k> kill hooks still fired on this process (they gate
+    # SPMD-global kill points, not the write).
+    assert not any(
+        r.get("event") == "checkpoint" for r in miner.metrics.records
+    )
+
+
+def test_multiprocess_checkpoint_resume_with_manifest_cross_check(
+    tmp_path, monkeypatch
+):
+    """Process 0 writes the checkpoint; a SIMULATED peer process
+    validates it against the manifest (bytes + sha256 + structural
+    lattice check) and resumes from it bit-exact — without ever
+    rewriting process 0's artifact."""
+    import jax
+
+    txns = _dataset()
+    prefix = str(tmp_path) + "/"
+    clean = FastApriori(config=_mine_config()).run(txns)[0]
+    failpoints.arm("level.3", "abort")
+    with pytest.raises(failpoints.InjectedAbort):
+        FastApriori(
+            config=_mine_config(checkpoint_prefix=prefix)
+        ).run(txns)
+    failpoints.disarm_all()
+
+    # Manifest cross-check: committed bytes match the recorded intent.
+    manifest = resume_io.load_manifest(prefix)
+    raw = open(prefix + "checkpoint.npz", "rb").read()
+    resume_io.validate_artifact_bytes(
+        prefix, "checkpoint.npz", raw, manifest
+    )
+    meta = ckpt.validate_checkpoint(prefix)
+    assert meta["min_count"] >= 1
+
+    # The peer process resumes; process-0-writes keeps its hands off.
+    levels, meta2 = ckpt.load_checkpoint(prefix)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    resumed = FastApriori(
+        config=_mine_config(checkpoint_prefix=prefix)
+    )
+    resumed.set_resume_levels(levels, meta2, label=prefix)
+    got = resumed.run(txns)[0]
+    assert sorted(got) == sorted(clean)
+    assert open(prefix + "checkpoint.npz", "rb").read() == raw
+
+
+def test_validate_checkpoint_rejects_corrupt_lattice(tmp_path):
+    """validate_checkpoint (the chaos harness's no-corrupt-artifact
+    check) rejects structurally valid npz files whose lattice violates
+    the mining contract."""
+    prefix = str(tmp_path) + "/"
+    bad_counts = [
+        (np.array([[0, 1]], np.int32), np.array([2], np.int64)),
+    ]
+    ckpt.save_checkpoint(prefix, bad_counts, _meta(min_count=5))
+    with pytest.raises(InputError, match="below min_count"):
+        ckpt.validate_checkpoint(prefix)
+    bad_ranks = [
+        (np.array([[0, 9]], np.int32), np.array([9], np.int64)),
+    ]
+    ckpt.save_checkpoint(prefix, bad_ranks, _meta(num_items=7))
+    with pytest.raises(InputError, match="outside"):
+        ckpt.validate_checkpoint(prefix)
+    good = [
+        (np.array([[0, 1]], np.int32), np.array([9], np.int64)),
+    ]
+    ckpt.save_checkpoint(prefix, good, _meta())
+    assert ckpt.validate_checkpoint(prefix) == _meta()

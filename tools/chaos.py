@@ -1,0 +1,482 @@
+"""Seeded chaos soak harness (ISSUE 9): deterministic randomized
+failpoint schedules against the full mine+rules+recommend pipeline.
+
+The point tests (tests/test_reliability.py, tools/failpoint_smoke.py)
+each arm ONE hand-picked site; with 20+ audited fetch sites and a
+five-deep engine-fallback stack the *interaction* space is far larger
+than they cover.  This harness derives schedules from a seeded RNG over
+``sites × kinds × counts`` — the site list comes from the lint-censused
+contract inventory (``tools/lint/inventory.json``), so every NEW fetch
+site is auto-enrolled the moment the census regenerates — and runs the
+real CLI pipeline under each, asserting the global invariant:
+
+    Every scenario ends in exactly one of:
+      1. **byte-identical output** to the clean run (degradations
+         allowed — they are counted from the ledger);
+      2. a **classified error naming the site** (InputError exit 2, a
+         transient/injected status error, or the InjectedAbort kill
+         stand-in — after which a checkpointed run must resume
+         byte-identically and a truncated artifact must be REJECTED by
+         manifest validation);
+      3. never anything else: a hang (per-scenario wall bound enforced
+         from a watchdog thread), silent corruption (different bytes
+         with rc 0 and no truncation armed), or an unclassified crash
+         (any exception outside the classification contract).
+
+Same seed → same schedule → same outcome (asserted by
+tests/test_reliability.py); the CI soak (`make chaos`, tools/ci.sh)
+runs a fixed seed set under a wall budget and logs its wall time like
+lint's 10 s budget.
+
+Usage::
+
+    python tools/chaos.py [--seeds 0,1,2,3] [--scenarios 4]
+                          [--budget-s 120] [--scenario-timeout-s 90]
+
+``FA_CHAOS_SEED`` (strict int) offsets the whole seed set — the knob
+for soaking a different schedule region without editing the CI set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # `python tools/chaos.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+_INVENTORY = os.path.join(_REPO_ROOT, "tools", "lint", "inventory.json")
+
+# Non-fetch sites worth soaking that the fetch census cannot enroll:
+# artifact writes (truncate/io), the post-commit level hooks (the
+# kill-and-resume kill points), the mid-mine drain, and the native
+# loader.  Kept small and explicit — fetch sites auto-enroll.
+EXTRA_SITES: Dict[str, Tuple[str, ...]] = {
+    "write.freqItemset": ("truncate", "io", "delay"),
+    "write.freqItems": ("truncate", "delay"),
+    "write.checkpoint.npz": ("truncate", "io", "delay"),
+    "level.2": ("abort", "delay"),
+    "level.3": ("abort", "delay"),
+    "level.4": ("abort",),
+    "drain.counts": ("oom", "delay"),
+    "native.load": ("io",),
+    "rules.upload": ("oom", "delay"),
+}
+
+_FETCH_KINDS = ("oom*1", "oom*2", "oom", "io", "delay")
+
+
+def fetch_sites_from_inventory(path: str = _INVENTORY) -> List[str]:
+    """``fetch.<label>`` for every censused fetch site — the
+    auto-enrollment contract: a new audited fetch site enters the soak
+    the moment ``--write-inventory`` regenerates the census."""
+    with open(path) as fh:
+        inv = json.load(fh)
+    return sorted(
+        "fetch." + e["label"] for e in inv.get("fetch_sites", [])
+    )
+
+
+def enrolled_sites(path: str = _INVENTORY) -> Dict[str, Tuple[str, ...]]:
+    """site -> candidate kinds, fetch census + the explicit extras."""
+    out: Dict[str, Tuple[str, ...]] = {
+        s: _FETCH_KINDS for s in fetch_sites_from_inventory(path)
+    }
+    out.update(EXTRA_SITES)
+    return out
+
+
+def make_schedule(seed: int, sites: Optional[Dict] = None) -> dict:
+    """ONE deterministic scenario from ``seed``: armed failpoint specs
+    plus the pipeline shape to run them under.  Pure function of the
+    seed and the (sorted) site inventory — tests pin same-seed
+    equality."""
+    if sites is None:
+        sites = enrolled_sites()
+    rng = random.Random(seed)
+    n = rng.randint(1, 3)
+    armed: Dict[str, str] = {}
+    for site in rng.sample(sorted(sites), n):
+        kind = rng.choice(sites[site])
+        if kind == "delay":
+            spec = f"delay@{rng.randint(1, 25)}"
+        elif kind == "truncate":
+            spec = f"truncate@{rng.randint(5, 60)}"
+        elif kind == "oom" and rng.random() < 0.5:
+            spec = f"oom*{rng.randint(1, 2)}"
+        else:
+            spec = kind
+        armed[site] = spec
+    has_abort = any(s.startswith("abort") for s in armed.values())
+    checkpoint = has_abort or rng.random() < 0.4
+    engine = rng.choice(("auto", "level", "fused"))
+    return {
+        "seed": seed,
+        "failpoints": armed,
+        "engine": engine,
+        "checkpoint": checkpoint,
+        "cadence": rng.choice((1, 2, 3)) if checkpoint else 1,
+    }
+
+
+def _base_seed() -> int:
+    """``FA_CHAOS_SEED`` offset for the whole seed set — strict parse
+    (the FA_NO_PALLAS contract: a typo'd seed silently soaking seed 0
+    would report coverage that never ran)."""
+    from fastapriori_tpu.utils.env import env_int
+
+    return env_int("FA_CHAOS_SEED", 0, minimum=0)
+
+
+def make_inputs(root: str) -> str:
+    """Deterministic tiny corpus (the failpoint_smoke shape, plus a
+    planted deep itemset so multi-segment fused-checkpoint schedules
+    exercise more than one segment)."""
+    rng = random.Random(11)
+    items = [str(i) for i in range(1, 13)]
+    weights = [1.0 / (i + 1) for i in range(12)]
+    lines = [
+        " ".join(rng.choices(items, weights=weights, k=rng.randint(1, 6)))
+        for _ in range(130)
+    ] + ["1 2 3 4 5"] * 20
+    inp = os.path.join(root, "in") + os.sep
+    os.makedirs(inp)
+    # lint: waive G009 -- soak INPUT fixtures in a fresh temp dir, not run artifacts
+    with open(os.path.join(inp, "D.dat"), "w") as f:
+        f.writelines(l + "\n" for l in lines)
+    # lint: waive G009 -- soak INPUT fixtures in a fresh temp dir, not run artifacts
+    with open(os.path.join(inp, "U.dat"), "w") as f:
+        f.writelines(l + "\n" for l in lines[:25])
+    return inp
+
+
+class Outcome:
+    __slots__ = ("kind", "detail")
+
+    def __init__(self, kind: str, detail: str = ""):
+        self.kind = kind  # identical | classified | killed_resumed | FAIL
+        self.detail = detail
+
+
+def _run_cli_bounded(argv: List[str], timeout_s: float):
+    """cli.main on a worker thread with a wall bound — the harness-side
+    no-hang assertion (the in-process analog of the dispatch watchdog).
+    Returns ``(rc_or_None, exception_or_None, hung)``."""
+    from fastapriori_tpu.cli import main
+
+    box: list = []
+
+    def run() -> None:
+        try:
+            box.append(("rc", main(argv)))
+        # lint: waive G006 -- captured (InjectedAbort is a BaseException) and judged against the invariant by the caller
+        except BaseException as exc:
+            box.append(("err", exc))
+
+    worker = threading.Thread(target=run, daemon=True)
+    worker.start()
+    worker.join(timeout_s)
+    if not box:
+        return None, None, True
+    kind, payload = box[0]
+    if kind == "rc":
+        return payload, None, False
+    return None, payload, False
+
+
+def _classified(exc: BaseException, armed: Dict[str, str]) -> bool:
+    """The invariant's "classified error naming the site" test: the
+    failure must be one of the contract's error shapes AND traceable —
+    its message names an armed site, carries an injected-failpoint
+    marker, cites the artifact-validation contract (manifest rejection
+    of a torn artifact names the FILE, not the write site), or
+    classifies transient under retry.classify.  A stray InputError
+    with none of those markers is a real regression and must count as
+    an UNCLASSIFIED crash, not ride the invariant."""
+    from fastapriori_tpu.errors import InputError
+    from fastapriori_tpu.reliability import failpoints, retry
+
+    if isinstance(exc, failpoints.InjectedAbort):
+        return True
+    msg = str(exc)
+    named = any(site in msg for site in armed) or (
+        "injected failpoint" in msg
+    )
+    contract = any(
+        w in msg for w in ("truncated", "corrupt", "manifest", "checkpoint")
+    )
+    if isinstance(exc, (InputError, FileNotFoundError, OSError)):
+        return named or contract or retry.classify(exc) == "transient"
+    if isinstance(exc, RuntimeError):
+        return retry.classify(exc) == "transient" or named
+    return False
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _validate_artifacts(out: str) -> Optional[str]:
+    """Manifest cross-validation of every committed artifact under
+    ``out``: returns the name of the first artifact the manifest
+    REJECTS (the truncation-detected case), None when all validate."""
+    from fastapriori_tpu.errors import InputError
+    from fastapriori_tpu.io import resume as resume_io
+
+    try:
+        manifest = resume_io.load_manifest(out)
+    except (InputError, FileNotFoundError):
+        return "MANIFEST.json"
+    for name in manifest:
+        try:
+            resume_io.validate_artifact_bytes(
+                out, name, _read(out + name), manifest
+            )
+        except (InputError, FileNotFoundError):
+            return name
+    return None
+
+
+def run_scenario(
+    schedule: dict, inp: str, root: str, clean: Dict[str, bytes],
+    timeout_s: float,
+) -> Outcome:
+    """One scenario under the invariant (module docstring)."""
+    from fastapriori_tpu.io.checkpoint import (
+        checkpoint_available,
+        validate_checkpoint,
+    )
+    from fastapriori_tpu.reliability import failpoints, ledger
+
+    out = os.path.join(root, f"s{schedule['seed']}") + os.sep
+    os.makedirs(out)
+    argv = [
+        inp, out, "--min-support", "0.08",
+        "--engine", schedule["engine"],
+    ]
+    if schedule["checkpoint"]:
+        argv += [
+            "--checkpoint-every-level",
+            "--checkpoint-cadence", str(schedule["cadence"]),
+        ]
+    ledger.reset()
+    failpoints.disarm_all()
+    for site, spec in schedule["failpoints"].items():
+        failpoints.arm(site, spec)
+    try:
+        rc, exc, hung = _run_cli_bounded(argv, timeout_s)
+    finally:
+        failpoints.disarm_all()
+    armed = schedule["failpoints"]
+    degraded = ledger.summary()
+    if hung:
+        return Outcome(
+            "FAIL", f"hang: no result within {timeout_s}s under {armed}"
+        )
+    truncated = any("truncate" in s for s in armed.values())
+    if exc is not None:
+        if not _classified(exc, armed):
+            return Outcome(
+                "FAIL",
+                f"unclassified crash {type(exc).__name__}: {exc} "
+                f"under {armed}",
+            )
+        if isinstance(exc, failpoints.InjectedAbort) and (
+            schedule["checkpoint"] and checkpoint_available(out)
+        ):
+            # The kill contract: a structurally valid checkpoint that
+            # resumes to byte-identical output.  A schedule may ALSO
+            # have armed a truncation against the checkpoint write —
+            # then validation REJECTING the torn file is exactly the
+            # manifest contract (invariant case 2), while a rejected
+            # checkpoint with no truncation armed is real corruption.
+            from fastapriori_tpu.errors import InputError
+            try:
+                validate_checkpoint(out)
+            except InputError as verr:
+                if any(
+                    site.startswith("write.checkpoint")
+                    and "truncate" in spec
+                    for site, spec in armed.items()
+                ):
+                    return Outcome(
+                        "classified",
+                        f"torn checkpoint rejected: {verr}",
+                    )
+                return Outcome(
+                    "FAIL",
+                    f"corrupt checkpoint with no truncation armed: "
+                    f"{verr} under {armed}",
+                )
+            rc2, exc2, hung2 = _run_cli_bounded(
+                [inp, out, "--min-support", "0.08",
+                 "--resume-from", out],
+                timeout_s,
+            )
+            if hung2 or exc2 is not None or rc2 != 0:
+                return Outcome(
+                    "FAIL",
+                    f"resume after kill failed (rc={rc2}, exc={exc2}) "
+                    f"under {armed}",
+                )
+            for name, want in clean.items():
+                if _read(out + name) != want:
+                    return Outcome(
+                        "FAIL",
+                        f"resumed {name} differs from clean run "
+                        f"under {armed}",
+                    )
+            return Outcome("killed_resumed", str(armed))
+        return Outcome("classified", f"{type(exc).__name__} under {armed}")
+    if rc == 2:
+        return Outcome("classified", f"exit 2 under {armed}")
+    if rc != 0:
+        return Outcome("FAIL", f"unexpected exit code {rc} under {armed}")
+    for name, want in clean.items():
+        if _read(out + name) == want:
+            continue
+        if truncated and _validate_artifacts(out) is not None:
+            # Not silent: the manifest rejects the torn artifact, which
+            # is the truncation contract (io/writer.py).
+            return Outcome("classified", f"truncation detected ({name})")
+        return Outcome(
+            "FAIL",
+            f"SILENT CORRUPTION: {name} differs (rc 0, "
+            f"degraded={degraded}) under {armed}",
+        )
+    kind = "degraded" if degraded.get("cascade") else "identical"
+    return Outcome(kind, f"degraded={degraded} under {armed}")
+
+
+def main_chaos(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--seeds", default="0,1,2,3",
+        help="comma-separated base seeds (offset by FA_CHAOS_SEED)",
+    )
+    ap.add_argument(
+        "--scenarios", type=int, default=2,
+        help="scenarios per seed (seed*100+i derives each schedule)",
+    )
+    ap.add_argument(
+        "--budget-s", type=float, default=150.0,
+        help="soft wall budget: no new scenario starts past it "
+        "(dropped scenarios are LOGGED, never silently skipped)",
+    )
+    ap.add_argument(
+        "--scenario-timeout-s", type=float, default=90.0,
+        help="per-scenario hang bound (the no-hang invariant)",
+    )
+    ap.add_argument("--keep", action="store_true", help="keep workdirs")
+    args = ap.parse_args(argv)
+
+    # 8 virtual CPU devices BEFORE any backend init, so the sharded
+    # paths (sparse exchange, vertical lanes, sharded rules) are real
+    # multi-device programs in the soak — the conftest mesh, standalone
+    # (XLA_FLAGS works on every pinned jax; jax_num_cpu_devices only on
+    # newer ones).  Compile-log lines off: the soak's stdout is its
+    # per-scenario verdict stream.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        )
+    os.environ.setdefault("FA_NO_COMPILE_LOG", "1")
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except (AttributeError, RuntimeError):  # old jax / already init
+        pass
+    from fastapriori_tpu.cli import main as cli_main
+
+    base = _base_seed()
+    seeds = [int(s) + base for s in args.seeds.split(",") if s.strip()]
+    t0 = time.monotonic()
+    root = tempfile.mkdtemp(prefix="fa_chaos_")
+    failures: List[str] = []
+    tallies: Dict[str, int] = {}
+    ran = dropped = 0
+    try:
+        inp = make_inputs(root)
+        out_clean = os.path.join(root, "clean") + os.sep
+        os.makedirs(out_clean)
+        if cli_main([inp, out_clean, "--min-support", "0.08"]) != 0:
+            print("chaos: FAIL: clean run failed", file=sys.stderr)
+            return 1
+        clean = {
+            n: _read(out_clean + n)
+            for n in ("freqItemset", "recommends")
+        }
+        sites = enrolled_sites()
+        print(
+            f"chaos: {len(sites)} enrolled sites "
+            f"({len(fetch_sites_from_inventory())} from the fetch "
+            f"census), seeds {seeds} x {args.scenarios}",
+        )
+        tainted = False
+        for seed in seeds:
+            for i in range(args.scenarios):
+                if tainted or time.monotonic() - t0 > args.budget_s:
+                    dropped += 1
+                    continue
+                schedule = make_schedule(seed * 100 + i, sites)
+                outcome = run_scenario(
+                    schedule, inp, root, clean, args.scenario_timeout_s
+                )
+                ran += 1
+                tallies[outcome.kind] = tallies.get(outcome.kind, 0) + 1
+                tag = "FAIL" if outcome.kind == "FAIL" else "ok"
+                print(
+                    f"chaos[{schedule['seed']}] {tag} "
+                    f"{outcome.kind}: {outcome.detail}"
+                )
+                if outcome.kind == "FAIL":
+                    failures.append(outcome.detail)
+                    if outcome.detail.startswith("hang"):
+                        # The hung scenario's daemonized CLI thread is
+                        # still running and shares the process-global
+                        # ledger/failpoint registries — later scenarios
+                        # would no longer be deterministic functions of
+                        # their seed.  The soak is already failed; stop
+                        # scheduling rather than report tainted verdicts.
+                        tainted = True
+                        print(
+                            "chaos: process state tainted by the hung "
+                            "scenario — remaining scenarios skipped",
+                            file=sys.stderr,
+                        )
+    finally:
+        if not args.keep:
+            shutil.rmtree(root, ignore_errors=True)
+        else:
+            print(f"chaos: workdirs kept under {root}")
+    wall = time.monotonic() - t0
+    if dropped:
+        print(
+            f"chaos: {dropped} scenario(s) dropped (the "
+            f"{args.budget_s}s budget, or taint after a hang) — "
+            "coverage was NOT complete",
+            file=sys.stderr,
+        )
+    print(
+        f"chaos: {'FAIL' if failures else 'OK'} scenarios={ran} "
+        f"{tallies} wall={wall:.1f}s (budget {args.budget_s}s)"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main_chaos())

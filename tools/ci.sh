@@ -44,3 +44,21 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_shard.py -q \
     -p no:cacheprovider
 
 env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
+
+# Seeded chaos soak (ISSUE 9): deterministic failpoint schedules over
+# the lint-censused site inventory against the full CLI pipeline —
+# byte-identical, classified, or ledger-degraded; never a hang, silent
+# corruption, or unclassified crash.  Fixed seed set, wall-budgeted and
+# logged like lint's 10 s budget.
+chaos_t0=$(python -c 'import time; print(time.time())')
+env JAX_PLATFORMS=cpu python tools/chaos.py \
+    --seeds 0,4,6,9 --scenarios 3 --budget-s 120
+# Hard gate = soft budget (120 s, stops NEW scenarios) + the
+# per-scenario hang bound (90 s, the worst legitimate overshoot for a
+# scenario started just inside the budget) + slack.
+python - "$chaos_t0" <<'EOF'
+import sys, time
+elapsed = time.time() - float(sys.argv[1])
+print(f"chaos soak wall time: {elapsed:.2f}s (hard gate 215s)")
+sys.exit(1 if elapsed > 215.0 else 0)
+EOF
