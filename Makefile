@@ -3,7 +3,7 @@
 # failpoint smoke pass (reliability wiring under injected failure — see
 # tools/failpoint_smoke.py).
 
-.PHONY: lint test smoke chaos ci baseline inventory native
+.PHONY: lint test smoke serve-smoke chaos ci baseline inventory native
 
 # Default paths cover the whole tree: fastapriori_tpu tests bench.py
 # __graft_entry__.py tools (tools/lint/cli.py DEFAULT_PATHS).
@@ -17,6 +17,12 @@ test:
 smoke:
 	env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
 
+# Serving-tier smoke (ISSUE 10): build + warm-restart byte-identical,
+# seeded open-loop burst, overload spike -> recorded sheds + recovery,
+# transient absorb on the serving fetch.
+serve-smoke:
+	env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
 # Seeded chaos soak: deterministic failpoint schedules over the
 # censused site inventory, full-pipeline invariant check (ISSUE 9;
 # FA_CHAOS_SEED offsets the seed set).
@@ -24,7 +30,7 @@ chaos:
 	env JAX_PLATFORMS=cpu python tools/chaos.py \
 	    --seeds 0,4,6,9 --scenarios 3 --budget-s 120
 
-ci: lint test smoke chaos
+ci: lint test smoke serve-smoke chaos
 
 # Ratchet reset — only alongside the change that justifies it.
 baseline:

@@ -15,3 +15,7 @@ __version__ = "0.1.0"
 from fastapriori_tpu.config import MinerConfig  # noqa: F401
 from fastapriori_tpu.models.apriori import FastApriori  # noqa: F401
 from fastapriori_tpu.models.recommender import AssociationRules  # noqa: F401
+from fastapriori_tpu.serve import (  # noqa: F401
+    RecommendServer,
+    ServingState,
+)

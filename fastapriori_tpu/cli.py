@@ -135,13 +135,271 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    """The ``serve`` subcommand (ISSUE 10): a resident recommend service
+    over the serving-tier subsystem (fastapriori_tpu/serve/) — build the
+    model once (mine, or warm-restart from a serving checkpoint), then
+    answer a file/stdin request stream through the admission-controlled
+    micro-batching server."""
+    p = argparse.ArgumentParser(
+        prog="fastapriori_tpu serve",
+        description="resident recommend service: mount the model once "
+        "(device-resident rule scan table), serve baskets from a file "
+        "or stdin through the micro-batching request loop",
+    )
+    p.add_argument(
+        "input",
+        help="input prefix containing D.dat (model build; ignored with "
+        "--from-serving)",
+    )
+    p.add_argument(
+        "output",
+        nargs="?",
+        default=None,
+        help="output prefix: writes <output>recommends (+ manifest); "
+        "omitted = responses to stdout",
+    )
+    p.add_argument(
+        "--requests",
+        default=None,
+        help="request source: a file of basket lines, or '-' for stdin "
+        "(default: <input>U.dat)",
+    )
+    p.add_argument(
+        "--from-serving",
+        default=None,
+        help="warm-restart: load <prefix>serving.npz (a ServingState "
+        "checkpoint) instead of mining <input>D.dat",
+    )
+    p.add_argument(
+        "--save-serving",
+        action="store_true",
+        help="after the model builds, write <output>serving.npz (the "
+        "warm-restart artifact; requires an output prefix)",
+    )
+    p.add_argument(
+        "--min-support",
+        type=float,
+        default=DEFAULT_MIN_SUPPORT,
+        help=f"minimum support for the model build (default "
+        f"{DEFAULT_MIN_SUPPORT})",
+    )
+    p.add_argument(
+        "--num-devices", type=int, default=None,
+        help="devices in the mesh (default: all visible)",
+    )
+    p.add_argument(
+        "--serve-engine",
+        choices=["auto", "device", "host"],
+        default="auto",
+        help="scan engine: auto picks the device table when the "
+        "model/batch product justifies a dispatch, host forces the "
+        "oracle scan",
+    )
+    p.add_argument(
+        "--batch-rows",
+        type=int,
+        default=None,
+        help="micro-batch rows (pow2-bucketed; default "
+        "config.rec_batch_rows / FA_REC_BATCH)",
+    )
+    p.add_argument(
+        "--linger-ms",
+        type=float,
+        default=None,
+        help="max ms a partial micro-batch waits to fill before "
+        "dispatching (default config.serve_linger_ms)",
+    )
+    p.add_argument(
+        "--queue-depth",
+        type=int,
+        default=None,
+        help="admission-control queue bound in requests (default 4x "
+        "the micro-batch rows); a full queue sheds ('0' + ledger)",
+    )
+    p.add_argument(
+        "--rate",
+        type=float,
+        default=None,
+        help="open-loop pacing in requests/sec (seeded Poisson "
+        "schedule; overload SHEDS — the sustained-load shape); "
+        "default: closed submission with bounded backpressure",
+    )
+    p.add_argument(
+        "--seed", type=int, default=0,
+        help="arrival-schedule seed for --rate (default 0)",
+    )
+    p.add_argument(
+        "--metrics", action="store_true",
+        help="emit structured JSON metrics to stderr",
+    )
+    p.add_argument(
+        "--platform", choices=["default", "cpu"], default="default",
+        help="force the JAX platform in-process ('cpu' serves without "
+        "an accelerator)",
+    )
+    return p
+
+
+def _serve_main(argv: List[str]) -> int:
+    from fastapriori_tpu.errors import InputError
+
+    args = build_serve_parser().parse_args(argv)
+    try:
+        return _run_serve(args)
+    except InputError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except FileNotFoundError as e:
+        missing = e.filename if e.filename else str(e)
+        print(f"error: file {missing!r} not found", file=sys.stderr)
+        return 2
+
+
+def _run_serve(args) -> int:
+    from fastapriori_tpu.errors import InputError
+
+    if args.save_serving and not args.output:
+        raise InputError(
+            "--save-serving writes <output>serving.npz and therefore "
+            "needs an output prefix"
+        )
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if jax.default_backend() != "cpu":
+            print(
+                "--platform cpu requested but JAX backends were already "
+                f"initialized ({jax.default_backend()}); start a fresh "
+                "process",
+                file=sys.stderr,
+            )
+            return 2
+    from fastapriori_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.io.reader import tokenize_line
+    from fastapriori_tpu.serve import RecommendServer, ServingState
+
+    config = MinerConfig(
+        min_support=args.min_support,
+        num_devices=args.num_devices,
+        log_metrics=args.metrics,
+        retain_csr=False,
+    )
+    t0 = time.perf_counter()
+    if args.from_serving:
+        state = ServingState.load(
+            args.from_serving, config=config, engine=args.serve_engine
+        )
+    else:
+        state = ServingState.from_mine(
+            args.input + "D.dat", config=config, engine=args.serve_engine
+        )
+    if args.save_serving:
+        state.save(args.output)
+    server = RecommendServer(
+        state,
+        batch_rows=args.batch_rows,
+        linger_ms=args.linger_ms,
+        queue_depth=args.queue_depth,
+    ).start()
+    print(
+        "==== Total time for serve model mount "
+        f"{int((time.perf_counter() - t0) * 1e3)}",
+        file=sys.stderr,
+    )
+
+    req_path = args.requests or (args.input + "U.dat")
+    if req_path == "-":
+        lines = (tokenize_line(l) for l in sys.stdin)
+    else:
+        from fastapriori_tpu.io.reader import read_dat
+
+        lines = iter(read_dat(req_path))
+
+    t1 = time.perf_counter()
+    reqs = []
+    if args.rate is not None:
+        # Open-loop: materialize the pool, drive the seeded schedule.
+        from fastapriori_tpu.serve import run_open_loop
+
+        pool = list(lines)
+        if pool:
+            # run_open_loop submits request i = pool[i % len] in order,
+            # so responses align with input rows.
+            result = run_open_loop(
+                server,
+                pool,
+                rate_rps=args.rate,
+                n_requests=len(pool),
+                seed=args.seed,
+                requests_out=reqs,
+            )
+            import json
+
+            print(json.dumps({"serve_open_loop": result}), file=sys.stderr)
+    else:
+        for tokens in lines:
+            reqs.append(server.submit_wait(tokens))
+    completed = server.wait_for(reqs, timeout_s=600.0)
+    served_wall = time.perf_counter() - t1
+    stats = server.stats()
+    stopped = server.stop(drain=True)
+    if not completed or not stopped:
+        # A wedged dispatcher must be a LOUD failure (the server's own
+        # stop() contract) — writing a clean-looking artifact of "0"
+        # rows with exit 0 is exactly the silent degradation the
+        # serving tier forbids.
+        pending = sum(1 for r in reqs if not r.done)
+        print(
+            f"error: serve did not complete inside the bound "
+            f"({pending} of {len(reqs)} requests unfinished, "
+            f"dispatcher {'stopped' if stopped else 'STILL RUNNING'}) — "
+            "no output written",
+            file=sys.stderr,
+        )
+        return 1
+
+    recommends = [
+        (i, r.item if r.item is not None else "0")
+        for i, r in enumerate(reqs)
+    ]
+    if args.output:
+        from fastapriori_tpu.io.writer import write_manifest
+
+        manifest = {}
+        save_recommends(args.output, recommends, manifest=manifest)
+        write_manifest(args.output, manifest)
+    else:
+        for _, item in recommends:
+            print(item)
+    print(
+        f"==== serve: {stats['served']} served, {stats['shed']} shed, "
+        f"{stats['batches']} batches (avg {stats['avg_batch']} rows), "
+        f"engine {stats['model']['engine']}, "
+        f"{int(served_wall * 1e3)} ms",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """Parse args and run; user-correctable problems (missing input
     files, malformed resume artifacts — InputError/FileNotFoundError)
     print a one-line actionable message and return 2 instead of dumping a
-    traceback (the reference stack-traces on all of these)."""
+    traceback (the reference stack-traces on all of these).  A first
+    argument of ``serve`` routes to the serving-tier subcommand
+    (:func:`_serve_main`) — the batch contract's positionals are
+    untouched for every other spelling."""
     from fastapriori_tpu.errors import InputError
 
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        return _serve_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     try:
         return _run(args)

@@ -40,6 +40,29 @@ class MinerConfig:
     # scan stops as soon as every basket has matched, so most runs touch
     # only the first chunk).
     rule_chunk: int = 1 << 13
+    # Scan micro-batch rows for the resident-table first-match scan —
+    # ONE knob shared by the batch recommender (which caps each
+    # replicated micro-batch at this many basket rows) and the serving
+    # tier's request micro-batcher (serve/server.py collects at most
+    # this many queued requests per dispatch), replacing the static 4K
+    # constant (PR 8 residue / ISSUE 10).  Pow2-bucketed at use (G011:
+    # the scan compiles per batch shape; a data-exact row count would
+    # compile per population) with a floor of 32.  FA_REC_BATCH
+    # overrides, strictly parsed.
+    rec_batch_rows: int = 1 << 12
+    # Serving tier (serve/server.py): max milliseconds a partial
+    # micro-batch lingers waiting to fill before dispatching anyway —
+    # the latency side of the batch-size/linger trade-off (arxiv
+    # 1309.0215's buffer/latency knob).  0 dispatches every batch
+    # immediately (minimum latency, maximum dispatch overhead).
+    serve_linger_ms: float = 2.0
+    # Serving tier: admission-control queue bound, in REQUESTS.  A
+    # submit finding the queue full is shed — answered "0" immediately
+    # and counted, with the accept->shed transition recorded on the
+    # degradation cascade — so offered load past capacity degrades to
+    # bounded latency + recorded sheds, never an unbounded queue.
+    # 0 = auto (4x the resolved micro-batch rows).
+    serve_queue_depth: int = 0
     # Rule generation (phase 2) engine: "auto" (default) runs the
     # device-resident level-wise join + dominance prune (rules/gen.py
     # device path — packed-key sorted gathers, one dispatch per level)

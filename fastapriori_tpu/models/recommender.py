@@ -38,6 +38,49 @@ from fastapriori_tpu.rules.gen import (
 from fastapriori_tpu.utils.logging import MetricsLogger
 
 
+def bucket_batch_rows(rows: int) -> int:
+    """THE bucketing contract for scan micro-batch rows: pow2 bucket
+    (G011 — the scan compiles per batch shape) with a floor of 32.
+    Shared by :meth:`AssociationRules.rec_batch_rows`, the serving
+    state's pinned override and the server's collection bound — the
+    compiled scan shape and the micro-batcher's batch bound must be the
+    SAME number, which only holds while they share this one function."""
+    return max(_next_pow2(max(int(rows), 1)), 32)
+
+
+class ServeScanHandle:
+    """What the serving tier needs from the recommender's device scan
+    (:meth:`AssociationRules.serve_scan`): the fixed-shape micro-batch
+    ``scan`` callable over whichever table form is mounted, plus the
+    layout facts the micro-batcher sizes its batches with.
+
+    ``scan(bitmap, blen) -> (best_rank, consequent_or_None, chunks)``
+    returns device arrays; the caller owns the (audited) fetch.  On the
+    replicated form the kernel returns only the winning global rank —
+    ``decode(best_np)`` maps fetched ranks to consequent indexes (-1 =
+    no match) through the host consequent table; on the resident form
+    the consequent array comes back from the device directly and
+    ``decode`` is None.  ``row_multiple`` is the basket-row divisibility
+    the scan's sharding needs (1 on the resident form, whose micro-batch
+    is replicated)."""
+
+    __slots__ = (
+        "scan", "f", "f_pad", "resident", "shards", "table_bytes",
+        "decode", "row_multiple",
+    )
+
+    def __init__(self, scan, *, f, f_pad, resident, shards, table_bytes,
+                 row_multiple, decode=None):
+        self.scan = scan
+        self.f = f
+        self.f_pad = f_pad
+        self.resident = resident
+        self.shards = shards
+        self.table_bytes = table_bytes
+        self.decode = decode
+        self.row_multiple = row_multiple
+
+
 class AssociationRules:
     def __init__(
         self,
@@ -369,12 +412,19 @@ class AssociationRules:
         )
         return self._rule_dev
 
-    # Basket micro-batch rows for the sharded resident-table scan: one
-    # compiled scan shape serves every population (requests stream in
-    # fixed-size replicated micro-batches — the serving-tier request
-    # batching shape, ROADMAP item 1), each batch's result fetch
-    # overlapping the next batch's dispatch.
-    REC_MICROBATCH_ROWS = 1 << 12
+    def rec_batch_rows(self) -> int:
+        """Scan micro-batch rows: ``config.rec_batch_rows`` overridden by
+        strictly-parsed ``FA_REC_BATCH``, pow2-bucketed with a floor of
+        32 (the scan compiles per batch shape — G011).  ONE knob shared
+        by the batch path's resident scan below and the serving tier's
+        request micro-batcher (serve/server.py), replacing the static 4K
+        constant (PR 8 residue / ISSUE 10)."""
+        from fastapriori_tpu.utils.env import env_int
+
+        rows = env_int("FA_REC_BATCH", 0, minimum=0)
+        if rows == 0:
+            rows = self.config.rec_batch_rows
+        return bucket_batch_rows(rows)
 
     def _ensure_scan_table(self) -> tuple:
         """Build the priority-sorted compact scan table ON DEVICE from
@@ -465,7 +515,7 @@ class AssociationRules:
             self._ensure_scan_table()
         )
         scan_fn = ctx.strided_first_match_scan(chunk)
-        mb = max(min(_next_pow2(max(nb, 1)), self.REC_MICROBATCH_ROWS), 32)
+        mb = max(min(_next_pow2(max(nb, 1)), self.rec_batch_rows()), 32)
         t_s0 = time.perf_counter()
         fetches = []
         upload_bytes = 0
@@ -530,6 +580,70 @@ class AssociationRules:
         if first_build:
             stats["table_build_ms"] = build_ms
         return [int(x) for x in recs], stats
+
+    def serve_scan(self):
+        """Serving-tier device-scan entry (ISSUE 10): the serving
+        subsystem mounts the SAME device rule table the batch path owns
+        — the resident sharded table when phase 2 left one (scanned
+        rank-strided, consequent selected on device), else the
+        replicated compact table (scanned row-sharded, the winning rank
+        decoded through the host consequent map).  Returns a
+        :class:`ServeScanHandle` whose ``scan(bitmap, blen)`` runs ONE
+        fixed-shape micro-batch ([mb, F_pad] int8 basket bitmap + [mb]
+        int32 lengths; 0-length rows are padding, excluded from the
+        kernel's early exit) and returns DEVICE arrays — the CALLER owns
+        the audited fetch, so serving transfers land on the serving
+        tier's own ``fetch.serve_match`` site instead of the batch
+        path's ``fetch.rec_match``."""
+        from fastapriori_tpu.ops.contain import NO_MATCH
+
+        self._ensure_rules()
+        ctx = self.context
+        cfg = self.config
+        f = len(self.freq_items)
+        f_pad = pad_axis(f + 1, cfg.item_tile)
+        if self._scan_state is not None or self._scan_table is not None:
+            ant_s, size_s, cons_s, chunk, r_pad, shards, _ = (
+                self._ensure_scan_table()
+            )
+            scan_fn = ctx.strided_first_match_scan(chunk)
+
+            def scan(bm, blen):
+                return scan_fn(
+                    ctx.replicate(bm), ctx.replicate(blen),
+                    ant_s, size_s, cons_s,
+                )
+
+            tbytes = int(
+                ant_s.nbytes + size_s.nbytes + cons_s.nbytes
+            )
+            return ServeScanHandle(
+                scan, f=f, f_pad=f_pad, resident=True, shards=shards,
+                table_bytes=tbytes, row_multiple=1,
+            )
+
+        ant_dev, size_dev, cons_dev, chunk, r_pad, consequent, rbytes = (
+            self._rule_table_device(f_pad)
+        )
+
+        def scan(bm, blen):
+            best, chunks = ctx.first_match_scan(
+                ctx.shard_rows_local(bm), ctx.shard_rows_local(blen),
+                ant_dev, size_dev, cons_dev, chunk,
+            )
+            return best, None, chunks
+
+        def decode(best_np):
+            found = best_np < int(NO_MATCH)
+            return np.where(
+                found, consequent[np.minimum(best_np, r_pad - 1)], -1
+            )
+
+        return ServeScanHandle(
+            scan, f=f, f_pad=f_pad, resident=False, shards=1,
+            table_bytes=int(rbytes), decode=decode,
+            row_multiple=max(cfg.txn_tile, 32) * ctx.txn_shards,
+        )
 
     def _device_first_match(
         self, baskets: List[np.ndarray]

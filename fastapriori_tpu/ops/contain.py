@@ -723,7 +723,16 @@ def local_strided_match_scan(
     ``pmax`` selects the winning shard's consequent (global ranks are
     unique across shards: rank mod S identifies the owner).  Returns
     ``(best_rank [mb], consequent-or-minus-1 [mb], chunks_run ())``,
-    identical across shards."""
+    identical across shards.
+
+    Padding contract (the serving tier depends on it — ISSUE 10): rows
+    with ``basket_len == 0`` are padding, excluded from the early-exit
+    census, and scan to NO_MATCH/-1.  The serving micro-batcher
+    (serve/state.py) therefore dispatches every batch at ONE fixed
+    [mb, F_pad] shape — a partial batch rides as zero-length rows
+    instead of compiling a fresh program per observed batch size, which
+    is what makes the linger/batch-size knobs a latency trade-off
+    rather than a compile-cache hazard."""
     r_loc = ant_cols.shape[0]
     n_chunks = r_loc // chunk
     s = lax.axis_index(axis_name).astype(jnp.int32)

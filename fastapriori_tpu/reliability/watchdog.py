@@ -17,7 +17,12 @@ silent multi-hour hang.  Every trip lands on the degradation ledger as
 a ``watchdog_timeout`` event.  The abandoned worker thread is daemonic:
 it cannot be killed (Python offers no safe cross-thread abort of a
 blocked C call), but it no longer blocks the pipeline — the closest
-in-process analog of Spark's speculative-task abandon.
+in-process analog of Spark's speculative-task abandon.  Abandonment is
+ACCOUNTED (ISSUE 10 satellite / PR 9 residue): every trip carries the
+live abandoned-thread count on its ledger event, and a trip past
+``FA_DISPATCH_MAX_ABANDONED`` raises the fatal
+:class:`AbandonedThreadCap` instead of leaking one more daemon thread
+per retry — a runtime wedged that hard is down, not flapping.
 
 **Cascade.**  The engines already degrade in half a dozen places —
 fused→level salvage, vertical→bitmap, sparse→dense redo, device
@@ -68,6 +73,11 @@ CHAINS: Dict[str, Tuple[str, ...]] = {
     "rule_engine": ("sharded", "device", "host"),
     # Recommender first-match scan: resident device table -> host scan.
     "rule_scan": ("device", "host"),
+    # Serving admission control (serve/server.py): accepting requests ->
+    # shedding them ("0" answers) under overload.  Each overload episode
+    # records one forward walk; recovery is internal server state, not a
+    # (forbidden) backward cascade event.
+    "serving": ("accept", "shed"),
 }
 
 
@@ -129,7 +139,23 @@ class DispatchTimeout(RuntimeError):
     the same contract a real XLA deadline error carries."""
 
 
+class AbandonedThreadCap(RuntimeError):
+    """A watchdog trip past the abandoned-thread cap
+    (``FA_DISPATCH_MAX_ABANDONED``).  Each abandoned fetch leaks one
+    daemon thread (Python cannot abort a blocked C call); a runtime
+    wedged hard enough to strand the cap's worth of threads is not
+    flapping, it is down — so this error deliberately carries NO
+    transient status: retry.classify sees a fatal and the run dies
+    naming the leak instead of abandoning threads unboundedly."""
+
+
 _timeout_memo: Optional[float] = None
+_max_abandoned_memo: Optional[int] = None
+
+# Watchdog-abandoned worker threads still alive (pruned on every trip).
+# Module-level like the ledger: the guard sites have no config in scope.
+_abandoned_lock = threading.Lock()
+_abandoned: list = []
 
 
 def dispatch_timeout_s() -> float:
@@ -147,10 +173,52 @@ def dispatch_timeout_s() -> float:
     return _timeout_memo
 
 
+def max_abandoned() -> int:
+    """Cap on concurrently-abandoned fetch threads:
+    ``FA_DISPATCH_MAX_ABANDONED``, strictly parsed; 0 disables the cap
+    (unbounded abandonment, the pre-ISSUE-10 behavior).  Default 8 — a
+    genuinely flapping link frees its threads as fetches eventually
+    land, so only a hard-wedged runtime accumulates toward the cap."""
+    global _max_abandoned_memo
+    if _max_abandoned_memo is None:
+        from fastapriori_tpu.utils.env import env_int
+
+        _max_abandoned_memo = env_int(
+            "FA_DISPATCH_MAX_ABANDONED", 8, minimum=0
+        )
+    return _max_abandoned_memo
+
+
+def abandoned_live() -> int:
+    """Abandoned worker threads still alive right now (dead ones are
+    pruned on every trip and on every read)."""
+    with _abandoned_lock:
+        _abandoned[:] = [t for t in _abandoned if t.is_alive()]
+        return len(_abandoned)
+
+
+def _register_abandoned(worker: threading.Thread) -> int:
+    """Record a freshly-abandoned worker; returns the live count
+    including it."""
+    with _abandoned_lock:
+        _abandoned[:] = [t for t in _abandoned if t.is_alive()]
+        _abandoned.append(worker)
+        return len(_abandoned)
+
+
 def reload_from_env() -> None:
-    """Re-read ``FA_DISPATCH_TIMEOUT_S`` (tests; otherwise read once)."""
-    global _timeout_memo
+    """Re-read the FA_DISPATCH_* knobs (tests; otherwise read once)."""
+    global _timeout_memo, _max_abandoned_memo
     _timeout_memo = None
+    _max_abandoned_memo = None
+
+
+def reset_abandoned() -> None:
+    """Forget the abandoned-thread registry (tests: earlier tests'
+    deliberately-hung workers must not count against this test's cap).
+    The threads themselves, being daemonic, die with the process."""
+    with _abandoned_lock:
+        _abandoned.clear()
 
 
 def guard(
@@ -183,10 +251,24 @@ def guard(
     worker.start()
     worker.join(bound)
     if not box:
+        live = _register_abandoned(worker)
+        cap = max_abandoned()
         ledger.record(
             "watchdog_timeout", once_key=site, site=site,
-            timeout_s=bound,
+            timeout_s=bound, abandoned_live=live,
         )
+        if cap and live > cap:
+            # Past the cap the leak itself is the failure: a retry would
+            # strand thread cap+2 against the same wedged runtime.  No
+            # transient status in the message — classify() must see a
+            # fatal (test-pinned).
+            raise AbandonedThreadCap(
+                f"dispatch watchdog: {live} abandoned fetch threads "
+                f"still live after abandoning {site!r} — past the "
+                f"FA_DISPATCH_MAX_ABANDONED cap of {cap}; the runtime "
+                "is wedged, not flapping, so this trip is fatal instead "
+                "of leaking another thread per retry"
+            )
         raise DispatchTimeout(
             f"DEADLINE_EXCEEDED: dispatch watchdog abandoned {site!r} "
             f"after {bound}s (FA_DISPATCH_TIMEOUT_S) — the in-flight "

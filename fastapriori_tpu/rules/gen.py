@@ -143,6 +143,17 @@ class DeviceRuleState:
         self.psum_bytes = int(psum_bytes)
         self.ready = True
 
+    def device_bytes(self) -> int:
+        """HBM footprint of the resident per-level join state (summed
+        over the level arrays) — the serving tier reports it next to the
+        compact scan table's bytes so a hot-swap's transient double
+        residency is a visible number, not a surprise OOM."""
+        total = 0
+        for arrs in self.arrays:
+            for a in arrs:
+                total += int(getattr(a, "nbytes", 0))
+        return total
+
     def release(self):
         """Drop the device references (the scan table, once built, is
         the only resident consumer)."""

@@ -1,0 +1,27 @@
+"""Serving tier (ISSUE 10): the "serve model" half of the API split.
+
+- :class:`~fastapriori_tpu.serve.state.ServingState` — the explicit,
+  checkpointable model artifact mounting the device-resident rule scan
+  table (build / save / load / recommend_batch / release).
+- :class:`~fastapriori_tpu.serve.server.RecommendServer` — the
+  resident request loop: bounded-queue admission control, fixed-shape
+  micro-batching behind the batch-size/linger knobs, ledger-recorded
+  shed mode, barrier-ordered hot-swap.
+- :mod:`~fastapriori_tpu.serve.loadgen` — seeded open-loop load
+  generation + the sustained-load record fields (bench / smoke / CLI).
+"""
+
+from fastapriori_tpu.serve.loadgen import (  # noqa: F401
+    arrival_offsets,
+    percentiles_ms,
+    run_open_loop,
+)
+from fastapriori_tpu.serve.server import (  # noqa: F401
+    RecommendServer,
+    ServeRequest,
+)
+from fastapriori_tpu.serve.state import (  # noqa: F401
+    SERVING_NAME,
+    ServingState,
+    model_signature,
+)

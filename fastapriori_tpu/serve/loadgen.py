@@ -1,0 +1,123 @@
+"""Open-loop load generation for the serving tier (ISSUE 10).
+
+A CLOSED benchmark (submit, wait, repeat) measures only service time —
+its arrival rate slows down whenever the server does, so queueing and
+overload never show.  The north star's "heavy traffic" claim needs the
+open-loop shape: arrivals follow a schedule INDEPENDENT of completions
+(a Poisson process here — seeded, so the schedule is deterministic and
+reproducible), latency is measured from each request's *scheduled*
+arrival (no coordinated omission: generator lag counts against the
+server, not for it), and offered load past capacity surfaces as
+queueing + recorded sheds rather than a silently stretched run.
+
+:func:`run_open_loop` drives a :class:`~fastapriori_tpu.serve.server.
+RecommendServer` with one such schedule and aggregates the serving
+record fields: offered/achieved rates, p50/p95/p99 latency, queue
+depth, shed counts.  Every wait is timeout-bounded — a wedged server
+yields ``drained=False`` plus partial counters, never a hung bench.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def arrival_offsets(
+    n_requests: int, rate_rps: float, seed: int
+) -> np.ndarray:
+    """Deterministic Poisson arrival schedule: ``n`` cumulative offsets
+    (seconds from t0) with exponential inter-arrivals at ``rate_rps``.
+    Same (n, rate, seed) -> byte-identical schedule (test-pinned)."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be positive, got {rate_rps}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    return np.cumsum(gaps)
+
+
+def percentiles_ms(latencies_ms: Sequence[float]) -> dict:
+    if not len(latencies_ms):
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+    # lint: host-data -- latency floats computed on host, no device fetch
+    arr = np.asarray(latencies_ms, dtype=np.float64)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 2),
+        "p95_ms": round(float(np.percentile(arr, 95)), 2),
+        "p99_ms": round(float(np.percentile(arr, 99)), 2),
+    }
+
+
+def run_open_loop(
+    server,
+    baskets: Sequence[Sequence[str]],
+    *,
+    rate_rps: float,
+    n_requests: int,
+    seed: int,
+    drain_timeout_s: float = 60.0,
+    label: str = "open_loop",
+    requests_out: Optional[List] = None,
+) -> dict:
+    """Drive ``server`` with a seeded open-loop burst: request i is the
+    (i mod len(baskets))-th basket, submitted at its scheduled offset
+    (all due arrivals submit in one sweep — at tens of kHz a per-request
+    sleep cannot keep the schedule, the batched sweep can).  Returns the
+    serving record: offered/achieved rates, percentile latencies over
+    SERVED requests (sheds answer immediately and are counted
+    separately), queue/shed counters, and the model's scan facts."""
+    if not baskets:
+        raise ValueError("run_open_loop needs a non-empty basket pool")
+    offsets = arrival_offsets(n_requests, rate_rps, seed)
+    # Each scenario reports ITS OWN queue peak (`batches` below is
+    # differenced the same way).
+    server.reset_max_queue()
+    before = server.stats()
+    reqs: List = []
+    t0 = time.monotonic()
+    i = 0
+    while i < n_requests:
+        now = time.monotonic() - t0
+        # Submit every arrival whose scheduled time has passed.
+        while i < n_requests and offsets[i] <= now:
+            reqs.append(
+                server.submit(
+                    baskets[i % len(baskets)], t_sched=t0 + offsets[i]
+                )
+            )
+            i += 1
+        if i < n_requests:
+            time.sleep(min(max(offsets[i] - (time.monotonic() - t0), 0.0),
+                           0.002))
+    if requests_out is not None:
+        requests_out.extend(reqs)
+    drained = server.wait_for(reqs, timeout_s=drain_timeout_s)
+    t_end = time.monotonic()
+    served = [r for r in reqs if r.done and not r.shed]
+    shed = sum(1 for r in reqs if r.shed)
+    lat = [r.latency_ms() for r in served]
+    last_done = max((r.t_done for r in served), default=t_end)
+    wall = max(last_done - t0, 1e-9)
+    after = server.stats()
+    out = {
+        "label": label,
+        "seed": seed,
+        "n_requests": n_requests,
+        "offered_rps": round(rate_rps, 1),
+        # Offered rate as realized by the schedule (== rate_rps up to
+        # sampling noise; recorded so the row is self-describing).
+        "scheduled_rps": round(float(n_requests / offsets[-1]), 1),
+        "achieved_rps": round(len(served) / wall, 1),
+        "served": len(served),
+        "shed": shed,
+        "drained": drained,
+        "wall_s": round(t_end - t0, 3),
+        "max_queue": after["max_queue"],
+        "batches": after["batches"] - before["batches"],
+        **percentiles_ms(lat),
+    }
+    n_batches = out["batches"]
+    out["avg_batch"] = round(len(served) / n_batches, 1) if n_batches else 0
+    return out

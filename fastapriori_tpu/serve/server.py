@@ -1,0 +1,404 @@
+"""RecommendServer — the resident, admission-controlled request loop
+(ISSUE 10 tentpole).
+
+One dispatcher thread turns an open-loop request stream into the fixed-
+shape micro-batches the device scan serves best (arxiv 1309.0215's
+pipelined micro-batching, with the buffer/latency trade-off as two
+explicit knobs):
+
+- **batch_rows** (``config.rec_batch_rows`` / ``FA_REC_BATCH``): the
+  micro-batch size — throughput side.  The dispatcher collects at most
+  this many queued requests per scan dispatch.
+- **linger** (``config.serve_linger_ms``): the max time a PARTIAL batch
+  waits to fill before dispatching anyway — latency side.  0 dispatches
+  immediately.
+
+**Admission control.**  The queue is bounded (``serve_queue_depth``; 0 =
+auto 4× batch_rows).  :meth:`submit` on a full queue SHEDS the request:
+it is answered ``"0"`` immediately (the reference's no-recommendation
+value, AssociationRules.scala:49) and counted, and the accept→shed
+transition of each overload episode is recorded on the degradation
+cascade (``watchdog.CHAINS["serving"]``) — so offered load past
+capacity degrades to bounded latency plus *recorded* sheds, never an
+unbounded queue, and a shed run can never masquerade as a clean one.
+:meth:`submit_wait` is the closed-loop flavor (file/stdin sources):
+bounded blocking for space instead of shedding.
+
+**Hot-swap.**  :meth:`swap` enqueues a barrier marker: every request
+enqueued before it is served by the OLD state (a batch never straddles
+the marker), requests after it by the new — responses never mix tables
+(test-pinned via model signatures).  The old state is released at the
+barrier.
+
+The scan fetches inside the state are the standard audited sites
+(``fetch.serve_match`` → retry + dispatch watchdog), so a wedged device
+runtime surfaces as classified errors/cascade walks, never a hung
+dispatcher; every wait in this module is timeout-bounded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional, Sequence
+
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.reliability import ledger, watchdog
+from fastapriori_tpu.serve.state import ServingState
+
+
+class ServeRequest:
+    """One in-flight request.  ``t_sched`` is the open-loop intended
+    arrival time (defaults to submit time) — latency is measured from
+    it, so generator lag cannot hide queueing delay (no coordinated
+    omission)."""
+
+    __slots__ = (
+        "tokens", "t_sched", "t_enq", "t_done", "item", "shed", "model"
+    )
+
+    def __init__(self, tokens, t_sched: Optional[float], t_enq: float):
+        self.tokens = tokens
+        self.t_sched = t_enq if t_sched is None else t_sched
+        self.t_enq = t_enq
+        self.t_done: Optional[float] = None
+        self.item: Optional[str] = None
+        self.shed = False
+        self.model: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self.t_done is not None
+
+    def latency_ms(self) -> Optional[float]:
+        if self.t_done is None:
+            return None
+        return max(self.t_done - self.t_sched, 0.0) * 1e3
+
+
+class _SwapMarker:
+    __slots__ = ("state", "release_old", "event")
+
+    def __init__(self, state: ServingState, release_old: bool):
+        self.state = state
+        self.release_old = release_old
+        self.event = threading.Event()
+
+
+class RecommendServer:
+    def __init__(
+        self,
+        state: ServingState,
+        batch_rows: Optional[int] = None,
+        linger_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+    ):
+        from fastapriori_tpu.models.recommender import bucket_batch_rows
+
+        self._state = state
+        cfg = state.config
+        rows = batch_rows if batch_rows else state.batch_rows()
+        # The state's set_batch_rows applies the SAME shared bucketing,
+        # so the compiled scan shape equals this collection bound.
+        self._batch_rows = bucket_batch_rows(rows)
+        self._linger_s = (
+            cfg.serve_linger_ms if linger_ms is None else linger_ms
+        ) / 1e3
+        depth = queue_depth if queue_depth else cfg.serve_queue_depth
+        self._depth = int(depth) if depth else 4 * self._batch_rows
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._in_flight = 0  # requests popped but not yet completed
+        self._thread: Optional[threading.Thread] = None
+        self._shedding = False
+        self._pending_swaps = 0  # markers riding the queue
+        # Counters (under _cond).
+        self._submitted = 0
+        self._served = 0
+        self._shed = 0
+        self._batches = 0
+        self._batch_rows_served = 0
+        self._swaps = 0
+        self._max_depth = 0
+        self._scan_wall_s = 0.0
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, warm: bool = True) -> "RecommendServer":
+        if self._thread is not None:
+            raise InputError("RecommendServer.start called twice")
+        # The scan's fixed compile shape must equal the micro-batcher's
+        # collection bound, or every partial batch pads up to the config
+        # default.
+        self._state.set_batch_rows(self._batch_rows)
+        if warm:
+            self._state.warm()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="fa-serve-dispatch",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> bool:
+        """Stop the dispatcher (optionally draining queued work first,
+        bounded).  Returns True when the thread exited inside the
+        bound — callers assert it, so a wedged dispatcher is a loud
+        failure, not a leaked zombie."""
+        if drain:
+            self.drain(timeout_s=timeout_s)
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+            return not t.is_alive()
+        return True
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait (bounded) until the queue is empty and nothing is in
+        flight.  False on timeout — never a hang."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._q or self._in_flight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.1))
+        return True
+
+    # -- request admission ---------------------------------------------
+    def submit(
+        self,
+        tokens: Sequence[str],
+        t_sched: Optional[float] = None,
+    ) -> ServeRequest:
+        """Open-loop admission: enqueue, or SHED immediately ("0",
+        counted, episode cascade-recorded) when the bounded queue is
+        full or the server is not running."""
+        now = time.monotonic()
+        req = ServeRequest(tokens, t_sched, now)
+        with self._cond:
+            self._submitted += 1
+            if not self._running or len(self._q) >= self._depth:
+                return self._shed_locked(req, now)
+            if self._shedding:
+                self._shedding = False  # overload episode over
+            self._q.append(req)
+            depth = len(self._q)
+            if depth > self._max_depth:
+                self._max_depth = depth
+            self._cond.notify_all()
+        return req
+
+    def submit_wait(
+        self,
+        tokens: Sequence[str],
+        t_sched: Optional[float] = None,
+        timeout_s: float = 30.0,
+    ) -> ServeRequest:
+        """Closed-loop admission (file/stdin sources): block — bounded —
+        for queue space instead of shedding.  Sheds only on timeout or a
+        stopped server."""
+        deadline = time.monotonic() + timeout_s
+        now = time.monotonic()
+        req = ServeRequest(tokens, t_sched, now)
+        with self._cond:
+            self._submitted += 1
+            while self._running and len(self._q) >= self._depth:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.1))
+            if not self._running or len(self._q) >= self._depth:
+                return self._shed_locked(req, time.monotonic())
+            if self._shedding:
+                self._shedding = False
+            req.t_enq = time.monotonic()
+            self._q.append(req)
+            depth = len(self._q)
+            if depth > self._max_depth:
+                self._max_depth = depth
+            self._cond.notify_all()
+        return req
+
+    def _shed_locked(self, req: ServeRequest, now: float) -> ServeRequest:
+        """Complete ``req`` as shed (caller holds the lock).  One
+        cascade event per overload EPISODE (accept→shed transition) —
+        per-request ledger events at tens of kilohertz would be their
+        own memory overload; the per-request count rides stats()."""
+        req.item = "0"
+        req.shed = True
+        req.t_done = now
+        self._shed += 1
+        if not self._shedding:
+            self._shedding = True
+            watchdog.downgrade(
+                "serving", "accept", "shed",
+                reason="queue_full" if self._running else "not_running",
+                once_key="serving:accept>shed",
+                depth=self._depth,
+                shed_so_far=self._shed,
+            )
+        return req
+
+    # -- waiting --------------------------------------------------------
+    def wait_for(
+        self, reqs: Sequence[ServeRequest], timeout_s: float = 30.0
+    ) -> bool:
+        """Bounded wait until every request in ``reqs`` completed."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while not all(r.done for r in reqs):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.1))
+        return True
+
+    # -- hot swap -------------------------------------------------------
+    def swap(
+        self, new_state: ServingState, release_old: bool = True
+    ) -> threading.Event:
+        """Hot-swap the model: requests enqueued BEFORE this call are
+        served by the current state (the barrier marker rides the queue;
+        a batch never straddles it), requests after it by ``new_state``.
+        Returns the barrier event (set when the swap committed).  The
+        outgoing state is released at the barrier unless
+        ``release_old=False`` (caller keeps it — e.g. a planned
+        swap-back)."""
+        marker = _SwapMarker(new_state, release_old)
+        with self._cond:
+            if not self._running:
+                raise InputError("cannot swap a stopped server")
+            self._q.append(marker)
+            self._pending_swaps += 1
+            self._cond.notify_all()
+        return marker.event
+
+    @property
+    def state(self) -> ServingState:
+        return self._state
+
+    # -- dispatcher -----------------------------------------------------
+    def _collect_batch(self) -> Optional[list]:
+        """Form one micro-batch under the lock: up to batch_rows
+        requests, stopping early at a swap marker or when the first
+        request's linger deadline passes.  Returns None when stopped and
+        empty."""
+        with self._cond:
+            while self._running and not self._q:
+                self._cond.wait(0.05)
+            if not self._q:
+                return None  # stopped and drained
+            if isinstance(self._q[0], _SwapMarker):
+                self._in_flight += 1
+                self._pending_swaps -= 1
+                return [self._q.popleft()]
+            deadline = self._q[0].t_enq + self._linger_s
+            while (
+                self._running
+                and len(self._q) < self._batch_rows
+                and not self._pending_swaps
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.05))
+            batch = []
+            while self._q and len(batch) < self._batch_rows:
+                if isinstance(self._q[0], _SwapMarker):
+                    break  # the barrier: next batch handles it
+                batch.append(self._q.popleft())
+            self._in_flight += len(batch)
+            return batch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._collect_batch()
+            if batch is None:
+                return
+            if len(batch) == 1 and isinstance(batch[0], _SwapMarker):
+                marker = batch[0]
+                old = self._state
+                marker.state.set_batch_rows(self._batch_rows)
+                self._state = marker.state
+                self._swaps += 1
+                ledger.record(
+                    "serve_swap",
+                    once_key=marker.state.signature,
+                    frm=old.signature,
+                    to=marker.state.signature,
+                )
+                if marker.release_old:
+                    old.release()
+                marker.event.set()
+                with self._cond:
+                    self._in_flight -= 1
+                    self._cond.notify_all()
+                continue
+            t0 = time.monotonic()
+            try:
+                items = self._state.recommend_batch(
+                    [r.tokens for r in batch]
+                )
+            # The dispatcher must survive anything recommend_batch
+            # raises past its own cascade (a fatal error serves "0" to
+            # THIS batch, classified on the ledger; the next batch gets
+            # a fresh attempt) — a dead dispatcher would hang every
+            # later waiter, the one outcome the serving tier forbids.
+            # lint: waive G006 -- answered "0" + ledger serve_error; next batch retries
+            except Exception as exc:
+                ledger.record(
+                    "serve_error",
+                    once_key=type(exc).__name__,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                    rows=len(batch),
+                )
+                items = ["0"] * len(batch)
+            now = time.monotonic()
+            sig = self._state.signature
+            with self._cond:
+                for r, item in zip(batch, items):
+                    r.item = item
+                    r.model = sig
+                    r.t_done = now
+                self._served += len(batch)
+                self._batches += 1
+                self._batch_rows_served += len(batch)
+                self._scan_wall_s += now - t0
+                self._in_flight -= len(batch)
+                self._cond.notify_all()
+
+    # -- observability --------------------------------------------------
+    def reset_max_queue(self) -> int:
+        """Reset the queue-depth peak to the CURRENT depth and return
+        the old peak — run_open_loop calls it at scenario start so each
+        record reports its own peak, not the server-lifetime maximum."""
+        with self._cond:
+            old = self._max_depth
+            self._max_depth = len(self._q)
+            return old
+
+    def stats(self) -> dict:
+        with self._cond:
+            out = {
+                "batch_rows": self._batch_rows,
+                "linger_ms": round(self._linger_s * 1e3, 3),
+                "queue_depth": self._depth,
+                "submitted": self._submitted,
+                "served": self._served,
+                "shed": self._shed,
+                "batches": self._batches,
+                "avg_batch": round(
+                    self._batch_rows_served / max(self._batches, 1), 1
+                ),
+                "max_queue": self._max_depth,
+                "swaps": self._swaps,
+                "scan_wall_s": round(self._scan_wall_s, 3),
+            }
+        out["model"] = self._state.describe()
+        return out

@@ -1,0 +1,482 @@
+"""ServingState — the explicit "serve model" half of the API split
+(ISSUE 10 tentpole; ROADMAP item 1).
+
+The batch pipeline conflates "build model" (mine + generate rules) with
+"serve model" (scan baskets against the sorted rule table) inside one
+``AssociationRules.run`` call.  A long-lived serving tier needs the
+second half as a first-class, checkpointable object:
+
+- :meth:`ServingState.build` wraps a mining result (level matrices +
+  item tables) into a serving artifact: rules generated + priority-
+  sorted ONCE, the device scan table mounted through
+  :meth:`~fastapriori_tpu.models.recommender.AssociationRules.serve_scan`
+  — the resident sharded table from the phase-2 join state
+  (``rules/gen.py DeviceRuleState`` / ``ops/contain.py
+  rule_scan_build``) when the mesh built one, uploaded once and reused
+  across every request batch.
+- :meth:`save` / :meth:`load` persist the model through PR 2's
+  committer + MANIFEST machinery (``<prefix>serving.npz``, atomic write,
+  size+sha256 manifest entry), so a serving process warm-restarts from
+  checkpoint and — rule generation being deterministic in the mining
+  result — serves byte-identical recommendations (test-pinned).
+- :meth:`recommend_batch` is the serving data path: one fixed-shape
+  micro-batch per scan dispatch (``config.rec_batch_rows`` /
+  ``FA_REC_BATCH`` — the same knob the batch recommender caps its
+  micro-batches with), padding rows excluded from the kernel's early
+  exit, the result fetch audited under the serving tier's own
+  ``fetch.serve_match`` site (failpoint-armable, watchdog-bounded,
+  retried — the standard audited-fetch discipline).  A device scan
+  whose transient failures survive the retry budget walks the
+  ``rule_scan`` cascade to the host oracle scan instead of killing the
+  server.
+
+Model identity: :attr:`signature` (sha256 over the level matrices,
+counts and item vocabulary) names the model a response was served from
+— the hot-swap tests pin that no response ever mixes tables.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import time
+import zipfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.io.reader import _open_bytes
+from fastapriori_tpu.io.writer import write_artifact_bytes, write_manifest
+from fastapriori_tpu.ops.bitmap import build_bitmap, pad_axis
+from fastapriori_tpu.preprocess import dedup_user_baskets
+from fastapriori_tpu.reliability import failpoints, ledger, retry, watchdog
+
+SERVING_NAME = "serving.npz"
+
+Level = Tuple[np.ndarray, np.ndarray]
+
+
+def model_signature(
+    levels: Sequence[Level],
+    item_counts: np.ndarray,
+    freq_items: Sequence[str],
+) -> str:
+    """Deterministic model identity: sha256 over the level matrices,
+    their counts, the 1-itemset counts and the item vocabulary.  Two
+    mines of the same corpus at the same support produce the same
+    signature; any rule-visible difference changes it."""
+    h = hashlib.sha256()
+    h.update(np.int64(len(levels)).tobytes())
+    for mat, cnt in levels:
+        h.update(np.ascontiguousarray(mat, dtype=np.int32).tobytes())
+        h.update(np.ascontiguousarray(cnt, dtype=np.int64).tobytes())
+    h.update(np.ascontiguousarray(item_counts, dtype=np.int64).tobytes())
+    h.update("\x00".join(freq_items).encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
+class ServingState:
+    """A resident, checkpointable recommend model (module docstring).
+
+    Construction is cheap; the expensive pieces — rule generation, the
+    device table build, the scan compile — run in :meth:`warm` (or
+    lazily on the first batch).  One instance serves many batches; a
+    model refresh builds a NEW instance and hot-swaps it through
+    :meth:`~fastapriori_tpu.serve.server.RecommendServer.swap`, then
+    :meth:`release`\\ s this one."""
+
+    def __init__(
+        self,
+        levels: Sequence[Level],
+        item_counts: np.ndarray,
+        freq_items: Sequence[str],
+        item_to_rank: Optional[Dict[str, int]] = None,
+        config: Optional[MinerConfig] = None,
+        context=None,
+        engine: str = "auto",
+        source: str = "build",
+    ):
+        if engine not in ("auto", "device", "host"):
+            # The FA_NO_PALLAS strictness contract: a typo'd engine
+            # silently serving the host scan is an invisible downgrade.
+            raise InputError(
+                f"unrecognized ServingState engine {engine!r}: use one "
+                "of auto/device/host"
+            )
+        from fastapriori_tpu.models.recommender import AssociationRules
+
+        self.levels = [
+            (
+                np.ascontiguousarray(m, dtype=np.int32),
+                np.ascontiguousarray(c, dtype=np.int64),
+            )
+            for m, c in levels
+        ]
+        self.item_counts = np.ascontiguousarray(item_counts, np.int64)
+        self.freq_items = list(freq_items)
+        self.item_to_rank = (
+            dict(item_to_rank)
+            if item_to_rank is not None
+            else {item: r for r, item in enumerate(self.freq_items)}
+        )
+        self.config = config or MinerConfig()
+        self.signature = model_signature(
+            self.levels, self.item_counts, self.freq_items
+        )
+        self.source = source
+        self._rec = AssociationRules(
+            [], self.freq_items, self.item_to_rank, config=self.config,
+            context=context, levels=self.levels,
+            item_counts=self.item_counts,
+        )
+        self._engine_req = engine
+        self._engine: Optional[str] = None  # resolved at warm()
+        self._handle = None
+        self._batch_rows_override: Optional[int] = None
+        self._released = False
+        self.warm_ms = 0.0
+        # Serving-run counters (cumulative per instance; the server's
+        # stats() folds them into the record).
+        self.scan_dispatches = 0
+        self.scan_rows = 0
+        # The acceptance contract (ISSUE 10): rule-table bytes crossing
+        # the host link AFTER the model is mounted — identically zero on
+        # both device forms (resident: built on device; replicated:
+        # uploaded once inside warm(), before serving starts).
+        self.rule_table_host_bytes = 0
+
+    # -- build/load entry points ---------------------------------------
+    @classmethod
+    def from_mine(
+        cls,
+        d_path: str,
+        config: Optional[MinerConfig] = None,
+        engine: str = "auto",
+        source: str = "mine",
+    ) -> "ServingState":
+        """Mine ``d_path`` and wrap the result — the one-call "build
+        model" path the CLI ``serve`` subcommand and bench use."""
+        from fastapriori_tpu.models.apriori import FastApriori
+
+        config = config or MinerConfig()
+        miner = FastApriori(config=config)
+        levels, data = miner.run_file_raw(d_path)
+        return cls(
+            levels, data.item_counts, data.freq_items, data.item_to_rank,
+            config=config, context=miner.context, engine=engine,
+            source=source,
+        )
+
+    def save(self, prefix: str) -> str:
+        """Persist ``<prefix>serving.npz`` through the crash-safe
+        committer + run manifest (PR 2 machinery): a killed save leaves
+        either the old artifact or the new one, never a torn file, and
+        a truncated artifact fails manifest validation at load."""
+        arrays = {
+            "meta": np.array(
+                [1, len(self.levels), len(self.freq_items)], dtype=np.int64
+            ),
+            "item_counts": self.item_counts,
+            # lint: host-data -- item vocabulary is a host string list
+            "freq_items": np.asarray(self.freq_items, dtype=np.str_),
+            "signature": np.asarray([self.signature], dtype=np.str_),
+        }
+        for i, (mat, cnt) in enumerate(self.levels):
+            arrays[f"mat_{i}"] = mat
+            arrays[f"cnt_{i}"] = cnt
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        manifest: Dict[str, dict] = {}
+        path = write_artifact_bytes(
+            prefix + SERVING_NAME, [buf.getvalue()], SERVING_NAME, manifest
+        )
+        write_manifest(prefix, manifest)
+        return path
+
+    @classmethod
+    def load(
+        cls,
+        prefix: str,
+        config: Optional[MinerConfig] = None,
+        context=None,
+        engine: str = "auto",
+    ) -> "ServingState":
+        """Warm restart: load ``<prefix>serving.npz`` (manifest-validated
+        — a truncated/corrupt artifact is an InputError naming the file,
+        never a silently different model) and rebuild the serving state.
+        Rule generation is deterministic in the stored mining result, so
+        the restarted state serves byte-identical recommendations
+        (test-pinned); the stored signature cross-checks the recomputed
+        one."""
+        from fastapriori_tpu.io.resume import validate_artifact_bytes
+
+        failpoints.fire("serving.load")
+        path = prefix + SERVING_NAME
+        try:
+            with _open_bytes(path) as f:
+                raw = f.read()
+        except FileNotFoundError:
+            raise InputError(
+                f"serving checkpoint {path!r} not found — write one "
+                "with ServingState.save (CLI: serve --save-serving)"
+            ) from None
+        validate_artifact_bytes(prefix, SERVING_NAME, raw)
+        try:
+            with np.load(io.BytesIO(raw)) as z:
+                meta = z["meta"]
+                if int(meta[0]) != 1:
+                    raise ValueError(f"unknown version {int(meta[0])}")
+                n_levels = int(meta[1])
+                freq_items = [str(s) for s in z["freq_items"]]
+                item_counts = z["item_counts"]
+                stored_sig = str(z["signature"][0])
+                levels = [
+                    (z[f"mat_{i}"], z[f"cnt_{i}"]) for i in range(n_levels)
+                ]
+        except (KeyError, ValueError, OSError, zipfile.BadZipFile) as e:
+            raise InputError(
+                f"corrupt serving checkpoint {path!r}: {e} — regenerate "
+                "it with ServingState.save"
+            ) from None
+        state = cls(
+            levels, item_counts, freq_items, config=config,
+            context=context, engine=engine, source="restart",
+        )
+        if state.signature != stored_sig:
+            raise InputError(
+                f"serving checkpoint {path!r} signature mismatch "
+                f"(stored {stored_sig}, recomputed {state.signature}) — "
+                "the artifact does not describe the model it claims"
+            )
+        ledger.record(
+            "serving_restart", once_key=state.signature,
+            signature=state.signature, n_levels=len(levels),
+        )
+        return state
+
+    # -- model facts ----------------------------------------------------
+    @property
+    def n_rules(self) -> int:
+        self._rec._ensure_rules()
+        return self._rec.n_rules or 0
+
+    def batch_rows(self) -> int:
+        """The serving micro-batch row count — the recommender's shared
+        ``rec_batch_rows`` knob (config + FA_REC_BATCH, pow2-bucketed),
+        unless a server pinned its own batch bound here
+        (:meth:`set_batch_rows`): the scan's fixed compile shape and the
+        micro-batcher's collection bound must be the SAME number, or
+        every dispatch pads a small batch up to the config default."""
+        if self._batch_rows_override is not None:
+            return self._batch_rows_override
+        return self._rec.rec_batch_rows()
+
+    def set_batch_rows(self, rows: int) -> None:
+        """Pin the scan micro-batch shape (the shared bucketing
+        contract, models/recommender.py bucket_batch_rows) — called by
+        the server with its resolved batch knob before warm()."""
+        from fastapriori_tpu.models.recommender import bucket_batch_rows
+
+        self._batch_rows_override = bucket_batch_rows(rows)
+
+    def describe(self) -> dict:
+        """Model facts for the serving record / stats stream."""
+        out = {
+            "signature": self.signature,
+            "source": self.source,
+            "engine": self._engine or self._engine_req,
+            "n_rules": self.n_rules,
+            "n_items": len(self.freq_items),
+            "batch_rows": self.batch_rows(),
+            "scan_dispatches": self.scan_dispatches,
+            "rule_table_host_bytes": self.rule_table_host_bytes,
+            "warm_ms": round(self.warm_ms, 1),
+        }
+        if self._handle is not None:
+            out["resident_table"] = bool(self._handle.resident)
+            out["scan_shards"] = self._handle.shards
+            out["table_bytes"] = self._handle.table_bytes
+        return out
+
+    # -- serving --------------------------------------------------------
+    def _resolve_engine(self) -> str:
+        if self._engine is not None:
+            return self._engine
+        eng = self._engine_req
+        rec = self._rec
+        n_rules = self.n_rules  # generates the rules (and, on the
+        # sharded engine, the resident scan state the auto rule reads)
+        if eng == "auto":
+            if (
+                rec._scan_state is not None or rec._scan_table is not None
+            ) and n_rules:
+                # Phase 2 left a device-resident (or already-built) scan
+                # table — the serving tier's whole point; mount it.
+                eng = "device"
+            else:
+                # Mirror the batch path's auto rule against ONE
+                # micro-batch (deterministic in the model, not the
+                # traffic): tiny models scan faster on the host than one
+                # dispatch round-trips.
+                eng = (
+                    "device"
+                    if self.n_rules
+                    and self.batch_rows() * self.n_rules >= 30_000_000
+                    else "host"
+                )
+        if eng == "device" and not self.n_rules:
+            eng = "host"
+        if eng == "host" and rec._scan_state is not None:
+            # The host scan never consumes the resident join state —
+            # free the per-level device tables (the batch path's rule).
+            rec._scan_state.release()
+            rec._scan_state = None
+        self._engine = eng
+        ledger.record(
+            "serve_engine", once_key=f"{self.signature}:{eng}",
+            engine=eng, signature=self.signature, rules=self.n_rules,
+        )
+        return eng
+
+    def warm(self) -> None:
+        """Resolve the engine, mount the device table and pre-compile
+        the fixed-shape scan (one dummy micro-batch), so the first real
+        request pays dispatch latency, not XLA compile latency.  The
+        replicated form's one-time table upload happens HERE — after
+        warm() returns, no rule-table byte crosses the host link
+        (``rule_table_host_bytes`` stays 0 across the serving run)."""
+        t0 = time.perf_counter()
+        eng = self._resolve_engine()
+        if eng == "device" and self._handle is None:
+            self._handle = self._rec.serve_scan()
+            self._scan_blocks([np.zeros(1, dtype=np.int32)])
+        elif eng == "host":
+            self._rec._ensure_rules()
+        self.warm_ms = (time.perf_counter() - t0) * 1e3
+
+    def _scan_blocks(self, baskets: List[np.ndarray]) -> np.ndarray:
+        """Device scan of distinct baskets in fixed-shape micro-batches:
+        every dispatch is [rows, F_pad] — ONE compiled program serves
+        any traffic mix, short batches ride as padding rows (0-length,
+        excluded from the kernel's early exit).  Each batch's audited
+        fetch (``fetch.serve_match``) overlaps the next batch's
+        dispatch.  Returns consequent indexes (-1 = no match)."""
+        import jax.numpy as jnp
+
+        h = self._handle
+        cfg = self.config
+        mb = self.batch_rows()
+        rows = pad_axis(mb, h.row_multiple) if h.row_multiple > 1 else mb
+        cons_out = np.full(len(baskets), -1, dtype=np.int64)
+        fetches = []
+        for b0 in range(0, len(baskets), mb):
+            block = baskets[b0 : b0 + mb]
+            bm = build_bitmap(block, h.f, rows, cfg.item_tile)
+            blen = np.zeros(rows, dtype=np.int32)
+            blen[: len(block)] = [len(b) for b in block]
+            best, cons, _chunks = h.scan(bm, blen)
+            arr = best if cons is None else jnp.stack([best, cons])
+            fetches.append(
+                (b0, len(block), retry.fetch_async(arr, "serve_match"))
+            )
+            self.scan_dispatches += 1
+            self.scan_rows += rows
+        for b0, n, fetch in fetches:
+            arr = fetch.result()
+            if h.decode is not None:
+                # lint: host-data -- arr is the already-fetched numpy result
+                ranks = np.asarray(arr[:n], dtype=np.int64)
+                cons_out[b0 : b0 + n] = h.decode(ranks)
+            else:
+                cons_out[b0 : b0 + n] = arr[1][:n]
+        return cons_out
+
+    def recommend_batch(self, lines: Sequence[Sequence[str]]) -> List[str]:
+        """Serve one request micro-batch: dedup within the batch (the
+        reference's C10 — identical concurrent baskets scan once),
+        scan distinct baskets on the resolved engine, fan out.  Returns
+        one recommended item string (or "0") per input line, in input
+        order.  A device scan whose transient failures exhausted their
+        retry budget walks the ``rule_scan`` cascade to the host oracle
+        for this AND later batches (forward-only, ledger-recorded) —
+        the serving loop degrades, it does not die."""
+        if self._released:
+            raise InputError(
+                "ServingState was released (hot-swapped out); build or "
+                "load a fresh state to serve"
+            )
+        baskets, indexes, _empty = dedup_user_baskets(
+            lines, self.item_to_rank
+        )
+        out = ["0"] * len(lines)
+        if not baskets or not self.n_rules:
+            return out
+        eng = self._resolve_engine()
+        if eng == "device":
+            if self._handle is None:
+                self.warm()
+            try:
+                cons = self._scan_blocks(baskets)
+            except Exception as exc:
+                if not watchdog.transient(exc):
+                    raise
+                watchdog.downgrade(
+                    "rule_scan", "device", "host",
+                    reason="serve_transient_exhausted",
+                    once_key=f"serve:{self.signature}",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+                self._engine = "host"
+                # The cascade is forward-only — the device engine never
+                # serves this state again, so free its table instead of
+                # pinning HBM for the degraded server's lifetime.
+                self._drop_device_table()
+                # lint: host-data -- host-scan result list, no device fetch
+                cons = np.asarray(
+                    self._rec._host_first_match(baskets), dtype=np.int64
+                )
+        else:
+            # lint: host-data -- host-scan result list, no device fetch
+            cons = np.asarray(
+                self._rec._host_first_match(baskets), dtype=np.int64
+            )
+        for rows, c in zip(indexes, cons):
+            if c >= 0:
+                item = self.freq_items[int(c)]
+                for i in rows:
+                    out[i] = item
+        return out
+
+    def _drop_device_table(self) -> None:
+        """Free every device reference this state holds (the scan
+        handle, the resident join state, the built/uploaded tables) —
+        shared by :meth:`release` and the device→host serve cascade."""
+        self._handle = None
+        rec = self._rec
+        if rec._scan_state is not None:
+            rec._scan_state.release()
+            rec._scan_state = None
+        rec._scan_table = None
+        rec._rule_dev = None
+
+    def release(self) -> None:
+        """Drop the device table references (a hot-swapped-out model
+        must not pin HBM for the process lifetime).  Further
+        recommend_batch calls raise — a swapped-out model never serves
+        again (the no-table-mixing contract)."""
+        self._released = True
+        self._drop_device_table()
+
+    def resident_device_bytes(self) -> int:
+        """HBM currently pinned by the mounted table (+ any not-yet-
+        consumed phase-2 join state — ``DeviceRuleState.device_bytes``),
+        for the serving record: a hot-swap transiently doubles this."""
+        total = (
+            self._handle.table_bytes if self._handle is not None else 0
+        )
+        state = self._rec._scan_state
+        if state is not None:
+            total += state.device_bytes()
+        return total

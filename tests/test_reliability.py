@@ -31,10 +31,12 @@ def _clean_reliability_state():
     failpoints.disarm_all()
     ledger.reset()
     watchdog.reload_from_env()
+    watchdog.reset_abandoned()
     yield
     failpoints.disarm_all()
     ledger.reset()
     watchdog.reload_from_env()
+    watchdog.reset_abandoned()
 
 
 # ---------------------------------------------------------------------------
@@ -150,6 +152,7 @@ FETCH_SITE_INVENTORY = [
     "fetch.rule_mask_shard",  # rules/gen.py SHARDED-engine survivor bitmask
     "fetch.rule_counts",  # rules/gen.py surviving-denominator gather
     "fetch.rec_match",  # models/recommender.py resident-scan result batch
+    "fetch.serve_match",  # serve/state.py serving micro-batch result
     "fetch.vpair",  # parallel/mesh.py vertical-engine pair packed fetch
     "fetch.vpair_sparse",  # parallel/mesh.py vertical pair + union census
     "fetch.vlevel_bits",  # models/apriori.py vertical survivor bitmask
@@ -1103,6 +1106,126 @@ def test_watchdog_bounds_retried_fetch_end_to_end(monkeypatch):
     assert "watchdog_timeout" in kinds and "retry" in kinds
 
 
+def test_watchdog_abandoned_count_rides_ledger_event():
+    """Every trip carries the live abandoned-thread census (ISSUE 10
+    satellite / PR 9 residue: the leak is now a number, not a
+    surprise)."""
+    import threading as _threading
+
+    gate = _threading.Event()
+    try:
+        with pytest.raises(watchdog.DispatchTimeout):
+            watchdog.guard(gate.wait, "fetch.hang_a", timeout_s=0.05)
+        assert watchdog.abandoned_live() == 1
+        with pytest.raises(watchdog.DispatchTimeout):
+            watchdog.guard(gate.wait, "fetch.hang_b", timeout_s=0.05)
+        events = [
+            e for e in ledger.snapshot()
+            if e["kind"] == "watchdog_timeout"
+        ]
+        assert [e["abandoned_live"] for e in events] == [1, 2]
+    finally:
+        gate.set()  # free the workers; the registry prunes dead threads
+
+
+def test_watchdog_abandoned_cap_trips_fatal(monkeypatch):
+    """A trip past FA_DISPATCH_MAX_ABANDONED is FATAL (not transient):
+    a runtime wedged hard enough to strand the cap's worth of threads
+    will strand one more per retry — the classified error must stop the
+    run instead of leaking unboundedly."""
+    import threading as _threading
+
+    monkeypatch.setenv("FA_DISPATCH_MAX_ABANDONED", "2")
+    # The end-to-end call_with_retries leg below takes its bound from
+    # the env knob — without it guard() is a passthrough and the hung
+    # thunk would block THIS thread forever.
+    monkeypatch.setenv("FA_DISPATCH_TIMEOUT_S", "0.02")
+    watchdog.reload_from_env()
+    gate = _threading.Event()
+    try:
+        for site in ("fetch.cap_a", "fetch.cap_b"):
+            with pytest.raises(watchdog.DispatchTimeout):
+                watchdog.guard(gate.wait, site, timeout_s=0.02)
+        with pytest.raises(watchdog.AbandonedThreadCap) as ei:
+            watchdog.guard(gate.wait, "fetch.cap_c", timeout_s=0.02)
+        assert retry.classify(ei.value) == "fatal"
+        assert "FA_DISPATCH_MAX_ABANDONED" in str(ei.value)
+        # End to end: the fatal cap error is NOT retried (one attempt).
+        calls = []
+
+        def hang():
+            calls.append(1)
+            gate.wait()
+
+        with pytest.raises(watchdog.AbandonedThreadCap):
+            retry.call_with_retries(
+                hang, "fetch.cap_d", sleep=lambda s: None,
+                policy=retry.RetryPolicy(max_attempts=3, base_delay_s=0.0),
+            )
+        assert len(calls) == 1
+        # Every trip still carried the census.
+        events = [
+            e for e in ledger.snapshot()
+            if e["kind"] == "watchdog_timeout"
+        ]
+        assert [e["abandoned_live"] for e in events] == [1, 2, 3, 4]
+    finally:
+        gate.set()
+
+
+def test_watchdog_abandoned_cap_zero_disables(monkeypatch):
+    import threading as _threading
+
+    monkeypatch.setenv("FA_DISPATCH_MAX_ABANDONED", "0")
+    watchdog.reload_from_env()
+    gate = _threading.Event()
+    try:
+        for i in range(3):
+            with pytest.raises(watchdog.DispatchTimeout):
+                watchdog.guard(gate.wait, f"fetch.nocap{i}",
+                               timeout_s=0.02)
+        assert watchdog.abandoned_live() == 3
+    finally:
+        gate.set()
+
+
+def test_watchdog_abandoned_registry_prunes_dead_threads():
+    import threading as _threading
+
+    gate = _threading.Event()
+    with pytest.raises(watchdog.DispatchTimeout):
+        watchdog.guard(gate.wait, "fetch.prune", timeout_s=0.02)
+    assert watchdog.abandoned_live() == 1
+    gate.set()  # the worker finishes; the registry prunes it
+    deadline = _time_mod().monotonic() + 5.0
+    while watchdog.abandoned_live() and _time_mod().monotonic() < deadline:
+        _time_mod().sleep(0.01)
+    assert watchdog.abandoned_live() == 0
+
+
+def _time_mod():
+    import time as _t
+
+    return _t
+
+
+def test_watchdog_max_abandoned_strictly_parsed(monkeypatch):
+    monkeypatch.setenv("FA_DISPATCH_MAX_ABANDONED", "many")
+    watchdog.reload_from_env()
+    with pytest.raises(InputError, match="FA_DISPATCH_MAX_ABANDONED"):
+        watchdog.max_abandoned()
+    monkeypatch.setenv("FA_DISPATCH_MAX_ABANDONED", "-3")
+    watchdog.reload_from_env()
+    with pytest.raises(InputError, match="out of range"):
+        watchdog.max_abandoned()
+    monkeypatch.setenv("FA_DISPATCH_MAX_ABANDONED", "5")
+    watchdog.reload_from_env()
+    assert watchdog.max_abandoned() == 5
+    monkeypatch.delenv("FA_DISPATCH_MAX_ABANDONED")
+    watchdog.reload_from_env()
+    assert watchdog.max_abandoned() == 8  # the documented default
+
+
 def test_watchdog_recovered_fetch_succeeds(monkeypatch):
     """A timeout on attempt 1 followed by a fast attempt 2 = the flap
     the watchdog+retry pairing exists for."""
@@ -1139,6 +1262,7 @@ def test_cascade_chain_ordering_pinned():
         "count_reduce": ("sparse", "dense"),
         "rule_engine": ("sharded", "device", "host"),
         "rule_scan": ("device", "host"),
+        "serving": ("accept", "shed"),
     }
     assert watchdog.chain_rank("engine", "fused") == 0
     assert watchdog.chain_rank("engine", "level") == 2

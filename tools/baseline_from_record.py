@@ -65,6 +65,23 @@ def render(parsed: dict) -> str:
             f"| {name} | {ms} | **{val}** {unit} | {vs} | "
             f"{wall} {fmt_band(band)} |"
         )
+    srv = (cfgs.get("movielens_serve") or {}).get("serve") or {}
+    sus = srv.get("sustained") or {}
+    if sus.get("achieved_rps") is not None:
+        over = srv.get("overload") or {}
+        model = srv.get("model") or {}
+        out.append(
+            f"| serving tier (movielens, open-loop) | 0.1 | "
+            f"**{sus.get('achieved_rps')}** users/sec sustained "
+            f"(offered {sus.get('offered_rps')}, closed-batch capacity "
+            f"{srv.get('batch_users_per_s')}) | — | p50/p95/p99 "
+            f"{sus.get('p50_ms')}/{sus.get('p95_ms')}/{sus.get('p99_ms')}"
+            f" ms, shed {sus.get('shed')}; overload shed "
+            f"{over.get('shed')}/{over.get('n_requests')} (queue bound "
+            f"{over.get('queue_depth')}), engine {model.get('engine')}"
+            f"{' resident' if model.get('resident_table') else ''}, "
+            f"rule-table host bytes {srv.get('rule_table_host_bytes')} |"
+        )
     rf = parsed.get("rules_full_scale") or {}
     if rf.get("value") is not None:
         eng = (

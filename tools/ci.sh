@@ -45,6 +45,21 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_shard.py -q \
 
 env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
 
+# Serving-tier smoke (ISSUE 10): resident server on the CI corpus —
+# build + warm-restart byte-identical, a seeded open-loop burst, an
+# overload spike that must degrade to recorded sheds (bounded queue,
+# recovery after), and a transient-absorb pass on the serving fetch.
+# Wall-budgeted and logged like lint/chaos (soft signal: the smoke
+# itself bounds every wait; the gate catches a pathological slowdown).
+serve_t0=$(python -c 'import time; print(time.time())')
+env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+python - "$serve_t0" <<'EOF'
+import sys, time
+elapsed = time.time() - float(sys.argv[1])
+print(f"serve smoke wall time: {elapsed:.2f}s (budget 90s)")
+sys.exit(1 if elapsed > 90.0 else 0)
+EOF
+
 # Seeded chaos soak (ISSUE 9): deterministic failpoint schedules over
 # the lint-censused site inventory against the full CLI pipeline —
 # byte-identical, classified, or ledger-degraded; never a hang, silent

@@ -158,11 +158,13 @@ class HostSyncRule(Rule):
     # just those inside traced functions: the mesh layer, the engine
     # layer's level loop (its np.asarray sites are the mining phase's
     # biggest link payloads — ROADMAP open item, extended from parallel/
-    # in the reliability PR), and the rule generator since its device
+    # in the reliability PR), the rule generator since its device
     # engine landed (ISSUE 4: mask/denominator fetches must stay on the
-    # audited retry.fetch_async / gather path).
+    # audited retry.fetch_async / gather path), and the serving tier
+    # (ISSUE 10: every scan-result fetch on the request hot path must
+    # ride the audited fetch.serve_match site).
     fetch_audit_dirs: Tuple[str, ...] = (
-        "parallel/", "models/apriori", "rules/gen",
+        "parallel/", "models/apriori", "rules/gen", "serve/",
     )
 
     _SYNC_ATTRS = {"item", "block_until_ready", "tolist", "copy_to_host_async"}
@@ -909,8 +911,12 @@ class ShapeBucketRule(Rule):
     name = "shape-bucket"
     aliases = ("bucket-ok",)
 
-    # Layers whose host code computes shapes for compiled dispatch.
-    scope_path_parts: Tuple[str, ...] = ("parallel/", "models/", "rules/")
+    # Layers whose host code computes shapes for compiled dispatch
+    # (serve/ since ISSUE 10: the serving micro-batcher forms the scan's
+    # fixed compile shape from its knobs).
+    scope_path_parts: Tuple[str, ...] = (
+        "parallel/", "models/", "rules/", "serve/",
+    )
 
     def check(self, ctx, pkg):
         from tools.lint import flow

@@ -1,0 +1,201 @@
+"""Bounded serving-tier smoke (ISSUE 10 satellite; `make serve-smoke`).
+
+Stands the resident recommend server up on the CI corpus and drives it
+through the serving invariants end to end, in one wall-budgeted pass:
+
+1. **Build + warm restart**: mine the corpus, serve a fixed request
+   set, checkpoint the ServingState, reload it (manifest-validated) and
+   assert the restarted server answers byte-identically.
+2. **Sustained open-loop burst**: a seeded arrival schedule below
+   capacity — everything serves, latency percentiles are finite, the
+   run drains inside the bound (never a hang).
+3. **Overload spike**: a slow-scan failpoint (``fetch.serve_match
+   delay``) plus a burst far past capacity against a tiny queue —
+   admission control must SHED (answered "0" + the serving cascade
+   event on the ledger), the queue stays bounded, and the server
+   recovers: a post-spike request set serves normally again.
+4. **Transient absorb**: ``fetch.serve_match:oom*1`` — the audited
+   fetch's retry absorbs one injected failure, responses stay correct,
+   the ledger names the site.
+
+Run: ``env JAX_PLATFORMS=cpu python tools/serve_smoke.py``.
+Exit 0 = all invariants held.  Wall time is logged by tools/ci.sh
+against its budget, like lint's and the chaos soak's.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # `python tools/serve_smoke.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def make_inputs(root: str) -> str:
+    """Deterministic tiny corpus (the chaos soak's shape)."""
+    rng = random.Random(11)
+    items = [str(i) for i in range(1, 13)]
+    weights = [1.0 / (i + 1) for i in range(12)]
+    lines = [
+        " ".join(rng.choices(items, weights=weights, k=rng.randint(1, 6)))
+        for _ in range(130)
+    ] + ["1 2 3 4 5"] * 20
+    inp = os.path.join(root, "in") + os.sep
+    os.makedirs(inp)
+    # lint: waive G009 -- smoke INPUT fixtures in a fresh temp dir, not run artifacts
+    with open(os.path.join(inp, "D.dat"), "w") as f:
+        f.writelines(l + "\n" for l in lines)
+    return inp
+
+
+def main() -> int:
+    t_start = time.time()
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.io.reader import tokenize_line
+    from fastapriori_tpu.reliability import failpoints, ledger
+    from fastapriori_tpu.serve import (
+        RecommendServer,
+        ServingState,
+        run_open_loop,
+    )
+
+    failures = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        status = "ok" if ok else "FAIL"
+        print(f"serve-smoke [{name}] {status} {detail}".rstrip())
+        if not ok:
+            failures.append(name)
+
+    with tempfile.TemporaryDirectory() as root:
+        inp = make_inputs(root)
+        out = os.path.join(root, "out") + os.sep
+        os.makedirs(out)
+        with open(os.path.join(inp, "D.dat")) as f:
+            pool = [tokenize_line(l) for l in f][:40]
+
+        cfg = MinerConfig(min_support=0.1, retain_csr=False)
+        state = ServingState.from_mine(
+            os.path.join(inp, "D.dat"), config=cfg
+        )
+        state.warm()
+        baseline = state.recommend_batch(pool)
+        check(
+            "build",
+            state.n_rules > 0 and len(baseline) == len(pool),
+            f"{state.n_rules} rules, engine {state.describe()['engine']}",
+        )
+
+        # 1. checkpoint -> reload -> byte-identical.
+        state.save(out)
+        restored = ServingState.load(out, config=cfg)
+        check(
+            "warm-restart",
+            restored.signature == state.signature
+            and restored.recommend_batch(pool) == baseline,
+            f"signature {restored.signature}",
+        )
+
+        # The server scenarios run the DEVICE engine (forced — the CI
+        # model is below the auto threshold) so the audited
+        # fetch.serve_match site is genuinely on the hot path for the
+        # delay/oom injections below; device responses must equal the
+        # host baseline.
+        dev_state = ServingState.load(out, config=cfg, engine="device")
+        dev_state.warm()
+        check(
+            "device-vs-host",
+            dev_state.recommend_batch(pool) == baseline,
+            f"resident={dev_state.describe().get('resident_table')}",
+        )
+
+        # 2. sustained seeded burst below capacity: all served, finite
+        # percentiles, bounded drain.
+        ledger.reset()
+        server = RecommendServer(
+            dev_state, batch_rows=32, linger_ms=1.0, queue_depth=4096
+        ).start(warm=False)
+        sustained = run_open_loop(
+            server, pool, rate_rps=500.0, n_requests=600, seed=7,
+            drain_timeout_s=60.0, label="sustained",
+        )
+        check(
+            "sustained",
+            sustained["drained"]
+            and sustained["served"] + sustained["shed"] == 600
+            and sustained["p99_ms"] is not None,
+            f"achieved {sustained['achieved_rps']}/s "
+            f"p99 {sustained['p99_ms']}ms shed {sustained['shed']}",
+        )
+
+        # 3. overload spike: slow scans (armed delay on the serving
+        # fetch) + a tiny queue + a burst far past capacity -> sheds
+        # recorded, queue bounded, no hang.
+        server.stop(drain=True)
+        failpoints.arm("fetch.serve_match", "delay@25")
+        slow = RecommendServer(
+            dev_state, batch_rows=32, linger_ms=0.0, queue_depth=64
+        ).start(warm=False)
+        overload = run_open_loop(
+            slow, pool, rate_rps=20000.0, n_requests=4000, seed=8,
+            drain_timeout_s=60.0, label="overload",
+        )
+        failpoints.disarm_all()
+        shed_reqs = overload["shed"]
+        cascade = [
+            e for e in ledger.snapshot()
+            if e.get("kind") == "cascade" and e.get("chain") == "serving"
+        ]
+        check(
+            "overload-sheds",
+            shed_reqs > 0 and overload["drained"] and len(cascade) >= 1,
+            f"shed {shed_reqs}/4000, max_queue {overload['max_queue']} "
+            f"(bound 64), cascade events {len(cascade)}",
+        )
+        check(
+            "overload-bounded",
+            overload["max_queue"] <= 64
+            and overload["served"] + shed_reqs == 4000,
+        )
+        # Recovery: after the spike (failpoint disarmed), the same
+        # server serves a normal request set byte-identically.
+        recovery = [slow.submit_wait(t, timeout_s=30.0) for t in pool]
+        slow.wait_for(recovery, timeout_s=60.0)
+        check(
+            "recovery",
+            [r.item for r in recovery] == baseline,
+            "post-spike responses byte-identical",
+        )
+        stopped = slow.stop(drain=True)
+        check("stop", stopped, "dispatcher exited inside the bound")
+
+        # 4. transient absorb on the audited serving fetch: one injected
+        # OOM is retried away, responses stay correct, the ledger names
+        # the site.
+        ledger.reset()
+        failpoints.arm("fetch.serve_match", "oom*1")
+        again = dev_state.recommend_batch(pool)
+        retries = [
+            e for e in ledger.snapshot()
+            if e.get("kind") == "retry"
+            and e.get("site") == "fetch.serve_match"
+        ]
+        failpoints.disarm_all()
+        check(
+            "transient-absorb",
+            again == baseline and len(retries) >= 1,
+            f"retries {len(retries)}",
+        )
+
+    wall = time.time() - t_start
+    print(f"serve-smoke: wall {wall:.1f}s, {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
