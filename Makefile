@@ -3,7 +3,7 @@
 # failpoint smoke pass (reliability wiring under injected failure — see
 # tools/failpoint_smoke.py).
 
-.PHONY: lint test smoke serve-smoke chaos ci baseline inventory native
+.PHONY: lint test smoke serve-smoke obs-smoke chaos ci baseline inventory native
 
 # Default paths cover the whole tree: fastapriori_tpu tests bench.py
 # __graft_entry__.py tools (tools/lint/cli.py DEFAULT_PATHS).
@@ -23,6 +23,12 @@ smoke:
 serve-smoke:
 	env JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+# Observability smoke (ISSUE 11): mine+serve under --trace
+# (Perfetto-loadable artifact, span hierarchy, counter tracks),
+# metrics-dump/mid-burst scrape, tracing-off overhead pin.
+obs-smoke:
+	env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
 # Seeded chaos soak: deterministic failpoint schedules over the
 # censused site inventory, full-pipeline invariant check (ISSUE 9;
 # FA_CHAOS_SEED offsets the seed set).
@@ -30,7 +36,7 @@ chaos:
 	env JAX_PLATFORMS=cpu python tools/chaos.py \
 	    --seeds 0,4,6,9 --scenarios 3 --budget-s 120
 
-ci: lint test smoke serve-smoke chaos
+ci: lint test smoke serve-smoke obs-smoke chaos
 
 # Ratchet reset — only alongside the change that justifies it.
 baseline:

@@ -125,6 +125,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a jax.profiler trace for the mining phase here",
     )
     p.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="record the span tracer (run → phase → level → dispatch → "
+        "fetch, with collective-byte counter tracks) and export "
+        "Chrome-trace-event JSON here — load it in Perfetto "
+        "(ui.perfetto.dev); FA_TRACE=1 records without exporting",
+    )
+    p.add_argument(
         "--platform",
         choices=["default", "cpu"],
         default="default",
@@ -234,6 +243,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="emit structured JSON metrics to stderr",
     )
     p.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="record the span tracer (serve-batch spans split "
+        "admission/dedup/pack host time from device scan time) and "
+        "export Perfetto-loadable Chrome-trace JSON here",
+    )
+    p.add_argument(
+        "--metrics-dump",
+        default=None,
+        metavar="PATH",
+        help="periodically write the server's Prometheus-text metrics "
+        "snapshot here (atomic rewrite every FA_METRICS_DUMP_S "
+        "seconds, final snapshot at shutdown) — the scrape surface "
+        "for a file-based collector",
+    )
+    p.add_argument(
         "--platform", choices=["default", "cpu"], default="default",
         help="force the JAX platform in-process ('cpu' serves without "
         "an accelerator)",
@@ -248,12 +274,53 @@ def _serve_main(argv: List[str]) -> int:
     try:
         return _run_serve(args)
     except InputError as e:
+        from fastapriori_tpu.obs import flight
+
+        flight.auto_dump(
+            "classified_error", extra={"error": f"InputError: {e}"[:400]}
+        )
         print(f"error: {e}", file=sys.stderr)
         return 2
     except FileNotFoundError as e:
         missing = e.filename if e.filename else str(e)
         print(f"error: file {missing!r} not found", file=sys.stderr)
         return 2
+
+
+def _start_metrics_dump(server, path: Optional[str]):
+    """``serve --metrics-dump PATH``: a daemon thread rewriting the
+    server's Prometheus-text snapshot ATOMICALLY (the PR-2 committer —
+    a scraper never reads a torn file) every ``FA_METRICS_DUMP_S``
+    seconds.  Returns a stop callable that writes the final snapshot
+    and joins the thread (bounded), or None when no path was given."""
+    if not path:
+        return None
+    import threading
+
+    from fastapriori_tpu.io.writer import write_artifact_bytes
+    from fastapriori_tpu.obs.metrics import dump_interval_s
+
+    interval = dump_interval_s()
+    stop = threading.Event()
+
+    def write_once() -> None:
+        write_artifact_bytes(
+            path, [server.metrics_text().encode("utf-8")], "metrics"
+        )
+
+    def loop() -> None:
+        while not stop.wait(interval):
+            write_once()
+
+    t = threading.Thread(target=loop, name="fa-metrics-dump", daemon=True)
+    t.start()
+
+    def finish() -> None:
+        stop.set()
+        t.join(10.0)
+        write_once()
+
+    return finish
 
 
 def _run_serve(args) -> int:
@@ -281,7 +348,12 @@ def _run_serve(args) -> int:
     enable_compile_cache()
     from fastapriori_tpu.config import MinerConfig
     from fastapriori_tpu.io.reader import tokenize_line
+    from fastapriori_tpu.obs import flight, trace
     from fastapriori_tpu.serve import RecommendServer, ServingState
+    from fastapriori_tpu.utils.logging import phase_timer
+
+    trace.maybe_enable(bool(args.trace))
+    flight.set_dump_prefix(args.output or args.input)
 
     config = MinerConfig(
         min_support=args.min_support,
@@ -290,27 +362,30 @@ def _run_serve(args) -> int:
         retain_csr=False,
     )
     t0 = time.perf_counter()
-    if args.from_serving:
-        state = ServingState.load(
-            args.from_serving, config=config, engine=args.serve_engine
-        )
-    else:
-        state = ServingState.from_mine(
-            args.input + "D.dat", config=config, engine=args.serve_engine
-        )
-    if args.save_serving:
-        state.save(args.output)
-    server = RecommendServer(
-        state,
-        batch_rows=args.batch_rows,
-        linger_ms=args.linger_ms,
-        queue_depth=args.queue_depth,
-    ).start()
+    with phase_timer("serve model mount", enabled=False):
+        if args.from_serving:
+            state = ServingState.load(
+                args.from_serving, config=config, engine=args.serve_engine
+            )
+        else:
+            state = ServingState.from_mine(
+                args.input + "D.dat", config=config,
+                engine=args.serve_engine,
+            )
+        if args.save_serving:
+            state.save(args.output)
+        server = RecommendServer(
+            state,
+            batch_rows=args.batch_rows,
+            linger_ms=args.linger_ms,
+            queue_depth=args.queue_depth,
+        ).start()
     print(
         "==== Total time for serve model mount "
         f"{int((time.perf_counter() - t0) * 1e3)}",
         file=sys.stderr,
     )
+    dump_stop = _start_metrics_dump(server, args.metrics_dump)
 
     req_path = args.requests or (args.input + "U.dat")
     if req_path == "-":
@@ -348,6 +423,15 @@ def _run_serve(args) -> int:
     served_wall = time.perf_counter() - t1
     stats = server.stats()
     stopped = server.stop(drain=True)
+    if dump_stop is not None:
+        dump_stop()  # final metrics snapshot, thread joined (bounded)
+    if args.trace:
+        path = trace.TRACER.export(args.trace)
+        print(
+            f"trace written: {path} "
+            f"({len(trace.TRACER.events())} events; load in Perfetto)",
+            file=sys.stderr,
+        )
     if not completed or not stopped:
         # A wedged dispatcher must be a LOUD failure (the server's own
         # stop() contract) — writing a clean-looking artifact of "0"
@@ -404,6 +488,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         return _run(args)
     except InputError as e:
+        # Classified failure: ship the flight-recorder post-mortem (the
+        # last N span/ledger/watchdog events) next to the run's other
+        # artifacts before the friendly one-liner.
+        from fastapriori_tpu.obs import flight
+
+        flight.auto_dump(
+            "classified_error", extra={"error": f"InputError: {e}"[:400]}
+        )
         print(f"error: {e}", file=sys.stderr)
         return 2
     except FileNotFoundError as e:
@@ -507,9 +599,27 @@ def _run(args) -> int:
     from fastapriori_tpu.models.recommender import AssociationRules
 
     from fastapriori_tpu.io.reader import read_dat
+    from fastapriori_tpu.obs import flight, trace
+    from fastapriori_tpu.utils.logging import phase_timer
+
+    # Observability (ISSUE 11): span recording on --trace/FA_TRACE, and
+    # the flight recorder's post-mortem dumps target this run's output
+    # prefix (process 0 — one writer, like every other artifact).
+    trace.maybe_enable(bool(args.trace))
+    if proc_id == 0:
+        flight.set_dump_prefix(args.output)
 
     u_lines = read_dat(args.input + "U.dat")
 
+    # The run root span + reference-style phase walls (phase_timer now
+    # routes through the tracer and the active MetricsLogger — ISSUE 11
+    # satellite).  Entered explicitly: the phase boundaries interleave
+    # with this function's early returns, and a propagating error is
+    # the flight recorder's job, not the trace exporter's.
+    run_span = trace.span("run", cmd="mine")
+    run_span.__enter__()
+    phase = phase_timer("get freqItemsets", enabled=False)
+    phase.__enter__()
     t1 = time.perf_counter()
     levels = item_counts = None
     resume_ckpt = None
@@ -595,12 +705,15 @@ def _run(args) -> int:
                     manifest=manifest,
                 )
             write_manifest(args.output, manifest)
+    phase.__exit__(None, None, None)
     print(
         "==== Total time for get freqItemsets "
         f"{int((time.perf_counter() - t1) * 1e3)}",
         file=sys.stderr,
     )
 
+    phase = phase_timer("get recommends", enabled=False)
+    phase.__enter__()
     t2 = time.perf_counter()
     # Phase 2 runs on EVERY process: the containment kernel shards the
     # (deduplicated) user baskets over the global mesh, so each process
@@ -618,11 +731,20 @@ def _run(args) -> int:
         manifest = {}
         save_recommends(args.output, recommends, manifest=manifest)
         write_manifest(args.output, manifest)
+    phase.__exit__(None, None, None)
     print(
         "==== Total time for get recommends "
         f"{int((time.perf_counter() - t2) * 1e3)}",
         file=sys.stderr,
     )
+    run_span.__exit__(None, None, None)
+    if args.trace and proc_id == 0:
+        path = trace.TRACER.export(args.trace)
+        print(
+            f"trace written: {path} "
+            f"({len(trace.TRACER.events())} events; load in Perfetto)",
+            file=sys.stderr,
+        )
     return 0
 
 

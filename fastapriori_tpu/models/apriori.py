@@ -34,6 +34,7 @@ from fastapriori_tpu.ops.bitmap import (
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import CompressedData, preprocess
 from fastapriori_tpu.reliability import failpoints, ledger, retry, watchdog
+from fastapriori_tpu.obs import trace
 from fastapriori_tpu.utils.logging import MetricsLogger
 
 ItemsetWithCount = Tuple[FrozenSet[int], int]
@@ -643,15 +644,16 @@ class FastApriori:
         Returns ``(freqItemsets with counts, itemToRank, freqItems)`` —
         levels >=2 first, then the 1-itemsets with their raw occurrence
         counts (:41,83)."""
-        with self.metrics.timed("preprocess") as m:
-            data = preprocess(transactions, self.config.min_support)
-            m.update(
-                n_raw=data.n_raw,
-                min_count=data.min_count,
-                num_items=data.num_items,
-                total_count=data.total_count,
-            )
-        freq_itemsets = self.mine_compressed(data)
+        with trace.span("mine", source="transactions"):
+            with self.metrics.timed("preprocess") as m:
+                data = preprocess(transactions, self.config.min_support)
+                m.update(
+                    n_raw=data.n_raw,
+                    min_count=data.min_count,
+                    num_items=data.num_items,
+                    total_count=data.total_count,
+                )
+            freq_itemsets = self.mine_compressed(data)
         return freq_itemsets, data.item_to_rank, data.freq_items
 
     def run_file(
@@ -684,17 +686,21 @@ class FastApriori:
         the CompressedData docstring for the exact contract."""
         from fastapriori_tpu.preprocess import preprocess_file
 
-        if self._can_pipeline_ingest(d_path):
-            return self._run_file_pipelined(d_path)
-        with self.metrics.timed("preprocess", path=d_path) as m:
-            data = preprocess_file(d_path, self.config.min_support)
-            m.update(
-                n_raw=data.n_raw,
-                min_count=data.min_count,
-                num_items=data.num_items,
-                total_count=data.total_count,
-            )
-        return self.mine_levels_raw(data), data
+        # The mining root span (ISSUE 11): phases (preprocess / level /
+        # tail_fuse / counts_resolve / checkpoint — every metrics.timed
+        # section) nest under it via the tracer's thread-local stack.
+        with trace.span("mine", path=d_path):
+            if self._can_pipeline_ingest(d_path):
+                return self._run_file_pipelined(d_path)
+            with self.metrics.timed("preprocess", path=d_path) as m:
+                data = preprocess_file(d_path, self.config.min_support)
+                m.update(
+                    n_raw=data.n_raw,
+                    min_count=data.min_count,
+                    num_items=data.num_items,
+                    total_count=data.total_count,
+                )
+            return self.mine_levels_raw(data), data
 
     def _txn_multiple(self, n_chunks: int, total: int) -> int:
         """Padding multiple for the transaction axis: per-chunk rows stay
@@ -1518,18 +1524,19 @@ class FastApriori:
         process)."""
         from fastapriori_tpu.preprocess import preprocess_file_sharded
 
-        with self.metrics.timed("preprocess", path=d_path) as m:
-            data = preprocess_file_sharded(
-                d_path, self.config.min_support
-            )
-            m.update(
-                n_raw=data.n_raw,
-                min_count=data.min_count,
-                num_items=data.num_items,
-                local_count=data.total_count,
-                global_count=data.shard.global_count,
-            )
-        return self.mine_levels_raw(data), data
+        with trace.span("mine", path=d_path, sharded=True):
+            with self.metrics.timed("preprocess", path=d_path) as m:
+                data = preprocess_file_sharded(
+                    d_path, self.config.min_support
+                )
+                m.update(
+                    n_raw=data.n_raw,
+                    min_count=data.min_count,
+                    num_items=data.num_items,
+                    local_count=data.total_count,
+                    global_count=data.shard.global_count,
+                )
+            return self.mine_levels_raw(data), data
 
     def mine_levels_raw(
         self, data: CompressedData

@@ -1,0 +1,19 @@
+"""Unified observability substrate (ISSUE 11 tentpole).
+
+Three pieces, deliberately stdlib-only (no jax import — the tracer must
+be importable from the lint-censused reliability layer and from tools):
+
+- :mod:`fastapriori_tpu.obs.trace` — nestable, thread-aware spans with
+  deterministic ids, exported as Chrome-trace-event JSON (Perfetto-
+  loadable); near-zero cost when disabled.
+- :mod:`fastapriori_tpu.obs.metrics` — allocation-free fixed-bucket
+  histograms + counters/gauges with a Prometheus-text snapshot: the
+  serving tier's scrapeable registry.
+- :mod:`fastapriori_tpu.obs.flight` — a bounded ring of the last N
+  span/ledger/watchdog events, dumped to a manifest-committed artifact
+  on classified errors, ``AbandonedThreadCap``, and chaos-soak hangs.
+"""
+
+from fastapriori_tpu.obs import flight, metrics, trace  # noqa: F401
+from fastapriori_tpu.obs.metrics import MetricsRegistry  # noqa: F401
+from fastapriori_tpu.obs.trace import TRACER, span  # noqa: F401

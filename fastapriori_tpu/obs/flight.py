@@ -1,0 +1,167 @@
+"""Crash flight recorder: the last N observability events, dumped on
+failure (ISSUE 11 tentpole, part c).
+
+A watchdog trip, an ``AbandonedThreadCap``, a chaos-soak hang — by the
+time these surface, the interesting part (what the process was doing
+right before) is gone from every log that only aggregates.  The flight
+recorder keeps a bounded ring of the most recent span / ledger /
+watchdog events (always on — appends are a lock + deque append, spans
+enter only while tracing is enabled) and dumps it to a
+manifest-committed JSON artifact when something dies:
+
+- the degradation ledger forwards every event here (watchdog timeouts,
+  cascade walks, retries included);
+- the tracer appends each completed span while enabled;
+- :func:`auto_dump` fires on ``AbandonedThreadCap``
+  (reliability/watchdog.py) against the prefix the CLI registered, and
+  ``tools/chaos.py`` dumps explicitly on FAIL/hang scenarios — so a
+  chaos failure ships its own post-mortem.
+
+``FA_FLIGHT_RECORDER_N`` sizes the ring (strict; 0 disables).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+FLIGHT_NAME = "flight.json"
+
+
+def ring_size() -> int:
+    """``FA_FLIGHT_RECORDER_N``: ring capacity in events (strictly
+    parsed; default 256, 0 disables recording).  Read once at recorder
+    construction; tests use :func:`reload_from_env`."""
+    from fastapriori_tpu.utils.env import env_int
+
+    return env_int("FA_FLIGHT_RECORDER_N", 256, minimum=0)
+
+
+class FlightRecorder:
+    """Bounded ring (module docstring).  ``seq`` is a monotone event
+    number, so a dump shows exactly how many events the ring dropped
+    and overwrite order is testable."""
+
+    def __init__(self, cap: Optional[int] = None):
+        self._cap = ring_size() if cap is None else cap
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self._cap or 1)
+        self._seq = 0
+        self._t0 = time.monotonic()
+        self._dump_prefix: Optional[str] = None
+        self.dumps = 0
+
+    @property
+    def cap(self) -> int:
+        return self._cap
+
+    def note(self, kind: str, **fields: Any) -> None:
+        if not self._cap:
+            return
+        with self._lock:
+            self._seq += 1
+            self._ring.append(
+                {
+                    "seq": self._seq,
+                    "t_s": round(time.monotonic() - self._t0, 6),
+                    "kind": kind,
+                    **fields,
+                }
+            )
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def set_dump_prefix(self, prefix: Optional[str]) -> None:
+        """Register where :func:`auto_dump` writes — the CLI sets its
+        output prefix here, so reliability-layer triggers (which have
+        no path in scope) can still ship the post-mortem."""
+        self._dump_prefix = prefix
+
+    def dump(
+        self,
+        prefix: str,
+        reason: str,
+        extra: Optional[dict] = None,
+    ) -> str:
+        """Write ``<prefix>flight.json`` through the crash-safe
+        committer + run manifest: the ring snapshot, the trigger
+        reason, and the drop accounting (``first_seq``>1 means the ring
+        wrapped).  Returns the artifact path."""
+        from fastapriori_tpu.io.writer import write_artifact_bytes, write_manifest
+
+        events = self.snapshot()
+        body = {
+            "version": 1,
+            "reason": reason,
+            "ring_capacity": self._cap,
+            "total_events": self._seq,
+            "first_seq": events[0]["seq"] if events else None,
+            "events": events,
+        }
+        if extra:
+            body["context"] = extra
+        manifest: Dict[str, dict] = {}
+        path = write_artifact_bytes(
+            prefix + FLIGHT_NAME,
+            [(json.dumps(body, indent=1) + "\n").encode("utf-8")],
+            FLIGHT_NAME,
+            manifest,
+        )
+        write_manifest(prefix, manifest)
+        self.dumps += 1
+        return path
+
+    def auto_dump(self, reason: str, extra: Optional[dict] = None) -> Optional[str]:
+        """Dump against the registered prefix; None (recorded, not
+        written) when no prefix was registered — never an error on the
+        failure path it instruments."""
+        if self._dump_prefix is None:
+            return None
+        try:
+            return self.dump(self._dump_prefix, reason, extra)
+        # The recorder rides error paths (AbandonedThreadCap, chaos
+        # hangs): a failing dump must never mask the original failure.
+        # lint: waive G006 G009 -- best-effort post-mortem on an already-failing path; the committer handles atomicity
+        except Exception:
+            return None
+
+    def reset(self, cap: Optional[int] = None) -> None:
+        with self._lock:
+            self._cap = ring_size() if cap is None else cap
+            self._ring = deque(maxlen=self._cap or 1)
+            self._seq = 0
+            self._t0 = time.monotonic()
+            self.dumps = 0
+
+
+RECORDER = FlightRecorder()
+
+
+def note(kind: str, **fields: Any) -> None:
+    RECORDER.note(kind, **fields)
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    return RECORDER.snapshot()
+
+
+def dump(prefix: str, reason: str, extra: Optional[dict] = None) -> str:
+    return RECORDER.dump(prefix, reason, extra)
+
+
+def auto_dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
+    return RECORDER.auto_dump(reason, extra)
+
+
+def set_dump_prefix(prefix: Optional[str]) -> None:
+    RECORDER.set_dump_prefix(prefix)
+
+
+def reload_from_env() -> None:
+    """Re-read FA_FLIGHT_RECORDER_N and rebuild the ring (tests)."""
+    RECORDER.reset()

@@ -1,0 +1,465 @@
+"""Span tracer: nestable, thread-aware, deterministic-id spans with
+Chrome-trace-event export (ISSUE 11 tentpole, part a).
+
+The profiling-before-optimizing discipline of arxiv 1309.0215 needs a
+timeline, not aggregate walls: PR 10's "dedup wall" (sustained 7.2K rps
+vs 10.8K closed-batch) is *inferred*; a trace showing when host work
+(admission/dedup/pack) blocks the device scan *measures* it.  The
+tracer threads through the mining level loop, the fused segments, rule
+generation, every audited fetch (reliability/retry.py) and the serving
+dispatcher, and exports the Perfetto-loadable Chrome trace-event JSON
+(``mine --trace out.trace.json``).
+
+Contracts:
+
+- **Near-zero cost when disabled** (the default): ``span()`` is one
+  attribute read + one branch returning a shared no-op context manager
+  — no allocation, no clock read (test-pinned; the serve bench's
+  no-obs control bounds the end-to-end overhead < 2%).
+- **Deterministic ids**: a span's id is its path — parent id, name,
+  and per-parent occurrence index (``main:mine#0/level#3``), NOT a
+  global counter that interleaves across threads — so two identical
+  seeded runs produce identical span trees modulo timestamps
+  (test-pinned).  Root spans are keyed by thread name (deterministic
+  here: ``MainThread``, ``fa-serve-dispatch``, ``fa-watchdog:<site>``).
+- **Thread-aware**: each thread nests under its own root; export maps
+  threads to stable small tids with ``thread_name`` metadata events.
+- **Bounded**: past ``max_events`` new events are counted as dropped,
+  never grown unboundedly (the MetricsLogger.records lesson).
+
+Enable via the CLI ``--trace PATH`` flags or the strict ``FA_TRACE``
+knob (spans recorded process-wide; export still needs a path).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# G014 span-census declaration: every audited fetch site label
+# (tools/lint/inventory.json FETCH census) receives a span scope through
+# reliability/retry.py's central instrumentation.  The site strings are
+# built dynamically there ("fetch." + site), so this literal census IS
+# the statically-checkable coverage claim: graftlint G014 fails when a
+# fetch site is added without a declaration here (or a declaration goes
+# stale), and tests/test_obs.py pins that a declared site really
+# produces a span when traced.
+FETCH_SITE_SPANS = (
+    "fetch.counts_resolve",
+    "fetch.fused",
+    "fetch.level_bits",
+    "fetch.level_bits_sparse",
+    "fetch.level_counts",
+    "fetch.local_rows",
+    "fetch.pair",
+    "fetch.pair_pre",
+    "fetch.pair_regather",
+    "fetch.pair_sparse",
+    "fetch.rec_match",
+    "fetch.rule_mask",
+    "fetch.rule_mask_shard",
+    "fetch.serve_match",
+    "fetch.tail",
+    "fetch.vlevel_bits",
+    "fetch.vlevel_bits_sparse",
+    "fetch.vpair",
+    "fetch.vpair_sparse",
+)
+
+
+class _NoopSpan:
+    """The disabled-path context manager: one shared instance, no state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def update(self, **args: Any) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "sid", "parent_sid", "t0", "args", "_children")
+
+    def __init__(self, name: str, sid: str, parent_sid: Optional[str]):
+        self.name = name
+        self.sid = sid
+        self.parent_sid = parent_sid
+        self.t0 = 0.0
+        self.args: Dict[str, Any] = {}
+        self._children: Dict[str, int] = {}
+
+    def child_sid(self, name: str) -> str:
+        idx = self._children.get(name, 0)
+        self._children[name] = idx + 1
+        return f"{self.sid}/{name}#{idx}"
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_name", "_args", "_span")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict[str, Any]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+        self._span: Optional[_Span] = None
+
+    def __enter__(self):
+        self._span = self._tracer._push(self._name, self._args)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None and self._span is not None:
+            self._span.args.setdefault("error", exc_type.__name__)
+        self._tracer._pop(self._span)
+        return False
+
+    def update(self, **args: Any) -> None:
+        """Attach attributes to this span (visible in the exported
+        trace's ``args``)."""
+        if self._span is not None:
+            self._span.args.update(args)
+
+
+class Tracer:
+    """Process-wide span collector (module docstring).  A singleton like
+    the degradation ledger: the sites that trace (retry wrappers, ops
+    dispatch points) have no config in scope."""
+
+    def __init__(self, max_events: int = 200_000):
+        self.enabled = False
+        self.max_events = max_events
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self.dropped = 0
+        self._tls = threading.local()
+        self._epoch = time.perf_counter()
+        self._thread_ids: Dict[str, int] = {}
+
+    # -- lifecycle ------------------------------------------------------
+    def enable(self) -> "Tracer":
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+            self._thread_ids.clear()
+        # Fresh thread-local stacks AND root occurrence counters, so two
+        # enable()+identical-run cycles produce identical span ids (the
+        # determinism contract).
+        self._tls = threading.local()
+        self._epoch = time.perf_counter()
+        self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+            self._thread_ids.clear()
+        self._tls = threading.local()
+        self._epoch = time.perf_counter()
+
+    # -- thread-local span stack ---------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+            self._tls.root_counts = {}
+        return stack
+
+    def _thread_key(self) -> str:
+        name = threading.current_thread().name
+        return "main" if name == "MainThread" else name
+
+    def _tid(self, key: str) -> int:
+        with self._lock:
+            tid = self._thread_ids.get(key)
+            if tid is None:
+                tid = len(self._thread_ids) + 1
+                self._thread_ids[key] = tid
+        return tid
+
+    def _push(self, name: str, args: Dict[str, Any]) -> _Span:
+        stack = self._stack()
+        if stack:
+            sid = stack[-1].child_sid(name)
+            parent = stack[-1].sid
+        else:
+            counts = self._tls.root_counts
+            idx = counts.get(name, 0)
+            counts[name] = idx + 1
+            sid = f"{self._thread_key()}:{name}#{idx}"
+            parent = None
+        span = _Span(name, sid, parent)
+        span.args.update(args)
+        span.t0 = time.perf_counter()
+        stack.append(span)
+        return span
+
+    def _pop(self, span: Optional[_Span]) -> None:
+        t1 = time.perf_counter()
+        stack = self._stack()
+        # Pop down TO the span (an unbalanced inner exit never corrupts
+        # outer spans; stranded frames close with their parent).
+        while stack:
+            top = stack.pop()
+            if top is span:
+                break
+        if span is None:
+            return
+        self._record(
+            {
+                "ph": "X",
+                "name": span.name,
+                "sid": span.sid,
+                "parent": span.parent_sid,
+                "ts_us": (span.t0 - self._epoch) * 1e6,
+                "dur_us": (t1 - span.t0) * 1e6,
+                "thread": self._thread_key(),
+                "args": span.args,
+            }
+        )
+        from fastapriori_tpu.obs import flight
+
+        flight.note(
+            "span", name=span.name, sid=span.sid,
+            dur_ms=round((t1 - span.t0) * 1e3, 3), **span.args,
+        )
+
+    def _record(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(event)
+
+    # -- public emit API ------------------------------------------------
+    def span(self, name: str, **args: Any):
+        """Open a nested span (context manager).  Disabled: one branch,
+        the shared no-op instance — near-zero cost."""
+        if not self.enabled:
+            return _NOOP
+        return _SpanCtx(self, name, args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """A point-in-time event under the current span scope."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        self._record(
+            {
+                "ph": "i",
+                "name": name,
+                "sid": None,
+                "parent": stack[-1].sid if stack else None,
+                "ts_us": (time.perf_counter() - self._epoch) * 1e6,
+                "thread": self._thread_key(),
+                "args": args,
+            }
+        )
+
+    def counter(self, name: str, **values: Any) -> None:
+        """A Chrome counter event (rendered as a track in Perfetto) —
+        collective bytes, queue depth, shed counts."""
+        if not self.enabled:
+            return
+        self._record(
+            {
+                "ph": "C",
+                "name": name,
+                "sid": None,
+                "parent": None,
+                "ts_us": (time.perf_counter() - self._epoch) * 1e6,
+                "thread": self._thread_key(),
+                "args": values,
+            }
+        )
+
+    def annotate(self, **args: Any) -> None:
+        """Attach attributes to the CURRENT innermost span (retry
+        counts, watchdog trips — the annotation form the reliability
+        layer uses where it has no span handle)."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        if stack:
+            stack[-1].args.update(args)
+
+    # -- inspection / export -------------------------------------------
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def span_tree(self) -> List[tuple]:
+        """The deterministic structure: sorted ``(sid, name, parent)``
+        for every completed span — two identical seeded runs produce
+        equal trees (timestamps excluded by construction)."""
+        with self._lock:
+            return sorted(
+                (e["sid"], e["name"], e["parent"])
+                for e in self._events
+                if e["ph"] == "X"
+            )
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The export form: Chrome trace-event JSON (Perfetto loads it
+        directly).  Threads map to stable small tids in first-span
+        order, named via ``thread_name`` metadata events."""
+        events = self.events()
+        out: List[Dict[str, Any]] = [
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": 1,
+                "tid": 0,
+                "args": {"name": "fastapriori_tpu"},
+            }
+        ]
+        threads: Dict[str, int] = {}
+        for e in events:
+            key = e["thread"]
+            if key not in threads:
+                threads[key] = len(threads) + 1
+                out.append(
+                    {
+                        "ph": "M",
+                        "name": "thread_name",
+                        "pid": 1,
+                        "tid": threads[key],
+                        "args": {"name": key},
+                    }
+                )
+        for e in events:
+            ev: Dict[str, Any] = {
+                "ph": e["ph"],
+                "name": e["name"],
+                "cat": e["name"].split(".")[0].split(":")[0],
+                "pid": 1,
+                "tid": threads[e["thread"]],
+                "ts": round(e["ts_us"], 3),
+                "args": dict(e["args"]),
+            }
+            if e["ph"] == "X":
+                ev["dur"] = round(e["dur_us"], 3)
+                ev["args"]["sid"] = e["sid"]
+            if e["ph"] == "i":
+                ev["s"] = "t"
+            out.append(ev)
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": self.dropped},
+        }
+
+    def export(self, path: str, manifest: Optional[dict] = None) -> str:
+        """Write the Chrome trace JSON through the crash-safe committer
+        (atomic tmp+fsync+rename; ``write.trace`` failpoint site), so a
+        killed export never leaves a torn trace under the final name."""
+        from fastapriori_tpu.io.writer import write_artifact_bytes
+
+        body = json.dumps(self.chrome_trace()) + "\n"
+        return write_artifact_bytes(
+            path, [body.encode("utf-8")], "trace", manifest
+        )
+
+
+def validate_chrome_trace(obj) -> List[str]:
+    """Schema problems in a Chrome-trace-event JSON object (empty list =
+    Perfetto-loadable shape).  Shared by tests/test_obs.py and
+    tools/obs_smoke.py so the artifact contract is checked by ONE
+    definition."""
+    problems: List[str] = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["top level must be an object with a traceEvents array"]
+    events = obj["traceEvents"]
+    if not isinstance(events, list) or not events:
+        return ["traceEvents must be a non-empty array"]
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        ph = e.get("ph")
+        if ph not in ("X", "i", "C", "M"):
+            problems.append(f"event {i}: unknown ph {ph!r}")
+            continue
+        if not isinstance(e.get("name"), str) or not e["name"]:
+            problems.append(f"event {i}: missing name")
+        if not isinstance(e.get("pid"), int) or not isinstance(
+            e.get("tid"), int
+        ):
+            problems.append(f"event {i}: pid/tid must be ints")
+        if ph != "M":
+            ts = e.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                problems.append(f"event {i}: bad ts {ts!r}")
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i}: bad dur {dur!r}")
+            if not isinstance(
+                e.get("args", {}).get("sid"), str
+            ):
+                problems.append(f"event {i}: span missing sid")
+        if "args" in e and not isinstance(e["args"], dict):
+            problems.append(f"event {i}: args must be an object")
+    return problems
+
+
+TRACER = Tracer()
+
+
+def span(name: str, **args: Any):
+    return TRACER.span(name, **args)
+
+
+def instant(name: str, **args: Any) -> None:
+    TRACER.instant(name, **args)
+
+
+def counter(name: str, **values: Any) -> None:
+    TRACER.counter(name, **values)
+
+
+def annotate(**args: Any) -> None:
+    TRACER.annotate(**args)
+
+
+_env_memo: Optional[bool] = None
+
+
+def enabled_by_env() -> bool:
+    """The strict ``FA_TRACE`` knob: ``1`` enables span recording
+    process-wide (the CLI ``--trace PATH`` flags additionally export);
+    a typo'd value raises InputError — the FA_NO_PALLAS contract.
+    Parsed once per process; tests use :func:`reload_from_env`."""
+    global _env_memo
+    if _env_memo is None:
+        from fastapriori_tpu.utils.env import env_flag
+
+        _env_memo = env_flag("FA_TRACE", False)
+    return _env_memo
+
+
+def reload_from_env() -> None:
+    global _env_memo
+    _env_memo = None
+
+
+def maybe_enable(explicit: bool = False) -> bool:
+    """Enable the global tracer when ``explicit`` (a ``--trace`` flag)
+    or ``FA_TRACE`` asks for it; returns the resulting enabled state."""
+    if explicit or enabled_by_env():
+        TRACER.enable()
+    return TRACER.enabled

@@ -53,6 +53,17 @@ class DegradationLedger:
             if first:
                 self._warned.add(warn_key)
             metrics = self._metrics
+        # Every ledger event also enters the crash flight recorder's
+        # bounded ring (obs/flight.py) — watchdog trips, cascade walks
+        # and retries are exactly the "what happened right before"
+        # evidence a post-mortem dump needs.
+        from fastapriori_tpu.obs import flight, trace
+
+        flight.note("ledger", **{"event": kind, **fields})
+        # And into the span tracer as an instant event under whatever
+        # span is active — a retry or cascade walk shows up ON the
+        # timeline it degraded, not just in the aggregate summary.
+        trace.instant("degraded", **{"kind": kind, **fields})
         if metrics is not None:
             metrics.emit("degraded", **event)
         if first:

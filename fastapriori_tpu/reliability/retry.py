@@ -200,26 +200,43 @@ def call_with_retries(
     bound and surfaces as a transient ``DEADLINE_EXCEEDED`` — retried
     like any other flap, so a wedged link can stall the pipeline for at
     most attempts × timeout instead of forever."""
+    from fastapriori_tpu.obs import metrics as obs_metrics
+    from fastapriori_tpu.obs import trace
     from fastapriori_tpu.reliability import ledger, watchdog
 
     policy = policy or policy_from_env()
     attempt = 0
-    while True:
-        try:
-            failpoints.fire(site)
-            return watchdog.guard(thunk, site)
-        except Exception as exc:
-            kind = classify(exc)
-            if kind != "transient" or attempt >= policy.max_attempts - 1:
-                raise
-            ledger.record(
-                "retry",
-                site=site,
-                attempt=attempt + 1,
-                error=f"{type(exc).__name__}: {exc}"[:200],
-            )
-            sleep(policy.delay(attempt))
-            attempt += 1
+    # Every audited call is a span (ISSUE 11): the site label names it,
+    # retries/timeouts land as annotations + instant events under it,
+    # and fetch sites feed the per-site latency histograms the serving
+    # registry snapshot exposes.  Disabled tracing costs one branch.
+    with trace.span(site) as sp:
+        t0 = time.perf_counter()
+        while True:
+            try:
+                failpoints.fire(site)
+                result = watchdog.guard(thunk, site)
+                if site.startswith("fetch."):
+                    obs_metrics.fetch_latency_observe(
+                        site[6:], (time.perf_counter() - t0) * 1e3
+                    )
+                return result
+            except Exception as exc:
+                kind = classify(exc)
+                if kind != "transient" or attempt >= policy.max_attempts - 1:
+                    sp.update(
+                        failed=f"{type(exc).__name__}", attempts=attempt + 1
+                    )
+                    raise
+                ledger.record(
+                    "retry",
+                    site=site,
+                    attempt=attempt + 1,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+                sp.update(retries=attempt + 1)
+                sleep(policy.delay(attempt))
+                attempt += 1
 
 
 def fetch(

@@ -261,7 +261,16 @@ def guard(
             # Past the cap the leak itself is the failure: a retry would
             # strand thread cap+2 against the same wedged runtime.  No
             # transient status in the message — classify() must see a
-            # fatal (test-pinned).
+            # fatal (test-pinned).  This is a process-is-down verdict,
+            # so ship the post-mortem: the flight recorder dumps its
+            # ring (the trips/retries that led here) against the
+            # CLI-registered prefix before the fatal raises.
+            from fastapriori_tpu.obs import flight
+
+            flight.auto_dump(
+                "abandoned_thread_cap",
+                extra={"site": site, "abandoned_live": live, "cap": cap},
+            )
             raise AbandonedThreadCap(
                 f"dispatch watchdog: {live} abandoned fetch threads "
                 f"still live after abandoning {site!r} — past the "

@@ -29,6 +29,7 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 import numpy as np
 
 from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.obs import trace
 from fastapriori_tpu.ops.bitmap import next_pow2 as _next_pow2
 from fastapriori_tpu.reliability import ledger, retry, watchdog
 
@@ -658,17 +659,23 @@ def _rule_arrays_device(
             fn = ctx.rule_level_join_sharded(k, bits, first)
         else:
             fn = ctx.rule_level_join(k, bits, first)
-        out = fn(
-            mat_dev,
-            cnts_dev,
-            jnp.int32(n),
-            psorted,
-            porder,
-            pcnts_dev,
-            jnp.int32(np_real),
-            prev_surv,
-            prev_d,
-        )
+        # Per-level join span (ISSUE 11): nests under the recommender's
+        # gen_rules span; the overlapped rule_mask[_shard] fetches show
+        # as their own audited-fetch spans when consumed below.
+        with trace.span(
+            "rules.level", k=k, n=n, shards=shards if sharded else 1
+        ):
+            out = fn(
+                mat_dev,
+                cnts_dev,
+                jnp.int32(n),
+                psorted,
+                porder,
+                pcnts_dev,
+                jnp.int32(np_real),
+                prev_surv,
+                prev_d,
+            )
         if sharded:
             packed, skeys, order, d_flat, surv_flat, mat_full, cnts_full = (
                 out

@@ -44,8 +44,15 @@ from collections import deque
 from typing import Optional, Sequence
 
 from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.obs import metrics as obs_metrics
+from fastapriori_tpu.obs import trace
+from fastapriori_tpu.obs.metrics import MetricsRegistry
 from fastapriori_tpu.reliability import ledger, watchdog
 from fastapriori_tpu.serve.state import ServingState
+
+# Batch-fill histogram bounds: pow2 rows up to the largest bucketed
+# micro-batch (models/recommender.py bucket_batch_rows ceiling is 4096).
+_FILL_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 class ServeRequest:
@@ -78,12 +85,13 @@ class ServeRequest:
 
 
 class _SwapMarker:
-    __slots__ = ("state", "release_old", "event")
+    __slots__ = ("state", "release_old", "event", "t_enq")
 
     def __init__(self, state: ServingState, release_old: bool):
         self.state = state
         self.release_old = release_old
         self.event = threading.Event()
+        self.t_enq = time.monotonic()
 
 
 class RecommendServer:
@@ -93,6 +101,7 @@ class RecommendServer:
         batch_rows: Optional[int] = None,
         linger_ms: Optional[float] = None,
         queue_depth: Optional[int] = None,
+        metrics: bool = True,
     ):
         from fastapriori_tpu.models.recommender import bucket_batch_rows
 
@@ -123,6 +132,48 @@ class RecommendServer:
         self._swaps = 0
         self._max_depth = 0
         self._scan_wall_s = 0.0
+        # Live serving metrics registry (ISSUE 11): fixed-bucket
+        # histograms + counters/gauges updated on the hot path,
+        # scrapeable MID-RUN through metrics_text() and the periodic
+        # `serve --metrics-dump` snapshots.  ``metrics=False`` is the
+        # no-obs control the serve bench uses to bound the
+        # instrumentation overhead (< 2% acceptance).
+        self._obs = metrics
+        self.registry = MetricsRegistry()
+        reg = self.registry
+        self._m_submitted = reg.counter(
+            "fa_serve_submitted_total", "requests submitted"
+        )
+        self._m_served = reg.counter(
+            "fa_serve_served_total", "requests answered by a scan batch"
+        )
+        self._m_shed = reg.counter(
+            "fa_serve_shed_total", "requests shed by admission control"
+        )
+        self._m_errors = reg.counter(
+            "fa_serve_errors_total", "batches answered '0' on a fatal error"
+        )
+        self._m_swaps = reg.counter(
+            "fa_serve_swaps_total", "hot-swap barriers committed"
+        )
+        self._m_queue = reg.gauge(
+            "fa_serve_queue_depth", "admission queue depth (and peak)"
+        )
+        self._m_fill = reg.histogram(
+            "fa_serve_batch_fill", _FILL_BUCKETS,
+            "rows per dispatched micro-batch",
+        )
+        self._m_linger = reg.histogram(
+            "fa_serve_linger_ms",
+            help="first-request wait from enqueue to batch dispatch",
+        )
+        self._m_batch_ms = reg.histogram(
+            "fa_serve_batch_ms", help="per-batch serve wall (scan incl.)"
+        )
+        self._m_swap_ms = reg.histogram(
+            "fa_serve_swap_barrier_ms",
+            help="swap-marker wait from enqueue to barrier commit",
+        )
 
     # -- lifecycle ------------------------------------------------------
     def start(self, warm: bool = True) -> "RecommendServer":
@@ -183,12 +234,16 @@ class RecommendServer:
         req = ServeRequest(tokens, t_sched, now)
         with self._cond:
             self._submitted += 1
+            if self._obs:
+                self._m_submitted.inc()
             if not self._running or len(self._q) >= self._depth:
                 return self._shed_locked(req, now)
             if self._shedding:
                 self._shedding = False  # overload episode over
             self._q.append(req)
             depth = len(self._q)
+            if self._obs:
+                self._m_queue.set(depth)
             if depth > self._max_depth:
                 self._max_depth = depth
             self._cond.notify_all()
@@ -208,6 +263,8 @@ class RecommendServer:
         req = ServeRequest(tokens, t_sched, now)
         with self._cond:
             self._submitted += 1
+            if self._obs:
+                self._m_submitted.inc()
             while self._running and len(self._q) >= self._depth:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -220,6 +277,8 @@ class RecommendServer:
             req.t_enq = time.monotonic()
             self._q.append(req)
             depth = len(self._q)
+            if self._obs:
+                self._m_queue.set(depth)
             if depth > self._max_depth:
                 self._max_depth = depth
             self._cond.notify_all()
@@ -234,6 +293,8 @@ class RecommendServer:
         req.shed = True
         req.t_done = now
         self._shed += 1
+        if self._obs:
+            self._m_shed.inc()
         if not self._shedding:
             self._shedding = True
             watchdog.downgrade(
@@ -327,6 +388,11 @@ class RecommendServer:
                 marker.state.set_batch_rows(self._batch_rows)
                 self._state = marker.state
                 self._swaps += 1
+                if self._obs:
+                    self._m_swaps.inc()
+                    self._m_swap_ms.observe(
+                        (time.monotonic() - marker.t_enq) * 1e3
+                    )
                 ledger.record(
                     "serve_swap",
                     once_key=marker.state.signature,
@@ -341,39 +407,86 @@ class RecommendServer:
                     self._cond.notify_all()
                 continue
             t0 = time.monotonic()
-            try:
-                items = self._state.recommend_batch(
-                    [r.tokens for r in batch]
+            # The per-batch span is the serving trace's unit of work:
+            # its children (serve.dedup / serve.pack / serve.scan,
+            # opened inside recommend_batch) separate host time from
+            # device time, the admission wait rides as an annotation,
+            # and the queue/shed counter track samples at batch rate.
+            with trace.span("serve.batch", rows=len(batch)) as sp:
+                sp.update(
+                    admission_wait_ms=round(
+                        (t0 - batch[0].t_enq) * 1e3, 3
+                    )
                 )
-            # The dispatcher must survive anything recommend_batch
-            # raises past its own cascade (a fatal error serves "0" to
-            # THIS batch, classified on the ledger; the next batch gets
-            # a fresh attempt) — a dead dispatcher would hang every
-            # later waiter, the one outcome the serving tier forbids.
-            # lint: waive G006 -- answered "0" + ledger serve_error; next batch retries
-            except Exception as exc:
-                ledger.record(
-                    "serve_error",
-                    once_key=type(exc).__name__,
-                    error=f"{type(exc).__name__}: {exc}"[:200],
-                    rows=len(batch),
-                )
-                items = ["0"] * len(batch)
-            now = time.monotonic()
-            sig = self._state.signature
-            with self._cond:
-                for r, item in zip(batch, items):
-                    r.item = item
-                    r.model = sig
-                    r.t_done = now
-                self._served += len(batch)
-                self._batches += 1
-                self._batch_rows_served += len(batch)
-                self._scan_wall_s += now - t0
-                self._in_flight -= len(batch)
-                self._cond.notify_all()
+                try:
+                    items = self._state.recommend_batch(
+                        [r.tokens for r in batch]
+                    )
+                # The dispatcher must survive anything recommend_batch
+                # raises past its own cascade (a fatal error serves "0" to
+                # THIS batch, classified on the ledger; the next batch gets
+                # a fresh attempt) — a dead dispatcher would hang every
+                # later waiter, the one outcome the serving tier forbids.
+                # lint: waive G006 -- answered "0" + ledger serve_error; next batch retries
+                except Exception as exc:
+                    ledger.record(
+                        "serve_error",
+                        once_key=type(exc).__name__,
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                        rows=len(batch),
+                    )
+                    items = ["0"] * len(batch)
+                    if self._obs:
+                        self._m_errors.inc()
+                now = time.monotonic()
+                sig = self._state.signature
+                with trace.span("serve.respond", rows=len(batch)):
+                    with self._cond:
+                        for r, item in zip(batch, items):
+                            r.item = item
+                            r.model = sig
+                            r.t_done = now
+                        self._served += len(batch)
+                        self._batches += 1
+                        self._batch_rows_served += len(batch)
+                        self._scan_wall_s += now - t0
+                        self._in_flight -= len(batch)
+                        depth = len(self._q)
+                        shed = self._shed
+                        # Registry updates BEFORE the waiters wake: a
+                        # scrape racing wait_for() must never see the
+                        # last batch missing from the instruments (the
+                        # bench cross-check compares them to loadgen's
+                        # own counts; cheap int adds under the lock).
+                        if self._obs:
+                            self._m_served.inc(len(batch))
+                            self._m_fill.observe(len(batch))
+                            self._m_linger.observe(
+                                (t0 - batch[0].t_enq) * 1e3
+                            )
+                            self._m_batch_ms.observe((now - t0) * 1e3)
+                            self._m_queue.set(depth)
+                        self._cond.notify_all()
+                trace.counter("serve_queue", depth=depth, shed=shed)
 
     # -- observability --------------------------------------------------
+    def metrics_text(self) -> str:
+        """The scrapeable Prometheus-text snapshot (ISSUE 11): this
+        server's registry plus the process-global instruments (per-site
+        audited-fetch latency).  Safe to call mid-run from any thread —
+        instruments are single-writer ints; a torn read costs one
+        sample, never a crash."""
+        return self.registry.render() + obs_metrics.GLOBAL.render()
+
+    def metrics_snapshot(self) -> dict:
+        """Structured form of :meth:`metrics_text` for records/tests:
+        the bench's per-scenario snapshot cross-checks these against
+        the load generator's own shed/queue counts."""
+        return {
+            "server": self.registry.snapshot(),
+            "global": obs_metrics.GLOBAL.snapshot(),
+        }
+
     def reset_max_queue(self) -> int:
         """Reset the queue-depth peak to the CURRENT depth and return
         the old peak — run_open_loop calls it at scenario start so each

@@ -49,6 +49,7 @@ from fastapriori_tpu.config import MinerConfig
 from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.io.reader import _open_bytes
 from fastapriori_tpu.io.writer import write_artifact_bytes, write_manifest
+from fastapriori_tpu.obs import trace
 from fastapriori_tpu.ops.bitmap import build_bitmap, pad_axis
 from fastapriori_tpu.preprocess import dedup_user_baskets
 from fastapriori_tpu.reliability import failpoints, ledger, retry, watchdog
@@ -371,26 +372,32 @@ class ServingState:
         rows = pad_axis(mb, h.row_multiple) if h.row_multiple > 1 else mb
         cons_out = np.full(len(baskets), -1, dtype=np.int64)
         fetches = []
-        for b0 in range(0, len(baskets), mb):
-            block = baskets[b0 : b0 + mb]
-            bm = build_bitmap(block, h.f, rows, cfg.item_tile)
-            blen = np.zeros(rows, dtype=np.int32)
-            blen[: len(block)] = [len(b) for b in block]
-            best, cons, _chunks = h.scan(bm, blen)
-            arr = best if cons is None else jnp.stack([best, cons])
-            fetches.append(
-                (b0, len(block), retry.fetch_async(arr, "serve_match"))
-            )
-            self.scan_dispatches += 1
-            self.scan_rows += rows
-        for b0, n, fetch in fetches:
-            arr = fetch.result()
-            if h.decode is not None:
-                # lint: host-data -- arr is the already-fetched numpy result
-                ranks = np.asarray(arr[:n], dtype=np.int64)
-                cons_out[b0 : b0 + n] = h.decode(ranks)
-            else:
-                cons_out[b0 : b0 + n] = arr[1][:n]
+        # Trace split (ISSUE 11 acceptance): serve.pack is the HOST side
+        # (bitmap build + dispatch issue), serve.scan the DEVICE side
+        # (the audited result fetches — each an inner fetch.serve_match
+        # span) — a Perfetto timeline separates the two directly.
+        with trace.span("serve.pack", baskets=len(baskets)):
+            for b0 in range(0, len(baskets), mb):
+                block = baskets[b0 : b0 + mb]
+                bm = build_bitmap(block, h.f, rows, cfg.item_tile)
+                blen = np.zeros(rows, dtype=np.int32)
+                blen[: len(block)] = [len(b) for b in block]
+                best, cons, _chunks = h.scan(bm, blen)
+                arr = best if cons is None else jnp.stack([best, cons])
+                fetches.append(
+                    (b0, len(block), retry.fetch_async(arr, "serve_match"))
+                )
+                self.scan_dispatches += 1
+                self.scan_rows += rows
+        with trace.span("serve.scan", dispatches=len(fetches)):
+            for b0, n, fetch in fetches:
+                arr = fetch.result()
+                if h.decode is not None:
+                    # lint: host-data -- arr is the already-fetched numpy result
+                    ranks = np.asarray(arr[:n], dtype=np.int64)
+                    cons_out[b0 : b0 + n] = h.decode(ranks)
+                else:
+                    cons_out[b0 : b0 + n] = arr[1][:n]
         return cons_out
 
     def recommend_batch(self, lines: Sequence[Sequence[str]]) -> List[str]:
@@ -407,9 +414,11 @@ class ServingState:
                 "ServingState was released (hot-swapped out); build or "
                 "load a fresh state to serve"
             )
-        baskets, indexes, _empty = dedup_user_baskets(
-            lines, self.item_to_rank
-        )
+        with trace.span("serve.dedup", rows=len(lines)) as sp:
+            baskets, indexes, _empty = dedup_user_baskets(
+                lines, self.item_to_rank
+            )
+            sp.update(distinct=len(baskets))
         out = ["0"] * len(lines)
         if not baskets or not self.n_rules:
             return out
@@ -438,10 +447,11 @@ class ServingState:
                     self._rec._host_first_match(baskets), dtype=np.int64
                 )
         else:
-            # lint: host-data -- host-scan result list, no device fetch
-            cons = np.asarray(
-                self._rec._host_first_match(baskets), dtype=np.int64
-            )
+            with trace.span("serve.host_scan", baskets=len(baskets)):
+                # lint: host-data -- host-scan result list, no device fetch
+                cons = np.asarray(
+                    self._rec._host_first_match(baskets), dtype=np.int64
+                )
         for rows, c in zip(indexes, cons):
             if c >= 0:
                 item = self.freq_items[int(c)]

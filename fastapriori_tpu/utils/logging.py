@@ -4,7 +4,12 @@ The reference's entire observability surface is ``====``-prefixed wall-clock
 prints around phases and Apriori levels (Main.scala:28-37,
 FastApriori.scala:103-119, AssociationRules.scala:73-181 — SURVEY.md §5).
 Here the same events are emitted as structured JSON lines, plus the
-reference-style human line for familiarity.
+reference-style human line for familiarity — and (ISSUE 11) mirrored
+into the span tracer (``fastapriori_tpu/obs/trace.py``): every
+``timed`` section opens a span, every ``emit`` lands as an instant
+event, and the per-level collective-byte fields ride as Chrome counter
+events, so the JSON metrics stream and the Perfetto trace are two
+views of ONE event source.
 """
 
 from __future__ import annotations
@@ -13,7 +18,27 @@ import contextlib
 import json
 import sys
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
+
+from fastapriori_tpu.obs import trace
+
+# MetricsLogger.records retention cap (ISSUE 11 satellite): the list
+# fed bench's full-record path unboundedly — a long `serve` run grew it
+# forever.  The cap is deliberately far above any bench run's event
+# count (webdocs mines emit hundreds of records, not tens of
+# thousands), so the full-record path keeps working; past it, records
+# drop COUNTED (`records_dropped`), never silently.
+RECORDS_CAP = 100_000
+
+# The process's active logger (latest enabled instance wins — the same
+# latest-binding rule the degradation ledger uses): `phase_timer` and
+# other module-level emit sites route through it so phase walls land in
+# the metrics stream and the trace, not just on stderr.
+_active: Optional["MetricsLogger"] = None
+
+
+def active_logger() -> Optional["MetricsLogger"]:
+    return _active
 
 
 class MetricsLogger:
@@ -21,18 +46,38 @@ class MetricsLogger:
 
     Each record carries an ``event`` name plus arbitrary fields; records go
     to ``stream`` (default stderr) so stdout stays clean for data output.
+    Retention is bounded (:data:`RECORDS_CAP` + ``records_dropped``).
     """
 
-    def __init__(self, enabled: bool = True, stream=None):
+    def __init__(
+        self,
+        enabled: bool = True,
+        stream=None,
+        records_cap: int = RECORDS_CAP,
+    ):
+        global _active
         self.enabled = enabled
         self.stream = stream if stream is not None else sys.stderr
         self.records: list[Dict[str, Any]] = []
+        self.records_cap = records_cap
+        self.records_dropped = 0
+        if enabled:
+            _active = self
 
-    def emit(self, event: str, **fields: Any) -> None:
-        rec = {"event": event, **fields}
-        self.records.append(rec)
+    def _record(self, rec: Dict[str, Any]) -> None:
+        """The ONE retention + output path (bounded append, counted
+        drops, JSON line when enabled) — emit and timed share it so the
+        retention contract cannot diverge."""
+        if len(self.records) < self.records_cap:
+            self.records.append(rec)
+        else:
+            self.records_dropped += 1
         if self.enabled:
             print(json.dumps(rec), file=self.stream, flush=True)
+
+    def emit(self, event: str, **fields: Any) -> None:
+        trace.instant(event, **fields)
+        self._record({"event": event, **fields})
 
     def bind_global_ledger(self) -> "MetricsLogger":
         """Route degradation-ledger events (reliability/ledger.py) through
@@ -49,20 +94,52 @@ class MetricsLogger:
     @contextlib.contextmanager
     def timed(self, event: str, **fields: Any):
         t0 = time.perf_counter()
-        holder: Dict[str, Any] = {}
-        try:
-            yield holder
-        finally:
-            holder.setdefault("wall_ms", round((time.perf_counter() - t0) * 1e3, 3))
-            self.emit(event, **fields, **holder)
+        holder = _TimedHolder()
+        # One span per timed section: nesting comes from the tracer's
+        # thread-local stack (run -> phase -> level -> dispatch), ids
+        # stay deterministic (per-parent occurrence counting).  The
+        # record lands in a finally — a section that RAISES (a fetch
+        # exhausting retries, an injected abort) still leaves its
+        # partial fields in the metrics stream, same as pre-tracer.
+        with trace.span(event, **fields) as sp:
+            try:
+                yield holder
+            finally:
+                holder.setdefault(
+                    "wall_ms", round((time.perf_counter() - t0) * 1e3, 3)
+                )
+                sp.update(**holder)
+                if "psum_bytes" in holder or "gather_bytes" in holder:
+                    # Collective payloads as Chrome counter tracks — the
+                    # byte timeline the sparse-exchange analysis (arxiv
+                    # 1312.3020) sums per level today.
+                    trace.counter(
+                        "collective_bytes",
+                        psum=holder.get("psum_bytes", 0),
+                        gather=holder.get("gather_bytes", 0),
+                    )
+                self._record({"event": event, **fields, **holder})
+
+
+class _TimedHolder(dict):
+    """The mutable mapping ``timed`` yields; ``update``/``setdefault``
+    are dict's own."""
 
 
 @contextlib.contextmanager
-def phase_timer(label: str, enabled: bool = True):
-    """Reference-style ``==== Use Time <label> <ms>`` print
-    (e.g. FastApriori.scala:108)."""
+def phase_timer(label: str, enabled: bool = True, metrics=None):
+    """Reference-style ``==== Use Time <label> <ms>`` phase wall
+    (e.g. FastApriori.scala:108) — routed through the span tracer and
+    the active :class:`MetricsLogger` (ISSUE 11 satellite), so the
+    reference-style walls appear in traces and metrics streams, not
+    just as a bare stderr print.  ``metrics`` overrides the active
+    logger; the human line still prints when ``enabled``."""
     t0 = time.perf_counter()
-    yield
+    with trace.span("phase", label=label):
+        yield
+    ms = int((time.perf_counter() - t0) * 1e3)
+    logger = metrics if metrics is not None else _active
+    if logger is not None:
+        logger.emit("phase", label=label, wall_ms=ms)
     if enabled:
-        ms = int((time.perf_counter() - t0) * 1e3)
         print(f"==== Use Time {label} {ms}", file=sys.stderr)

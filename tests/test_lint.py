@@ -438,6 +438,43 @@ CASES = [
      "    v = retry.fetch(lambda: np.asarray(y), 'a')"
      "  # lint: waive G013 -- test waiver\n"
      "    return u, v\n"),
+    # -- G014: span-scope census (fetch labels <-> tracer declaration) --
+    ("G014", "flag", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "FETCH_SITE_SPANS = ('fetch.a',)\n"
+     "COVERAGE = ('fetch.a', 'fetch.unspanned')\n"
+     "def pull(x, y):\n"
+     "    u = retry.fetch(lambda: np.asarray(x), 'a')\n"
+     "    v = retry.fetch(lambda: np.asarray(y), 'unspanned')\n"
+     "    return u, v\n"),  # 'unspanned' not declared a span scope
+    ("G014", "flag", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "FETCH_SITE_SPANS = ('fetch.a', 'fetch.gone')\n"
+     "COVERAGE = ('fetch.a',)\n"
+     "def pull(x):\n"
+     "    return retry.fetch(lambda: np.asarray(x), 'a')\n"),
+    # ^ stale declaration: no fetch site 'gone' remains
+    ("G014", "pass", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "FETCH_SITE_SPANS = ('fetch.a', 'fetch.b')\n"
+     "COVERAGE = ('fetch.a', 'fetch.b')\n"
+     "def pull(x, y):\n"
+     "    u = retry.fetch(lambda: np.asarray(x), 'a')\n"
+     "    v = retry.fetch(lambda: np.asarray(y), 'b')\n"
+     "    return u, v\n"),  # census and declaration agree both ways
+    ("G014", "waived", "pkg/mod.py",
+     "import numpy as np\n"
+     "from fastapriori_tpu.reliability import retry\n"
+     "FETCH_SITE_SPANS = ('fetch.a',)\n"
+     "COVERAGE = ('fetch.a', 'fetch.b')\n"
+     "def pull(x, y):\n"
+     "    u = retry.fetch(lambda: np.asarray(x), 'a')\n"
+     "    v = retry.fetch(lambda: np.asarray(y), 'b')"
+     "  # lint: waive G014 -- test waiver\n"
+     "    return u, v\n"),
     # -- waiver-grammar edge cases (engine, pinned by ISSUE 5) ---------
     # (a) a waiver above a decorator attaches to the decorated line
     ("G003", "waived", "pkg/mod.py",
@@ -519,7 +556,7 @@ def test_every_rule_has_all_three_case_kinds():
 
 def test_all_rules_registered_and_distinct():
     ids = [r.id for r in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 13
+    assert len(ids) == len(set(ids)) == 14
     assert all(hasattr(r, "name") and r.name for r in ALL_RULES)
 
 

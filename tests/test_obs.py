@@ -1,0 +1,447 @@
+"""Observability substrate (ISSUE 11): span tracer determinism +
+schema, the serving metrics registry's bucket exactness, the flight
+recorder's ring semantics and failure dumps, the MetricsLogger
+retention bound, and phase_timer routing."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.obs import flight, metrics, trace
+from fastapriori_tpu.obs.flight import FlightRecorder
+from fastapriori_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from fastapriori_tpu.obs.trace import (
+    FETCH_SITE_SPANS,
+    TRACER,
+    Tracer,
+    validate_chrome_trace,
+)
+from fastapriori_tpu.preprocess import preprocess
+from fastapriori_tpu.reliability import failpoints, ledger, retry, watchdog
+from fastapriori_tpu.utils.logging import MetricsLogger, phase_timer
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    TRACER.disable()
+    TRACER.reset()
+    flight.RECORDER.reset()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+    TRACER.disable()
+    TRACER.reset()
+    flight.RECORDER.set_dump_prefix(None)
+    flight.RECORDER.reset()
+
+
+D_LINES = tokenized(random_dataset(31, n_txns=220, max_len=7))
+
+
+def _mine_traced():
+    TRACER.enable()
+    data = preprocess(D_LINES, 0.05)
+    cfg = MinerConfig(min_support=0.05, engine="level")
+    FastApriori(config=cfg).mine_levels_raw(data)
+    return TRACER.span_tree()
+
+
+# ---------------------------------------------------------------------------
+# tracer
+
+
+def test_tracer_deterministic_span_tree_across_identical_runs():
+    """Two identical seeded mines produce IDENTICAL span trees (ids,
+    names, parentage) — timestamps are the only run-to-run variance."""
+    t1 = _mine_traced()
+    t2 = _mine_traced()
+    assert t1, "traced mine recorded no spans"
+    assert t1 == t2
+
+
+def test_tracer_ids_count_per_parent_occurrence():
+    tr = Tracer()
+    tr.enable()
+    with tr.span("run"):
+        for _ in range(2):
+            with tr.span("level"):
+                with tr.span("fetch.x"):
+                    pass
+    tree = tr.span_tree()
+    sids = [s for s, _, _ in tree]
+    assert "main:run#0/level#0" in sids
+    assert "main:run#0/level#1" in sids
+    assert "main:run#0/level#0/fetch.x#0" in sids
+    # The second level's child restarts ITS OWN occurrence counter.
+    assert "main:run#0/level#1/fetch.x#0" in sids
+
+
+def test_tracer_thread_roots_are_thread_named():
+    tr = Tracer()
+    tr.enable()
+
+    def work():
+        with tr.span("batch"):
+            pass
+
+    t = threading.Thread(target=work, name="fa-serve-dispatch")
+    t.start()
+    t.join()
+    (sid, name, parent) = tr.span_tree()[0]
+    assert sid == "fa-serve-dispatch:batch#0"
+    assert parent is None
+
+
+def test_chrome_trace_schema_validates():
+    _mine_traced()
+    obj = TRACER.chrome_trace()
+    assert validate_chrome_trace(obj) == []
+    # Round-trips through JSON (the export form).
+    obj2 = json.loads(json.dumps(obj))
+    assert validate_chrome_trace(obj2) == []
+    phs = {e["ph"] for e in obj["traceEvents"]}
+    assert "X" in phs and "M" in phs
+
+
+def test_chrome_trace_schema_catches_malformed():
+    assert validate_chrome_trace({"traceEvents": []})
+    assert validate_chrome_trace({"notTraceEvents": 1})
+    bad = {"traceEvents": [{"ph": "X", "name": "a", "pid": 1, "tid": 1,
+                            "ts": -1, "dur": 1, "args": {"sid": "s"}}]}
+    assert any("ts" in p for p in validate_chrome_trace(bad))
+
+
+def test_tracer_export_is_committed_and_loadable(tmp_path):
+    _mine_traced()
+    path = TRACER.export(str(tmp_path / "out.trace.json"))
+    with open(path) as fh:
+        assert validate_chrome_trace(json.load(fh)) == []
+
+
+def test_tracer_disabled_records_nothing_and_is_cheap():
+    assert not TRACER.enabled
+    with trace.span("x", k=1):
+        trace.instant("y")
+        trace.counter("z", v=1)
+        trace.annotate(a=2)
+    assert TRACER.events() == []
+    t0 = time.perf_counter()
+    for _ in range(50_000):
+        with trace.span("x"):
+            pass
+    assert (time.perf_counter() - t0) / 50_000 < 10e-6
+
+
+def test_tracer_event_cap_counts_drops():
+    tr = Tracer(max_events=3)
+    tr.enable()
+    for _ in range(5):
+        with tr.span("s"):
+            pass
+    assert len(tr.events()) == 3
+    assert tr.dropped == 2
+    assert tr.chrome_trace()["otherData"]["dropped_events"] == 2
+
+
+def test_fetch_spans_cover_declared_sites():
+    """An audited fetch produces a span named fetch.<site>, and the
+    G014 census declaration stays truthful: every declared name has the
+    fetch. prefix shape the tracer emits."""
+    TRACER.enable()
+    arr = np.arange(4)
+    retry.fetch(lambda: np.asarray(arr), "serve_match")
+    names = {name for _, name, _ in TRACER.span_tree()}
+    assert "fetch.serve_match" in names
+    assert all(s.startswith("fetch.") for s in FETCH_SITE_SPANS)
+    assert "fetch.serve_match" in FETCH_SITE_SPANS
+
+
+def test_retry_annotations_land_on_fetch_span():
+    TRACER.enable()
+    failpoints.arm("fetch.serve_match", "oom*1")
+    arr = np.arange(4)
+    retry.fetch(lambda: np.asarray(arr), "serve_match")
+    spans = [e for e in TRACER.events() if e["ph"] == "X"]
+    (fetch_span,) = [e for e in spans if e["name"] == "fetch.serve_match"]
+    assert fetch_span["args"]["retries"] == 1
+    # The ledger's retry event also landed as an instant on the stream.
+    instants = [e for e in TRACER.events() if e["ph"] == "i"]
+    assert any(e["name"] == "degraded" for e in instants)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+
+
+def test_histogram_bucket_exactness():
+    h = Histogram("h", (1.0, 2.0, 5.0))
+    for v in (0.5, 1.0, 1.5, 2.0, 4.9, 5.0, 5.1, 100.0):
+        h.observe(v)
+    # le-semantics: a value equal to a bound lands IN that bound.
+    assert h.counts == [2, 2, 2, 2]
+    assert h.total == 8
+    assert h.sum == pytest.approx(120.0)
+    text = "\n".join(h.render())
+    assert 'h_bucket{le="1"} 2' in text
+    assert 'h_bucket{le="2"} 4' in text      # cumulative
+    assert 'h_bucket{le="5"} 6' in text
+    assert 'h_bucket{le="+Inf"} 8' in text
+    assert "h_count 8" in text
+
+
+def test_histogram_rejects_unsorted_bounds():
+    with pytest.raises(ValueError):
+        Histogram("h", (2.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("h", (1.0, 1.0))
+
+
+def test_counter_gauge_and_registry_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total")
+    g = reg.gauge("g")
+    c.inc()
+    c.inc(3)
+    g.set(7)
+    g.set(2)
+    assert isinstance(c, Counter) and isinstance(g, Gauge)
+    snap = reg.snapshot()
+    assert snap["c_total"] == 4
+    assert snap["g"] == {"value": 2, "max": 7}
+    # get-or-create is idempotent: the hot path's reference IS the
+    # registry's instrument.
+    assert reg.counter("c_total") is c
+    text = reg.render()
+    assert "c_total 4" in text and "g_max 7" in text
+
+
+def test_labeled_fetch_latency_histogram():
+    metrics.GLOBAL.reset()
+    metrics.fetch_latency_observe("serve_match", 3.0)
+    metrics.fetch_latency_observe("serve_match", 700.0)
+    metrics.fetch_latency_observe("level_bits", 1.0)
+    text = metrics.GLOBAL.render()
+    assert 'fa_fetch_latency_ms_count{site="serve_match"} 2' in text
+    assert 'fa_fetch_latency_ms_count{site="level_bits"} 1' in text
+    snap = metrics.GLOBAL.snapshot()["fa_fetch_latency_ms"]
+    assert snap["serve_match"]["count"] == 2
+
+
+def test_server_registry_counts_and_mid_run_scrape():
+    from fastapriori_tpu.serve import RecommendServer, ServingState
+
+    data = preprocess(D_LINES, 0.05)
+    cfg = MinerConfig(min_support=0.05, engine="level")
+    miner = FastApriori(config=cfg)
+    levels = miner.mine_levels_raw(data)
+    st = ServingState(
+        levels, data.item_counts, data.freq_items, data.item_to_rank,
+        config=cfg, context=miner.context, engine="host",
+    )
+    server = RecommendServer(
+        st, batch_rows=32, linger_ms=0.5, queue_depth=64
+    ).start()
+    reqs = [server.submit(l) for l in D_LINES[:50]]
+    mid = server.metrics_text()  # mid-run scrape must not crash
+    assert "fa_serve_submitted_total 50" in mid
+    assert server.wait_for(reqs, timeout_s=30.0)
+    snap = server.metrics_snapshot()["server"]
+    assert (
+        snap["fa_serve_served_total"] + snap["fa_serve_shed_total"] == 50
+    )
+    assert snap["fa_serve_batch_fill"]["count"] >= 1
+    assert server.stop(drain=True)
+    # The no-obs control flavor records nothing.
+    server2 = RecommendServer(
+        st, batch_rows=32, metrics=False, queue_depth=64
+    ).start()
+    r2 = [server2.submit(l) for l in D_LINES[:10]]
+    server2.wait_for(r2, timeout_s=30.0)
+    assert (
+        server2.metrics_snapshot()["server"]["fa_serve_submitted_total"]
+        == 0
+    )
+    assert server2.stop(drain=True)
+
+
+def test_metrics_dump_knob_strictness(monkeypatch):
+    monkeypatch.setenv("FA_METRICS_DUMP_S", "nope")
+    metrics.reload_from_env()
+    with pytest.raises(InputError):
+        metrics.dump_interval_s()
+    monkeypatch.setenv("FA_METRICS_DUMP_S", "0.5")
+    metrics.reload_from_env()
+    assert metrics.dump_interval_s() == 0.5
+    monkeypatch.delenv("FA_METRICS_DUMP_S")
+    metrics.reload_from_env()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_ring_overwrite_order():
+    rec = FlightRecorder(cap=4)
+    for i in range(7):
+        rec.note("ledger", i=i)
+    snap = rec.snapshot()
+    assert [e["i"] for e in snap] == [3, 4, 5, 6]  # oldest dropped first
+    assert [e["seq"] for e in snap] == [4, 5, 6, 7]  # monotone seqs
+    assert snap[0]["kind"] == "ledger"
+
+
+def test_flight_ring_size_knob(monkeypatch):
+    monkeypatch.setenv("FA_FLIGHT_RECORDER_N", "2")
+    rec = FlightRecorder()
+    assert rec.cap == 2
+    monkeypatch.setenv("FA_FLIGHT_RECORDER_N", "0")
+    rec = FlightRecorder()
+    rec.note("ledger", x=1)
+    assert rec.snapshot() == []  # disabled
+    monkeypatch.setenv("FA_FLIGHT_RECORDER_N", "junk")
+    with pytest.raises(InputError):
+        FlightRecorder()
+
+
+def test_ledger_events_enter_flight_ring():
+    ledger.record("retry", site="fetch.x", attempt=1)
+    events = flight.snapshot()
+    assert any(
+        e["kind"] == "ledger" and e.get("event") == "retry"
+        for e in events
+    )
+
+
+def test_flight_dump_on_injected_watchdog_timeout(tmp_path):
+    """The ISSUE 11 satellite case: an injected watchdog timeout lands
+    in the ring, and the dump is a manifest-committed artifact naming
+    it."""
+    prefix = str(tmp_path) + "/"
+    with pytest.raises(watchdog.DispatchTimeout):
+        watchdog.guard(
+            lambda: time.sleep(2.0), "fetch.slow", timeout_s=0.05
+        )
+    path = flight.dump(prefix, "test: injected watchdog_timeout")
+    with open(path) as fh:
+        body = json.load(fh)
+    assert body["reason"].startswith("test:")
+    assert any(
+        e.get("event") == "watchdog_timeout"
+        and e.get("site") == "fetch.slow"
+        for e in body["events"]
+    )
+    # Manifest-committed: resume-side validation accepts the artifact.
+    from fastapriori_tpu.io.resume import validate_artifact_bytes
+
+    with open(path, "rb") as fh:
+        validate_artifact_bytes(prefix, "flight.json", fh.read())
+
+
+def test_flight_auto_dump_requires_prefix(tmp_path):
+    assert flight.auto_dump("no prefix registered") is None
+    flight.set_dump_prefix(str(tmp_path) + "/")
+    ledger.record("retry", site="fetch.x", attempt=1)
+    path = flight.auto_dump("now registered")
+    assert path is not None
+    with open(path) as fh:
+        assert json.load(fh)["reason"] == "now registered"
+
+
+def test_abandoned_thread_cap_dumps_flight(tmp_path, monkeypatch):
+    monkeypatch.setenv("FA_DISPATCH_MAX_ABANDONED", "1")
+    watchdog.reload_from_env()
+    watchdog.reset_abandoned()
+    flight.set_dump_prefix(str(tmp_path) + "/")
+    release = threading.Event()
+    try:
+        with pytest.raises(watchdog.DispatchTimeout):
+            watchdog.guard(
+                lambda: release.wait(30.0), "fetch.wedge", timeout_s=0.05
+            )
+        with pytest.raises(watchdog.AbandonedThreadCap):
+            watchdog.guard(
+                lambda: release.wait(30.0), "fetch.wedge", timeout_s=0.05
+            )
+    finally:
+        release.set()
+        watchdog.reset_abandoned()
+        monkeypatch.delenv("FA_DISPATCH_MAX_ABANDONED")
+        watchdog.reload_from_env()
+    with open(str(tmp_path) + "/flight.json") as fh:
+        body = json.load(fh)
+    assert body["reason"] == "abandoned_thread_cap"
+    assert body["context"]["site"] == "fetch.wedge"
+
+
+# ---------------------------------------------------------------------------
+# MetricsLogger bound + phase_timer routing (satellites)
+
+
+def test_metrics_logger_records_are_bounded():
+    log = MetricsLogger(enabled=False, records_cap=5)
+    for i in range(8):
+        log.emit("e", i=i)
+    assert len(log.records) == 5
+    assert log.records_dropped == 3
+    assert [r["i"] for r in log.records] == [0, 1, 2, 3, 4]
+
+
+def test_metrics_logger_timed_respects_bound():
+    log = MetricsLogger(enabled=False, records_cap=1)
+    with log.timed("a"):
+        pass
+    with log.timed("b"):
+        pass
+    assert len(log.records) == 1 and log.records_dropped == 1
+
+
+def test_phase_timer_routes_through_tracer_and_logger(capsys):
+    TRACER.enable()
+    log = MetricsLogger(enabled=False)
+    with phase_timer("get freqItemsets", enabled=True, metrics=log):
+        pass
+    err = capsys.readouterr().err
+    assert "==== Use Time get freqItemsets" in err
+    assert log.records and log.records[-1]["event"] == "phase"
+    assert log.records[-1]["label"] == "get freqItemsets"
+    names = {name for _, name, _ in TRACER.span_tree()}
+    assert "phase" in names
+
+
+def test_phase_timer_uses_active_logger():
+    from fastapriori_tpu.utils import logging as fa_logging
+
+    log = MetricsLogger(enabled=True, stream=open(os.devnull, "w"))
+    assert fa_logging.active_logger() is log
+    with phase_timer("p", enabled=False):
+        pass
+    assert log.records[-1]["event"] == "phase"
+
+
+def test_timed_sections_become_spans():
+    TRACER.enable()
+    log = MetricsLogger(enabled=False)
+    with log.timed("level", k=4) as m:
+        m.update(frequent=10, psum_bytes=128, gather_bytes=64)
+    spans = [e for e in TRACER.events() if e["ph"] == "X"]
+    assert spans[0]["name"] == "level"
+    assert spans[0]["args"]["frequent"] == 10
+    counters = [e for e in TRACER.events() if e["ph"] == "C"]
+    assert counters and counters[0]["args"] == {"psum": 128, "gather": 64}
+    # The JSON record kept the same fields (one event source, two views).
+    assert log.records[-1]["psum_bytes"] == 128

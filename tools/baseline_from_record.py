@@ -82,6 +82,37 @@ def render(parsed: dict) -> str:
             f"{' resident' if model.get('resident_table') else ''}, "
             f"rule-table host bytes {srv.get('rule_table_host_bytes')} |"
         )
+        # ISSUE 11: the per-scenario registry snapshot (hot-path
+        # instruments vs loadgen cross-check), the no-obs control, and
+        # the trace artifact, when the record carries them.
+        sreg = sus.get("registry") or {}
+        ctrl = srv.get("no_obs_control") or {}
+        if sreg or ctrl or srv.get("trace"):
+            bits = []
+            if sreg:
+                bits.append(
+                    f"registry sustained: shed {sreg.get('shed_total')}, "
+                    f"queue peak {sreg.get('queue_peak')}, batch fill "
+                    f"{sreg.get('batch_fill_avg')} "
+                    f"({'agrees' if sreg.get('agrees_loadgen') else 'DISAGREES'}"
+                    " with loadgen)"
+                )
+            oreg = (srv.get("overload") or {}).get("registry") or {}
+            if oreg:
+                bits.append(
+                    f"overload: shed {oreg.get('shed_total')}, queue "
+                    f"peak {oreg.get('queue_peak')} "
+                    f"({'agrees' if oreg.get('agrees_loadgen') else 'DISAGREES'})"
+                )
+            if ctrl:
+                bits.append(
+                    f"obs overhead {ctrl.get('obs_overhead_pct')}% vs "
+                    f"no-obs control {ctrl.get('achieved_rps')} users/sec"
+                )
+            if srv.get("trace"):
+                bits.append(f"trace: `{srv['trace']}`")
+            out.append("")
+            out.append("Serving observability: " + "; ".join(bits) + ".")
     rf = parsed.get("rules_full_scale") or {}
     if rf.get("value") is not None:
         eng = (

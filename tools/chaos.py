@@ -260,8 +260,23 @@ def run_scenario(
     )
     from fastapriori_tpu.reliability import failpoints, ledger
 
+    from fastapriori_tpu.obs import flight
+
     out = os.path.join(root, f"s{schedule['seed']}") + os.sep
     os.makedirs(out)
+
+    def fail(detail: str) -> Outcome:
+        """Every FAIL ships its own post-mortem (ISSUE 11): the flight
+        recorder's ring — the ledger/span/watchdog events leading up to
+        the failure — dumps manifest-committed next to the scenario's
+        artifacts (the harness keeps the workdir on failure)."""
+        try:
+            path = flight.dump(out, f"chaos: {detail}"[:400])
+            print(f"chaos: flight recorder dumped: {path}")
+        # lint: waive G006 G009 -- best-effort post-mortem on an already-failed scenario
+        except Exception:
+            pass
+        return Outcome("FAIL", detail)
     argv = [
         inp, out, "--min-support", "0.08",
         "--engine", schedule["engine"],
@@ -282,16 +297,15 @@ def run_scenario(
     armed = schedule["failpoints"]
     degraded = ledger.summary()
     if hung:
-        return Outcome(
-            "FAIL", f"hang: no result within {timeout_s}s under {armed}"
+        return fail(
+            f"hang: no result within {timeout_s}s under {armed}"
         )
     truncated = any("truncate" in s for s in armed.values())
     if exc is not None:
         if not _classified(exc, armed):
-            return Outcome(
-                "FAIL",
+            return fail(
                 f"unclassified crash {type(exc).__name__}: {exc} "
-                f"under {armed}",
+                f"under {armed}"
             )
         if isinstance(exc, failpoints.InjectedAbort) and (
             schedule["checkpoint"] and checkpoint_available(out)
@@ -315,10 +329,9 @@ def run_scenario(
                         "classified",
                         f"torn checkpoint rejected: {verr}",
                     )
-                return Outcome(
-                    "FAIL",
+                return fail(
                     f"corrupt checkpoint with no truncation armed: "
-                    f"{verr} under {armed}",
+                    f"{verr} under {armed}"
                 )
             rc2, exc2, hung2 = _run_cli_bounded(
                 [inp, out, "--min-support", "0.08",
@@ -326,24 +339,22 @@ def run_scenario(
                 timeout_s,
             )
             if hung2 or exc2 is not None or rc2 != 0:
-                return Outcome(
-                    "FAIL",
+                return fail(
                     f"resume after kill failed (rc={rc2}, exc={exc2}) "
-                    f"under {armed}",
+                    f"under {armed}"
                 )
             for name, want in clean.items():
                 if _read(out + name) != want:
-                    return Outcome(
-                        "FAIL",
+                    return fail(
                         f"resumed {name} differs from clean run "
-                        f"under {armed}",
+                        f"under {armed}"
                     )
             return Outcome("killed_resumed", str(armed))
         return Outcome("classified", f"{type(exc).__name__} under {armed}")
     if rc == 2:
         return Outcome("classified", f"exit 2 under {armed}")
     if rc != 0:
-        return Outcome("FAIL", f"unexpected exit code {rc} under {armed}")
+        return fail(f"unexpected exit code {rc} under {armed}")
     for name, want in clean.items():
         if _read(out + name) == want:
             continue
@@ -351,10 +362,9 @@ def run_scenario(
             # Not silent: the manifest rejects the torn artifact, which
             # is the truncation contract (io/writer.py).
             return Outcome("classified", f"truncation detected ({name})")
-        return Outcome(
-            "FAIL",
+        return fail(
             f"SILENT CORRUPTION: {name} differs (rc 0, "
-            f"degraded={degraded}) under {armed}",
+            f"degraded={degraded}) under {armed}"
         )
     kind = "degraded" if degraded.get("cascade") else "identical"
     return Outcome(kind, f"degraded={degraded} under {armed}")
@@ -459,7 +469,11 @@ def main_chaos(argv: Optional[List[str]] = None) -> int:
                             file=sys.stderr,
                         )
     finally:
-        if not args.keep:
+        # A failed soak keeps its workdirs regardless of --keep: the
+        # FAIL scenarios' flight-recorder dumps (<out>flight.json — the
+        # post-mortem, ISSUE 11) live there, and deleting the evidence
+        # of the failure the soak exists to catch would be absurd.
+        if not args.keep and not failures:
             shutil.rmtree(root, ignore_errors=True)
         else:
             print(f"chaos: workdirs kept under {root}")

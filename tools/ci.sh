@@ -60,6 +60,20 @@ print(f"serve smoke wall time: {elapsed:.2f}s (budget 90s)")
 sys.exit(1 if elapsed > 90.0 else 0)
 EOF
 
+# Observability smoke (ISSUE 11): mine+serve under --trace (artifact
+# schema-validated as Perfetto-loadable, span hierarchy + counter
+# tracks present), metrics dump parseable, a mid-burst registry
+# scrape, and the tracing-off ≈-zero-overhead pin.  Wall-budgeted and
+# logged like the serve smoke.
+obs_t0=$(python -c 'import time; print(time.time())')
+env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+python - "$obs_t0" <<'EOF'
+import sys, time
+elapsed = time.time() - float(sys.argv[1])
+print(f"obs smoke wall time: {elapsed:.2f}s (budget 60s)")
+sys.exit(1 if elapsed > 60.0 else 0)
+EOF
+
 # Seeded chaos soak (ISSUE 9): deterministic failpoint schedules over
 # the lint-censused site inventory against the full CLI pipeline —
 # byte-identical, classified, or ledger-degraded; never a hang, silent

@@ -633,6 +633,42 @@ def site_census(pkg: "PackageContext"):
     return pkg._site_census
 
 
+def span_declarations(pkg: "PackageContext"):
+    """``[(literal, ctx, node)]`` for every string constant inside an
+    assignment to ``FETCH_SITE_SPANS`` in a NON-TEST file — the span
+    tracer's statically-checkable claim of which audited fetch sites
+    receive span scopes (fastapriori_tpu/obs/trace.py).  G014 checks
+    the claim against the fetch census both ways; the inventory ships
+    it as the ``span_sites`` census.  Cached per run."""
+    cached = getattr(pkg, "_span_declarations", None)
+    if cached is not None:
+        return cached
+    out = []
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Name) and t.id == "FETCH_SITE_SPANS"
+                for t in targets
+            ):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    out.append((sub.value, ctx, sub))
+    pkg._span_declarations = out
+    return out
+
+
 def _counted(entries):
     """[(key-dict)] -> sorted unique entries with a ``count`` field."""
     counts: Dict[Tuple, int] = {}
@@ -668,6 +704,10 @@ def build_inventory(pkg: "PackageContext") -> dict:
                         "justification": justification,
                     }
                 )
+    spans = [
+        {"label": v, "path": c.path}
+        for v, c, _n in span_declarations(pkg)
+    ]
     return {
         "version": 1,
         "comment": (
@@ -677,6 +717,7 @@ def build_inventory(pkg: "PackageContext") -> dict:
         ),
         "fetch_sites": _counted(fetches),
         "failpoint_sites": _counted(fires),
+        "span_sites": _counted(spans),
         "env_reads": _counted(envs),
         "waivers": _counted(waivers),
     }

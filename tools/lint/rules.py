@@ -1165,6 +1165,62 @@ class SiteCensusRule(Rule):
             )
 
 
+class SpanCensusRule(Rule):
+    """G014 — every audited fetch site label has a span scope.
+
+    The tracer instruments audited fetches centrally
+    (reliability/retry.py builds the span from the dynamic site
+    string), so the per-site coverage claim is only checkable through
+    the declared census: ``fastapriori_tpu/obs/trace.py`` ships
+    ``FETCH_SITE_SPANS``, the literal ``fetch.<label>`` list tests pin
+    against real traced spans.  This rule closes the drift loop both
+    ways with the G013 machinery: a fetch site added without a span
+    declaration flags at the site; a declaration whose site vanished
+    flags as stale.  Packages with no ``FETCH_SITE_SPANS`` assignment
+    (pre-obs fixture trees) are exempt — there is no claim to check.
+    """
+
+    id = "G014"
+    name = "span-census"
+    aliases = ("span-ok",)
+
+    def check(self, ctx, pkg):
+        return iter(())
+
+    def check_package(self, pkg):
+        from tools.lint import engine as eng
+
+        declared = eng.span_declarations(pkg)
+        if not declared:
+            return
+        declared_set = {v for v, _c, _n in declared}
+        fetch_sites, _fires, _envs = eng.site_census(pkg)
+        live = set()
+        for label, ctx, node in fetch_sites:
+            want = f"fetch.{label}"
+            live.add(want)
+            if want in declared_set:
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"fetch site {label!r} has no span-scope declaration: "
+                f"add {want!r} to FETCH_SITE_SPANS "
+                "(fastapriori_tpu/obs/trace.py) so the tracer's "
+                "coverage census matches the audited-fetch census",
+            )
+        for value, ctx, node in declared:
+            if value in live:
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"span-scope declaration {value!r} is stale: no audited "
+                "fetch site with that label remains — drop it from "
+                "FETCH_SITE_SPANS",
+            )
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncRule(),
     CollectiveAxisRule(),
@@ -1179,6 +1235,7 @@ ALL_RULES: Sequence[Rule] = (
     ShapeBucketRule(),
     EnvContractRule(),
     SiteCensusRule(),
+    SpanCensusRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
