@@ -3,7 +3,7 @@
 # failpoint smoke pass (reliability wiring under injected failure — see
 # tools/failpoint_smoke.py).
 
-.PHONY: lint test smoke serve-smoke obs-smoke chaos ci baseline inventory native
+.PHONY: lint test smoke serve-smoke obs-smoke chaos chaos-mp ci baseline inventory native
 
 # Default paths cover the whole tree: fastapriori_tpu tests bench.py
 # __graft_entry__.py tools (tools/lint/cli.py DEFAULT_PATHS).
@@ -36,7 +36,16 @@ chaos:
 	env JAX_PLATFORMS=cpu python tools/chaos.py \
 	    --seeds 0,4,6,9 --scenarios 3 --budget-s 120
 
-ci: lint test smoke serve-smoke obs-smoke chaos
+# Multi-process fault-domain soak (ISSUE 12): real 2-subprocess meshes
+# over the file-transport quorum — seeded kill-mid-level / divergence
+# injection / coordinator-flap / heartbeat-delay schedules under the
+# extended invariant (survivors byte-identical or classified naming
+# the rank; never a hang or a mixed-epoch artifact).
+chaos-mp:
+	env JAX_PLATFORMS=cpu python tools/chaos.py --procs 2 \
+	    --seeds 0,3,7 --scenarios 3 --budget-s 120
+
+ci: lint test smoke serve-smoke obs-smoke chaos chaos-mp
 
 # Ratchet reset — only alongside the change that justifies it.
 baseline:

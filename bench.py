@@ -204,12 +204,31 @@ def _phase_summary(records, cold_s=None):
     if levels_ms:
         ph["levels_ms"] = levels_ms
         ph["levels_total_ms"] = round(sum(levels_ms.values()), 1)
+    _quorum_summary(ph)
     if cold_s is not None:
         # Cold-warm delta ~= compile + first-warm backend costs; with a
         # primed persistent compile cache this should be small — the
         # record proves whether the cache hit in THIS environment.
         ph["cold_s"] = round(cold_s, 3)
     return ph
+
+
+def _quorum_summary(ph):
+    """Surface fault-domain events as FIRST-CLASS phase fields (ISSUE
+    12 satellite): a run whose consensus layer adopted a peer's
+    degradation (quorum_adopt / mesh_divergence) or lost a peer
+    (peer_lost) must not read as a clean perf number just because the
+    counts are buried inside the degraded-kind histogram."""
+    d = ph.get("degraded") or {}
+    q = sum(
+        v
+        for k, v in d.items()
+        if k.startswith("quorum") or k == "mesh_divergence"
+    )
+    if q:
+        ph["quorum_events"] = q
+    if d.get("peer_lost"):
+        ph["peer_lost"] = d["peer_lost"]
 
 
 def _loadavg():
@@ -1689,6 +1708,7 @@ def _serve_workload(args, raw, d_path) -> int:
     # ordered cascade trail.  An all-"0"-answering broken server can
     # then never read as a clean record-setting row.
     phases = {"degraded": ledger.summary()}
+    _quorum_summary(phases)
     trail = [
         {
             k: e[k]

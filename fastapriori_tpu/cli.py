@@ -498,6 +498,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         print(f"error: {e}", file=sys.stderr)
         return 2
+    except RuntimeError as e:
+        # Fault-domain failures (ISSUE 12) are CLASSIFIED, not
+        # tracebacks: a dead peer / divergent mesh names the rank and
+        # exits 3 (distinct from the user-fixable 2), with the
+        # consensus epoch trail already in the flight dump the quorum
+        # layer shipped when the error classified.  Any other
+        # RuntimeError keeps propagating unchanged.
+        from fastapriori_tpu.reliability import quorum
+
+        if not isinstance(
+            e, (quorum.PeerLost, quorum.MeshDivergence)
+        ):
+            raise
+        print(f"error: {e}", file=sys.stderr)
+        return 3
     except FileNotFoundError as e:
         missing = e.filename if e.filename else str(e)
         # The D.dat/U.dat hint only fits the two ingest reads; a
@@ -604,10 +619,23 @@ def _run(args) -> int:
 
     # Observability (ISSUE 11): span recording on --trace/FA_TRACE, and
     # the flight recorder's post-mortem dumps target this run's output
-    # prefix (process 0 — one writer, like every other artifact).
+    # prefix (process 0 — one writer, like every other artifact).  On a
+    # multi-process fault domain (ISSUE 12) EVERY rank dumps, under a
+    # rank-suffixed prefix so per-process post-mortems never clobber
+    # (tools/flight_merge.py reassembles them into one ordered trail).
+    from fastapriori_tpu.reliability import quorum
+
+    dom = quorum.active()
+    multi_rank = dom is not None and dom.nprocs > 1
     trace.maybe_enable(bool(args.trace))
-    if proc_id == 0:
+    if multi_rank:
+        flight.set_dump_prefix(args.output + f"rank{dom.rank}.")
+    elif proc_id == 0:
         flight.set_dump_prefix(args.output)
+    # Fault-domain rendezvous (ISSUE 12): all ranks up before any work
+    # — a peer that never starts surfaces here as a classified
+    # PeerLost, bounded by attempts x FA_QUORUM_TIMEOUT_S.
+    quorum.sync("run.start", wait=True)
 
     u_lines = read_dat(args.input + "U.dat")
 
@@ -711,6 +739,11 @@ def _run(args) -> int:
         f"{int((time.perf_counter() - t1) * 1e3)}",
         file=sys.stderr,
     )
+    # End-of-mine rendezvous: fused and per-level ranks take different
+    # numbers of level boundaries, but every rank arrives HERE — a rank
+    # killed mid-mine is detected by its survivors within the bound,
+    # never waited on forever.
+    quorum.sync("mine.end", wait=True)
 
     phase = phase_timer("get recommends", enabled=False)
     phase.__enter__()
@@ -738,8 +771,13 @@ def _run(args) -> int:
         file=sys.stderr,
     )
     run_span.__exit__(None, None, None)
-    if args.trace and proc_id == 0:
-        path = trace.TRACER.export(args.trace)
+    # Final rendezvous: no rank exits while a peer still needs its
+    # heartbeats — the survivors' last bounded wait.
+    quorum.sync("run.end", wait=True)
+    if args.trace and (multi_rank or proc_id == 0):
+        # Multi-rank runs export per-rank traces (rank suffix before
+        # the extension — no clobbering; ISSUE 12 satellite).
+        path = trace.TRACER.export(quorum.rank_path(args.trace))
         print(
             f"trace written: {path} "
             f"({len(trace.TRACER.events())} events; load in Perfetto)",

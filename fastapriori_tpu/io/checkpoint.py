@@ -41,7 +41,13 @@ def save_checkpoint(
     prefix: str, levels: List[Level], meta: Dict[str, int]
 ) -> str:
     """Atomically (re)write ``<prefix>checkpoint.npz`` + its manifest
-    entry.  ``meta`` needs ``n_raw``, ``min_count``, ``num_items``."""
+    entry.  ``meta`` needs ``n_raw``, ``min_count``, ``num_items``; an
+    optional ``fence`` (the quorum fence epoch a multi-process writer
+    holds — reliability/quorum.py, ISSUE 12) is stamped into BOTH the
+    checkpoint meta and the run manifest, so a resume can reject a
+    stale (split-brain) writer's artifact even when the writer's own
+    commit-time fence check was raced past."""
+    fence = int(meta.get("fence", 0))
     arrays = {
         "meta": np.array(
             [
@@ -49,6 +55,7 @@ def save_checkpoint(
                 meta["n_raw"],
                 meta["min_count"],
                 meta["num_items"],
+                fence,
             ],
             dtype=np.int64,
         )
@@ -65,7 +72,7 @@ def save_checkpoint(
         CHECKPOINT_NAME,
         manifest,
     )
-    write_manifest(prefix, manifest)
+    write_manifest(prefix, manifest, fence=fence or None)
     return path
 
 
@@ -122,6 +129,10 @@ def load_checkpoint(
                 "min_count": int(m[2]),
                 "num_items": int(m[3]),
             }
+            # Fence slot (ISSUE 12): absent on pre-fence checkpoints
+            # (4-slot meta) — those stay loadable; fence 0 = unfenced.
+            if m.shape[0] >= 5 and int(m[4]):
+                meta["fence"] = int(m[4])
             levels = [
                 (z[f"mat_{i}"], z[f"cnt_{i}"]) for i in range(n_levels)
             ]
@@ -138,6 +149,24 @@ def load_checkpoint(
                 f"corrupt checkpoint {path!r}: level {i + 2} has shape "
                 f"{mat.shape}/{cnt.shape} (expected [N, {i + 2}]/[N])"
             )
+    # Fenced-resume validation (reliability/quorum.py): on an active
+    # multi-process domain, a checkpoint whose fence (meta slot, cross-
+    # checked against the manifest's monotone copy) is older than the
+    # domain's FENCE was written by a superseded coordinator — a
+    # split-brain artifact must never seed a resume.  Without a domain
+    # the fence stays informational and the manifest is not re-read
+    # (on a remote prefix that read is a whole extra GET per resume).
+    from fastapriori_tpu.reliability import quorum
+
+    if quorum.active() is not None:
+        from fastapriori_tpu.io.resume import manifest_fence
+
+        fences = [
+            f
+            for f in (meta.get("fence"), manifest_fence(prefix))
+            if f is not None
+        ]
+        quorum.validate_resume_fence(max(fences) if fences else None)
     return levels, meta
 
 
@@ -172,8 +201,11 @@ def validate_checkpoint(prefix: str) -> Dict[str, int]:
 
 def check_meta(meta: Dict[str, int], *, n_raw: int, min_count: int,
                num_items: int, prefix: str) -> None:
-    """Reject a checkpoint written for different data or support."""
+    """Reject a checkpoint written for different data or support.  The
+    fence slot (writer identity, not dataset identity) is excluded —
+    it is validated separately at load time."""
     got = {"n_raw": n_raw, "min_count": min_count, "num_items": num_items}
+    meta = {k: v for k, v in meta.items() if k in got}
     if meta != got:
         raise InputError(
             f"checkpoint under {prefix!r} was written for different "

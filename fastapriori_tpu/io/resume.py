@@ -103,6 +103,23 @@ def load_manifest(prefix: str) -> Optional[Dict[str, dict]]:
     return artifacts
 
 
+def manifest_fence(prefix: str) -> Optional[int]:
+    """The quorum fence epoch recorded in ``<prefix>MANIFEST.json``
+    (reliability/quorum.py fenced checkpoints — ISSUE 12), or None when
+    no manifest exists or no fence was ever stamped (single-process
+    runs).  Parse failures return None rather than raising: the
+    artifact-table reader (:func:`load_manifest`) owns the loud corrupt-
+    manifest contract; the fence is an ADDITIONAL cross-check."""
+    path = prefix + MANIFEST_NAME
+    try:
+        with _open_bytes(path) as f:
+            doc = json.loads(f.read().decode("utf-8"))
+        fence = doc.get("fence")
+        return fence if isinstance(fence, int) else None
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+
+
 def validate_artifact_bytes(
     prefix: str,
     name: str,

@@ -148,15 +148,26 @@ def write_artifact(
     )
 
 
-def write_manifest(prefix: str, entries: Dict[str, dict]) -> str:
+def write_manifest(
+    prefix: str,
+    entries: Dict[str, dict],
+    fence: Optional[int] = None,
+) -> str:
     """Persist ``<prefix>MANIFEST.json``, merging over any existing
     manifest at the same prefix (phase-1 artifacts and the recommends
     file are written at different times by the same run).  The manifest
     write itself is atomic; it is deliberately the LAST write, so a crash
     between an artifact and its manifest entry leaves a manifest that
-    still validates the artifacts it lists."""
+    still validates the artifacts it lists.
+
+    ``fence``: the quorum fence epoch a multi-process checkpoint commit
+    carries (reliability/quorum.py — ISSUE 12): stamped as a top-level
+    ``fence`` field, monotone under merge (a merge can never LOWER the
+    recorded fence — a stale writer's concurrent rewrite cannot roll
+    the manifest's epoch back even if its commit slips through)."""
     path = prefix + MANIFEST_NAME
     merged: Dict[str, dict] = {}
+    prev_fence: Optional[int] = None
     try:
         # Remote prefixes merge too — a recommends-phase rewrite that
         # dropped the mining entries would silently disable integrity
@@ -168,12 +179,16 @@ def write_manifest(prefix: str, entries: Dict[str, dict]) -> str:
         artifacts = prev.get("artifacts", {})
         if isinstance(artifacts, dict):
             merged.update(artifacts)
+        if isinstance(prev.get("fence"), int):
+            prev_fence = prev["fence"]
     except (OSError, ValueError, UnicodeDecodeError):
         pass  # absent or corrupt old manifest: superseded by the rewrite
     merged.update(entries)
-    body = json.dumps(
-        {"version": 1, "artifacts": merged}, indent=2, sort_keys=True
-    )
+    doc: Dict[str, object] = {"version": 1, "artifacts": merged}
+    fences = [f for f in (fence, prev_fence) if f is not None]
+    if fences:
+        doc["fence"] = max(fences)
+    body = json.dumps(doc, indent=2, sort_keys=True)
     return write_artifact(path, [body + "\n"], MANIFEST_NAME)
 
 

@@ -33,7 +33,13 @@ from fastapriori_tpu.ops.bitmap import (
 )
 from fastapriori_tpu.parallel.mesh import DeviceContext
 from fastapriori_tpu.preprocess import CompressedData, preprocess
-from fastapriori_tpu.reliability import failpoints, ledger, retry, watchdog
+from fastapriori_tpu.reliability import (
+    failpoints,
+    ledger,
+    quorum,
+    retry,
+    watchdog,
+)
 from fastapriori_tpu.obs import trace
 from fastapriori_tpu.utils.logging import MetricsLogger
 
@@ -210,10 +216,17 @@ class FastApriori:
             return
         prefix = self.config.checkpoint_prefix
         k = int(levels[-1][0].shape[1])
-        if prefix and jax.process_index() == 0:
+        if prefix and jax.process_index() == 0 and quorum.is_writer():
             from fastapriori_tpu.io.checkpoint import save_checkpoint
 
             with self.metrics.timed("checkpoint", levels=len(levels), k=k):
+                # Fenced commit (ISSUE 12): on a multi-process domain
+                # the writer stamps its monotonic fence epoch into the
+                # checkpoint meta + MANIFEST.json; a superseded writer
+                # (split-brain after a coordinator flap) is REJECTED
+                # here (StaleFenceError, classified) instead of
+                # publishing a mixed-epoch artifact.  0 without a
+                # domain (single-process, unfenced — the default).
                 save_checkpoint(
                     prefix,
                     levels,
@@ -221,9 +234,16 @@ class FastApriori:
                         "n_raw": data.n_raw,
                         "min_count": data.min_count,
                         "num_items": data.num_items,
+                        "fence": quorum.checkpoint_fence(),
                     },
                 )
         failpoints.fire(f"level.{k}")
+        # Level-boundary consensus exchange (ISSUE 12): publish this
+        # process's cascade positions, adopt any peer's more-degraded
+        # ones BEFORE the next level's dispatch, and surface a dead
+        # peer (stale heartbeat) as a classified PeerLost instead of a
+        # collective hang.  Non-blocking; no-op without a domain.
+        quorum.sync(f"level.{k}")
 
     # -- count-reduction engine (ROADMAP item 2: sparse allreduce) -----
     _COUNT_REDUCE = ("auto", "dense", "sparse")
@@ -263,6 +283,11 @@ class FastApriori:
             reason = "cand_mesh"
         elif data.shard is not None or jax.process_count() != 1:
             reason = "multi_process"
+        elif not quorum.stage_allowed("count_reduce", "sparse"):
+            # Cross-process consensus floor (ISSUE 12): a peer already
+            # degraded this chain — start at the agreed position so
+            # this process never issues the more-capable collective.
+            reason = "quorum"
         if reason is not None:
             if req == "sparse":
                 ledger.record(
@@ -375,6 +400,19 @@ class FastApriori:
         req = self._requested_mine_engine()
         if req == "bitmap":
             return "bitmap"
+        if not quorum.stage_allowed("mine_engine", "vertical"):
+            # Consensus floor (ISSUE 12): same clamp as _mine_engine —
+            # the probe must never commit blocks to a layout a peer has
+            # already abandoned.
+            if req == "vertical":
+                ledger.record(
+                    "mine_engine_fallback", once_key="quorum",
+                    reason="quorum",
+                )
+                watchdog.downgrade(
+                    "mine_engine", "vertical", "bitmap", reason="quorum"
+                )
+            return "bitmap"
         if req == "vertical":
             ledger.record(
                 "mine_engine", once_key="vertical", engine="vertical",
@@ -439,6 +477,10 @@ class FastApriori:
             reason = "multi_process"
         elif not self._has_csr(data):
             reason = "no_csr"
+        elif not quorum.stage_allowed("mine_engine", "vertical"):
+            # Consensus floor (ISSUE 12): a peer already fell back to
+            # the bitmap layout — lane collectives would never match.
+            reason = "quorum"
         if reason is not None:
             if req == "vertical":
                 ledger.record(
@@ -2301,6 +2343,11 @@ class FastApriori:
         ctx = self.context
         f = data.num_items
         min_count = data.min_count
+        # Consensus exchange BEFORE any engine resolution (ISSUE 12):
+        # adopt peers' cascade positions first, so every resolution
+        # below starts at the domain's agreed floor and the first
+        # dispatch is already lockstep.  No-op without a domain.
+        quorum.sync("mine.start")
         # Count-reduction engine (ROADMAP item 2): sparse threshold
         # exchange on multi-device meshes, dense psum elsewhere — and
         # always available as the differential oracle / overflow
@@ -2352,6 +2399,8 @@ class FastApriori:
             and not cfg.checkpoint_prefix  # no mid-points to checkpoint
             and ctx.cand_shards == 1
             and data.shard is None
+            # Consensus floor: a peer already walked engine past fused.
+            and quorum.stage_allowed("engine", "fused")
         )
         need_n2 = False
         if fused_ok:
@@ -2648,6 +2697,23 @@ class FastApriori:
         fold_attempts = 2  # an early incomplete fold keeps one retry
         last_fold_seed = None  # strict seed shrink between attempts
         while cur.shape[0] >= k:
+            # Mid-mine consensus adoption (ISSUE 12): the boundary sync
+            # in _checkpoint_levels may have adopted a peer's degraded
+            # position since the last iteration — re-clamp the local
+            # choices BEFORE this level's dispatch, so the very next
+            # collective already matches the domain's agreed shape.
+            if count_reduce == "sparse" and not quorum.stage_allowed(
+                "count_reduce", "sparse"
+            ):
+                ledger.record(
+                    "count_reduce_fallback", once_key="quorum",
+                    reason="quorum", k=int(k),
+                )
+                count_reduce, sparse_thr = "dense", None
+            if fused_ckpt and not quorum.stage_allowed("engine", "fused"):
+                fused_ckpt = False  # per-level (still checkpointed)
+            if tail_ok and not quorum.stage_allowed("engine", "tail"):
+                tail_ok = False
             # k > 3: never fold straight off the pair level — small
             # lattices that fit a whole-loop program are the fused
             # engine's job (the auto choice), and the fold's seed should

@@ -51,6 +51,11 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=self._cap or 1)
         self._seq = 0
         self._t0 = time.monotonic()
+        # Wall-clock anchor for the same instant as _t0: event t_s
+        # values are monotonic-relative, so cross-PROCESS ordering (the
+        # per-rank dumps tools/flight_merge.py reassembles) needs the
+        # anchor in the dump body — t_abs = t0_unix_s + t_s.
+        self._t0_wall = time.time()
         self._dump_prefix: Optional[str] = None
         self.dumps = 0
 
@@ -101,6 +106,7 @@ class FlightRecorder:
             "ring_capacity": self._cap,
             "total_events": self._seq,
             "first_seq": events[0]["seq"] if events else None,
+            "t0_unix_s": round(self._t0_wall, 6),
             "events": events,
         }
         if extra:
@@ -136,6 +142,7 @@ class FlightRecorder:
             self._ring = deque(maxlen=self._cap or 1)
             self._seq = 0
             self._t0 = time.monotonic()
+            self._t0_wall = time.time()
             self.dumps = 0
 
 

@@ -130,14 +130,38 @@ class _SpanCtx:
             self._span.args.update(args)
 
 
+DEFAULT_MAX_EVENTS = 200_000
+_max_events_memo: Optional[int] = None
+
+
+def max_events_from_env() -> int:
+    """``FA_TRACE_EVENTS``: the tracer's bounded-buffer capacity
+    (strict int >= 1; default 200K).  ROADMAP obs residue: a
+    webdocs-scale full trace outgrows the default cap and DROPS (with
+    only a counter saying so) — this knob raises the ceiling for a
+    deliberate big capture without changing the default's bound or the
+    counted-drop behavior.  Parsed once per process; tests use
+    :func:`reload_from_env`."""
+    global _max_events_memo
+    if _max_events_memo is None:
+        from fastapriori_tpu.utils.env import env_int
+
+        _max_events_memo = env_int(
+            "FA_TRACE_EVENTS", DEFAULT_MAX_EVENTS, minimum=1
+        )
+    return _max_events_memo
+
+
 class Tracer:
     """Process-wide span collector (module docstring).  A singleton like
     the degradation ledger: the sites that trace (retry wrappers, ops
     dispatch points) have no config in scope."""
 
-    def __init__(self, max_events: int = 200_000):
+    def __init__(self, max_events: Optional[int] = None):
         self.enabled = False
-        self.max_events = max_events
+        self.max_events = (
+            max_events_from_env() if max_events is None else max_events
+        )
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
         self.dropped = 0
@@ -453,8 +477,10 @@ def enabled_by_env() -> bool:
 
 
 def reload_from_env() -> None:
-    global _env_memo
+    global _env_memo, _max_events_memo
     _env_memo = None
+    _max_events_memo = None
+    TRACER.max_events = max_events_from_env()
 
 
 def maybe_enable(explicit: bool = False) -> bool:

@@ -1,0 +1,852 @@
+"""Cross-process fault domain: cascade consensus, peer-death detection
+and fenced checkpoints (ISSUE 12 tentpole).
+
+PR 9 proved the chaos invariant (byte-identical-or-classified-or-
+degraded, never a hang) on a single-host virtual mesh; the CHAINS
+cascade it built is exactly the mechanism that can *deadlock* a real
+multi-process mesh.  Every chain walk that changes collective shape or
+count — engine fused→level, mine_engine vertical→bitmap, count_reduce
+sparse→dense, rule_engine sharded→host — is a PER-PROCESS decision
+(transient exhaustion is local: one rank's flaky link, one rank's
+injected failpoint).  If rank r degrades and its peers do not, the two
+sides issue collectives with different shapes/counts and the mesh hangs
+forever — the classic failure mode of the exchange layouts the sparse
+allreduce construction (arxiv 1312.3020) relies on.  This module makes
+that divergence impossible by construction:
+
+**Cascade consensus.**  Every shape-changing downgrade becomes an
+epoch-stamped *proposal*: :func:`~fastapriori_tpu.reliability.watchdog.
+downgrade` folds the new position into this process's published state
+the moment it happens (before the next dispatch), and every sync point
+(mine start, level boundaries, phase-2 start, run end) exchanges the
+tiny fixed-shape position vector across processes.  All processes adopt
+the elementwise MOST-DEGRADED position — a peer's transient exhaustion
+degrades everyone in lockstep, ledger-recorded with the originating
+rank (``quorum_adopt`` + the standard ``cascade`` event), so divergent
+collectives cannot be issued.  Positions are forward-only, exactly like
+the cascade itself.
+
+**Peer-death detection.**  The consensus exchange and the phase
+rendezvous are wall-bounded (``FA_QUORUM_TIMEOUT_S``), and every
+process publishes a heartbeat (``FA_HEARTBEAT_MS``, a background
+daemon thread on the file transport).  A killed or wedged peer
+surfaces as a classified :class:`PeerLost` error NAMING THE RANK within
+``attempts × FA_QUORUM_TIMEOUT_S`` (the exchange runs under the
+standard bounded retry), instead of an indefinite collective hang.
+PeerLost carries the ``UNAVAILABLE`` status so retry.classify sees a
+transient — a flapping peer gets its retry; a dead one exhausts the
+budget and the run dies classified.
+
+**Divergence demonstration (consensus off).**  A domain built with
+``consensus=False`` models the RAW mesh: sync points become collective
+rendezvous comparing a digest of each rank's collective-shaping state
+(positions + site).  A divergence-injected chain walk then does what a
+real mesh would — the mismatched collective "hangs", bounded by the
+quorum timeout into a classified :class:`MeshDivergence` naming both
+ranks and digests.  tests/test_reliability.py pins both halves: hang
+(bounded) without consensus, lockstep degradation with it.
+
+**Fenced checkpoints.**  The domain owns a monotonic FENCE epoch
+(``<dir>/FENCE``, atomically incremented under an exclusive lock).  The
+checkpoint writer (quorum rank 0) acquires a fence once per run and
+stamps it into the checkpoint meta AND ``MANIFEST.json``; a writer
+whose fence has been superseded (split-brain: an old coordinator coming
+back after a flap) is REJECTED at commit time (:class:`StaleFenceError`,
+classified), and peers validate fence+signature at resume — a
+mixed-epoch artifact can neither be committed nor resumed from.
+
+**Transports.**  Single-process (the default): no domain, every hook is
+a memoized no-op costing one attribute read.  The FILE transport
+(``FA_QUORUM_DIR`` + ``FA_QUORUM_RANK`` + ``FA_QUORUM_PROCS``) backs
+the simulated-multiprocess harness (``tools/chaos.py --procs N`` and
+the test suites) — the same role PR 9's monkeypatched
+``jax.process_index`` played, made real with actual subprocesses,
+because the pinned jax 0.4.37 CPU backend refuses multiprocess
+computations.  The JAX transport (real ``jax.distributed`` meshes,
+``jax.process_count() > 1``) exchanges the same vector through
+``process_allgather`` under the dispatch watchdog; its two-process
+cases version-gate on jax >= 0.5 alongside tests/test_distributed.py.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.reliability import failpoints, ledger
+
+# The consensus chains: the CHAINS entries whose position changes the
+# SHAPE or COUNT of mesh collectives (watchdog.CHAINS keeps the full
+# set; serving/rule_scan are host-local and never cross the mesh).
+# Order is the wire format of the exchanged position vector — pinned by
+# tests; reordering is a protocol change.
+CONSENSUS_CHAINS: Tuple[str, ...] = (
+    "engine",
+    "mine_engine",
+    "count_reduce",
+    "rule_engine",
+)
+
+FENCE_NAME = "FENCE"
+
+
+class PeerLost(RuntimeError):
+    """A quorum peer died or wedged: no heartbeat / no rendezvous
+    arrival within the bound.  The message leads with ``UNAVAILABLE``
+    so retry.classify sees a transient — the exchange's bounded retry
+    absorbs a flap, and exhaustion surfaces as this classified error
+    naming the rank (never an indefinite collective hang)."""
+
+    def __init__(self, rank: int, site: str, detail: str):
+        self.rank = rank
+        self.site = site
+        super().__init__(
+            f"UNAVAILABLE: quorum peer rank {rank} lost at {site!r} — "
+            f"{detail}"
+        )
+
+
+class MeshDivergence(RuntimeError):
+    """Collective-shape divergence detected at a rendezvous (consensus
+    disabled — the raw-mesh failure mode this module exists to kill).
+    Carries ``ABORTED`` so classification sees a transient: the bounded
+    retry re-checks (a peer may still converge), and exhaustion is a
+    classified error naming both sides instead of a hang."""
+
+
+class StaleFenceError(InputError):
+    """A checkpoint commit or resume with a superseded fence epoch
+    (split-brain writer).  InputError: the run cannot proceed against a
+    newer coordinator's artifacts; the message names the checkpoint
+    fence so the chaos invariant classifies it."""
+
+
+def quorum_timeout_s() -> float:
+    """``FA_QUORUM_TIMEOUT_S``: wall bound (seconds) on one consensus
+    exchange / rendezvous wait (strict; default 30).  Total worst-case
+    stall on a dead peer is ``retry attempts × this bound``."""
+    from fastapriori_tpu.utils.env import env_float
+
+    return env_float("FA_QUORUM_TIMEOUT_S", 30.0, minimum=0.1)
+
+
+def heartbeat_ms() -> float:
+    """``FA_HEARTBEAT_MS``: heartbeat publish interval (milliseconds,
+    strict; default 200).  Must be well under the quorum timeout —
+    liveness is judged by heartbeat age against the timeout."""
+    from fastapriori_tpu.utils.env import env_float
+
+    return env_float("FA_HEARTBEAT_MS", 200.0, minimum=1.0)
+
+
+# ---------------------------------------------------------------------------
+# transports
+
+
+class FileTransport:
+    """Shared-directory transport for the simulated-multiprocess mesh:
+    one atomically-replaced state file per rank (positions + seq +
+    publish time), marker files for rendezvous sites, a background
+    daemon heartbeat, and best-effort exit markers so a cleanly-failed
+    peer is detected immediately instead of after the staleness bound.
+    All writes are tmp+rename (a reader never sees a torn file)."""
+
+    def __init__(self, root: str, rank: int, nprocs: int):
+        self.root = root
+        self.rank = rank
+        self.nprocs = nprocs
+        os.makedirs(root, exist_ok=True)
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- atomic helpers -------------------------------------------------
+    def _write_json(self, name: str, doc: dict) -> None:
+        path = os.path.join(self.root, name)
+        tmp = path + f".tmp{self.rank}"
+        # lint: waive G009 -- quorum control-plane state files, not run artifacts (atomic tmp+rename below)
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    def _read_json(self, name: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.root, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            # Absent, or mid-replace on a non-atomic filesystem: the
+            # caller polls; a persistent parse failure surfaces as a
+            # missing peer (bounded → PeerLost), never a crash.
+            return None
+
+    # -- heartbeat ------------------------------------------------------
+    def start_heartbeat(self) -> None:
+        if self._hb_thread is not None:
+            return
+        interval = heartbeat_ms() / 1e3
+
+        def beat() -> None:
+            while not self._hb_stop.wait(interval):
+                failpoints.fire("quorum.heartbeat")
+                self._write_json(
+                    f"hb.{self.rank}", {"t": time.time()}
+                )
+
+        self._write_json(f"hb.{self.rank}", {"t": time.time()})
+        t = threading.Thread(
+            target=beat, name=f"fa-quorum-hb:{self.rank}", daemon=True
+        )
+        t.start()
+        self._hb_thread = t
+
+    def stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+
+    def heartbeat_age(self, rank: int) -> Optional[float]:
+        """Seconds since ``rank`` last published a heartbeat; None when
+        it never has (not started yet, or the process died pre-start)."""
+        doc = self._read_json(f"hb.{rank}")
+        if doc is None:
+            return None
+        return max(0.0, time.time() - float(doc.get("t", 0.0)))
+
+    # -- state / markers ------------------------------------------------
+    def publish_state(self, doc: dict) -> None:
+        self._write_json(f"state.{self.rank}", doc)
+
+    def peer_state(self, rank: int) -> Optional[dict]:
+        return self._read_json(f"state.{rank}")
+
+    def post_marker(self, site: str, doc: dict) -> None:
+        self._write_json(f"mark.{_site_slug(site)}.{self.rank}", doc)
+
+    def peer_marker(self, site: str, rank: int) -> Optional[dict]:
+        return self._read_json(f"mark.{_site_slug(site)}.{rank}")
+
+    def post_exit(self, status: str) -> None:
+        self._write_json(
+            f"exit.{self.rank}", {"status": status, "t": time.time()}
+        )
+
+    def peer_exit(self, rank: int) -> Optional[dict]:
+        return self._read_json(f"exit.{rank}")
+
+    # -- fence ----------------------------------------------------------
+    def _fence_lock(self, bound_s: float):
+        """Exclusive-create lock file with a staleness bound: a lock
+        older than the bound belongs to a dead writer and is broken
+        (the new coordinator must be able to fence it out).  Breaking
+        is ATOMIC — the stale lock is renamed aside, and exactly one
+        breaker wins the rename — so two coordinators can never both
+        conclude they broke the same lock and hold it concurrently
+        (both would then stamp the same fence: the split-brain the
+        fence exists to prevent)."""
+        path = os.path.join(self.root, FENCE_NAME + ".lock")
+        t0 = time.monotonic()
+        while True:
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                return path
+            except FileExistsError:
+                try:
+                    stale = (
+                        time.time() - os.path.getmtime(path) > bound_s
+                    )
+                except OSError:
+                    continue  # holder released mid-check — retry create
+                if stale:
+                    # One winner: os.rename is atomic, the loser's
+                    # rename raises.  Either way, loop back to the
+                    # exclusive create — the O_EXCL race stays the one
+                    # and only lock arbiter.
+                    aside = path + f".broken.{self.rank}.{os.getpid()}"
+                    try:
+                        os.rename(path, aside)
+                        os.unlink(aside)
+                    except OSError:
+                        pass
+                    continue
+                if time.monotonic() - t0 > bound_s:
+                    raise PeerLost(
+                        -1,
+                        "fence.lock",
+                        f"fence lock held past {bound_s}s",
+                    ) from None
+                time.sleep(0.005)
+
+    def current_fence(self) -> int:
+        doc = self._read_json(FENCE_NAME)
+        return int(doc["fence"]) if doc else 0
+
+    def acquire_fence(self) -> int:
+        """Atomically increment and return the fence epoch (monotonic
+        across every writer that ever touches this domain dir)."""
+        bound = quorum_timeout_s()
+        lock = self._fence_lock(bound)
+        try:
+            fence = self.current_fence() + 1
+            self._write_json(FENCE_NAME, {"fence": fence})
+            return fence
+        finally:
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
+
+
+def _site_slug(site: str) -> str:
+    return "".join(c if c.isalnum() or c in "._-" else "_" for c in site)
+
+
+class JaxTransport:
+    """Real-mesh transport: the position vector exchanges through
+    ``multihost_utils.process_allgather`` (the same tiny-global-table
+    channel sharded ingest already uses), each call bounded by the
+    dispatch watchdog at the quorum timeout — a dead peer turns the
+    collective into a classified DEADLINE_EXCEEDED instead of a hang,
+    and exhaustion surfaces as :class:`PeerLost` naming the first
+    non-responding rank the runtime reports (or -1 when it cannot).
+    Heartbeats/fences ride a shared filesystem only when
+    ``FA_QUORUM_DIR`` is ALSO set; otherwise fencing is inactive (the
+    single-writer discipline still holds via process_index)."""
+
+    def __init__(self, rank: int, nprocs: int):
+        self.rank = rank
+        self.nprocs = nprocs
+
+    def exchange(self, vec, site: str):
+        import numpy as np
+
+        from fastapriori_tpu.reliability import watchdog
+
+        from jax.experimental import multihost_utils
+
+        def thunk():
+            return multihost_utils.process_allgather(
+                np.asarray(vec, dtype=np.int32)
+            )
+
+        try:
+            return watchdog.guard(
+                thunk, f"quorum.{site}", timeout_s=quorum_timeout_s()
+            )
+        except watchdog.DispatchTimeout as exc:
+            raise PeerLost(
+                -1, site, f"consensus allgather timed out ({exc})"
+            ) from exc
+
+
+# ---------------------------------------------------------------------------
+# the domain
+
+
+class QuorumDomain:
+    """One process's membership in a multi-process fault domain
+    (module docstring).  Thread-safe; one instance per process (see
+    :func:`active`), or constructed directly by tests/harnesses."""
+
+    def __init__(
+        self,
+        transport,
+        rank: int,
+        nprocs: int,
+        consensus: bool = True,
+    ):
+        if nprocs < 1 or not (0 <= rank < nprocs):
+            raise InputError(
+                f"quorum domain needs 0 <= rank < nprocs, got rank="
+                f"{rank} nprocs={nprocs}"
+            )
+        self.transport = transport
+        self.rank = rank
+        self.nprocs = nprocs
+        self.consensus = consensus
+        self._lock = threading.Lock()
+        self._seq = 0
+        # Per-chain agreed position (index into watchdog.CHAINS[chain];
+        # 0 = most capable).  Forward-only, like the cascade.
+        self._pos: Dict[str, int] = {c: 0 for c in CONSENSUS_CHAINS}
+        self._fence: Optional[int] = None
+        self._epoch_trail: List[Dict[str, Any]] = []
+        if isinstance(transport, FileTransport):
+            transport.start_heartbeat()
+            self._publish("init")
+
+    # -- positions ------------------------------------------------------
+    def _chain_order(self, chain: str) -> Tuple[str, ...]:
+        from fastapriori_tpu.reliability import watchdog
+
+        return watchdog.CHAINS[chain]
+
+    def position(self, chain: str) -> int:
+        with self._lock:
+            return self._pos[chain]
+
+    def floor_stage(self, chain: str) -> str:
+        """The agreed most-degraded stage name for ``chain`` — engine
+        resolution clamps its choice at least this far down."""
+        return self._chain_order(chain)[self.position(chain)]
+
+    def stage_allowed(self, chain: str, stage: str) -> bool:
+        """True when ``stage`` is at or below (more degraded than) the
+        agreed floor — i.e. this process may still run it."""
+        order = self._chain_order(chain)
+        return order.index(stage) >= self.position(chain)
+
+    def propose(self, chain: str, stage: str, reason: str = "") -> None:
+        """Raise this process's position for ``chain`` to ``stage`` and
+        PUBLISH immediately (the epoch-stamped proposal: peers see it
+        before their next exchange, which runs before their next
+        dispatch).  Forward-only: a proposal below the current position
+        is a no-op, never a backward walk."""
+        if chain not in self._pos:
+            return
+        idx = self._chain_order(chain).index(stage)
+        with self._lock:
+            if idx <= self._pos[chain]:
+                return
+            self._pos[chain] = idx
+        self._publish(f"propose:{chain}:{reason}")
+
+    def _vector(self) -> List[int]:
+        with self._lock:
+            return [self._pos[c] for c in CONSENSUS_CHAINS]
+
+    def _publish(self, site: str) -> None:
+        if not isinstance(self.transport, FileTransport):
+            return
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            vec = [self._pos[c] for c in CONSENSUS_CHAINS]
+        self.transport.publish_state(
+            {"seq": seq, "site": site, "pos": vec, "t": time.time()}
+        )
+
+    def _adopt(self, peer_vecs: Dict[int, List[int]], site: str) -> None:
+        """Elementwise most-degraded-wins merge; each adoption that a
+        PEER forced lands on the ledger as the standard cascade event
+        (via watchdog.downgrade, reason="quorum") plus a
+        ``quorum_adopt`` event naming the originating rank."""
+        from fastapriori_tpu.reliability import watchdog
+
+        for ci, chain in enumerate(CONSENSUS_CHAINS):
+            best_rank, best = None, self.position(chain)
+            for r, vec in peer_vecs.items():
+                if len(vec) == len(CONSENSUS_CHAINS) and vec[ci] > best:
+                    best, best_rank = vec[ci], r
+            if best_rank is None:
+                continue
+            order = self._chain_order(chain)
+            frm = order[self.position(chain)]
+            to = order[min(best, len(order) - 1)]
+            with self._lock:
+                self._pos[chain] = min(best, len(order) - 1)
+            ledger.record(
+                "quorum_adopt",
+                once_key=f"{chain}:{to}",
+                chain=chain,
+                frm=frm,
+                to=to,
+                rank=best_rank,
+                site=site,
+                epoch=self._seq,
+            )
+            watchdog.downgrade(
+                chain,
+                frm,
+                to,
+                reason="quorum",
+                once_key=f"quorum:{chain}:{to}",
+                # "rank" is the cascade event's own position field;
+                # the originating process rides as src_rank.
+                src_rank=best_rank,
+                site=site,
+            )
+        self._publish(f"adopt:{site}")
+
+    # -- liveness -------------------------------------------------------
+    def _check_peer_alive(
+        self, rank: int, site: str, waited_s: float, bound_s: float
+    ) -> None:
+        """Raise PeerLost when ``rank`` is demonstrably gone: an exit
+        marker without the awaited arrival, or a heartbeat stale past
+        the bound.  A peer that has not STARTED yet is given the full
+        wait bound (subprocess startup skew is not death)."""
+        t = self.transport
+        ex = t.peer_exit(rank)
+        if ex is not None:
+            raise PeerLost(
+                rank, site,
+                f"peer exited ({ex.get('status', '?')}) before reaching "
+                f"this point",
+            )
+        age = t.heartbeat_age(rank)
+        if age is not None and age > bound_s:
+            raise PeerLost(
+                rank, site,
+                f"no heartbeat for {age:.1f}s (bound {bound_s}s, "
+                f"FA_QUORUM_TIMEOUT_S)",
+            )
+        if age is None and waited_s > bound_s:
+            raise PeerLost(
+                rank, site,
+                f"never published a heartbeat within {bound_s}s",
+            )
+
+    # -- sync / rendezvous ----------------------------------------------
+    def sync(self, site: str, wait: bool = False) -> None:
+        """The consensus exchange at ``site``.  Non-blocking form
+        (default): publish my positions, read every peer's CURRENT
+        state, adopt most-degraded, and check heartbeats — one poll, no
+        rendezvous.  ``wait=True``: a true rendezvous — block (bounded)
+        until every peer has posted THIS site's marker, detecting a
+        killed peer within the bound; with ``consensus=False`` the
+        rendezvous additionally compares collective digests and raises
+        :class:`MeshDivergence` on mismatch (the raw-mesh demo).
+
+        The whole exchange runs under the standard bounded retry
+        (site ``quorum.<site>``), so a transient flap — including an
+        armed failpoint — is absorbed and exhaustion is classified;
+        worst case stall is attempts × FA_QUORUM_TIMEOUT_S."""
+        if self.nprocs == 1:
+            return
+        if isinstance(self.transport, JaxTransport) and not wait:
+            # The real-mesh exchange is itself a collective: every rank
+            # must call it the same number of times, but the
+            # non-blocking boundary syncs fire a DIFFERENT number of
+            # times once a rank walks an engine chain (that is the
+            # whole point).  Real meshes therefore exchange only at the
+            # rendezvous points every rank passes exactly once
+            # (run.start / mine.end / rules.start / run.end); mid-mine
+            # adoption granularity is a file-transport property.
+            return
+        from fastapriori_tpu.obs import flight
+        from fastapriori_tpu.reliability import retry
+
+        def attempt():
+            if isinstance(self.transport, JaxTransport):
+                self._sync_jax(site)
+            else:
+                self._sync_file(site, wait)
+
+        try:
+            retry.call_with_retries(attempt, f"quorum.{_site_slug(site)}")
+        except (PeerLost, MeshDivergence) as exc:
+            # The post-mortem: the consensus epoch trail (every sync
+            # this domain ran, with positions) rides the flight dump.
+            kind = type(exc).__name__
+            ledger.record(
+                "peer_lost" if isinstance(exc, PeerLost) else
+                "mesh_divergence",
+                site=site,
+                rank=getattr(exc, "rank", -1),
+                error=str(exc)[:200],
+            )
+            flight.auto_dump(
+                kind,
+                extra={
+                    "site": site,
+                    "rank": self.rank,
+                    "epoch_trail": self.epoch_trail(),
+                },
+            )
+            raise
+        with self._lock:
+            self._epoch_trail.append(
+                {
+                    "epoch": self._seq,
+                    "site": site,
+                    "pos": [self._pos[c] for c in CONSENSUS_CHAINS],
+                }
+            )
+            trail = self._epoch_trail[-1]
+        flight.note("quorum", **trail)
+
+    def _sync_jax(self, site: str) -> None:
+        import numpy as np
+
+        vec = np.asarray([self.rank] + self._vector(), dtype=np.int32)
+        gathered = self.transport.exchange(vec, _site_slug(site))
+        peer_vecs = {
+            int(row[0]): [int(x) for x in row[1:]]
+            for row in np.asarray(gathered)
+            if int(row[0]) != self.rank
+        }
+        if self.consensus:
+            self._adopt(peer_vecs, site)
+
+    def _sync_file(self, site: str, wait: bool) -> None:
+        t = self.transport
+        bound = quorum_timeout_s()
+        my_vec = self._vector()
+        digest = f"{_site_slug(site)}|" + ",".join(map(str, my_vec))
+        self._publish(f"sync:{site}")
+        if wait or not self.consensus:
+            t.post_marker(site, {"pos": my_vec, "digest": digest})
+        peers = [r for r in range(self.nprocs) if r != self.rank]
+        peer_vecs: Dict[int, List[int]] = {}
+        t0 = time.monotonic()
+        pending = list(peers)
+        while True:
+            still: List[int] = []
+            for r in pending:
+                if wait or not self.consensus:
+                    doc = t.peer_marker(site, r)
+                else:
+                    doc = t.peer_state(r)
+                if doc is None:
+                    still.append(r)
+                    continue
+                peer_vecs[r] = list(doc.get("pos", []))
+                if not self.consensus and "digest" in doc and (
+                    doc["digest"] != digest
+                ):
+                    raise MeshDivergence(
+                        f"ABORTED: mesh divergence at {site!r}: rank "
+                        f"{self.rank} would issue {digest!r} while rank "
+                        f"{r} issues {doc['digest']!r} — without cascade "
+                        "consensus these collectives can never match "
+                        "(the raw mesh hangs here; this bound is the "
+                        "watchdog)"
+                    )
+                # Adopting from the peer's last PUBLISHED state also
+                # covers the non-blocking path: proposals publish
+                # immediately at downgrade time.
+            waited = time.monotonic() - t0
+            if not still and (wait or not self.consensus):
+                break
+            if not (wait or not self.consensus):
+                # Non-blocking poll: whoever has published, we saw.
+                break
+            for r in still:
+                self._check_peer_alive(r, site, waited, bound)
+            if waited > bound:
+                raise PeerLost(
+                    still[0] if still else -1,
+                    site,
+                    f"rendezvous incomplete after {bound}s "
+                    f"(waiting on ranks {still})",
+                )
+            pending = still
+            time.sleep(min(0.005, bound / 10))
+        # Liveness check even on the non-blocking path: a peer whose
+        # STATE file is present but whose heartbeat has gone stale is
+        # dead, and must surface at the next level boundary, not only
+        # at the next rendezvous.  A peer already collected THIS round
+        # is only judged by heartbeat age (it may legitimately exit
+        # right after a final rendezvous); one never seen gets the full
+        # exit-marker/staleness check, with the full bound for startup
+        # skew.
+        waited = time.monotonic() - t0
+        for r in peers:
+            if r in peer_vecs:
+                age = t.heartbeat_age(r)
+                if age is not None and age > bound:
+                    raise PeerLost(
+                        r, site,
+                        f"no heartbeat for {age:.1f}s (bound {bound}s, "
+                        "FA_QUORUM_TIMEOUT_S)",
+                    )
+            else:
+                self._check_peer_alive(r, site, waited, bound)
+        if self.consensus:
+            self._adopt(peer_vecs, site)
+
+    def epoch_trail(self) -> List[Dict[str, Any]]:
+        """Every sync this domain ran (epoch, site, positions) — the
+        consensus history a PeerLost/chaos-FAIL flight dump ships."""
+        with self._lock:
+            return [dict(e) for e in self._epoch_trail]
+
+    # -- fenced checkpoints ---------------------------------------------
+    def is_writer(self) -> bool:
+        return self.rank == 0
+
+    def checkpoint_fence(self) -> int:
+        """The fence epoch this process's checkpoint commits carry:
+        acquired ONCE per run (monotonic across writers sharing the
+        domain dir), then validated against the authoritative FENCE at
+        every commit — a superseded writer is rejected, never allowed
+        to publish a mixed-epoch artifact."""
+        if not isinstance(self.transport, FileTransport):
+            return 0
+        with self._lock:
+            if self._fence is None:
+                self._fence = self.transport.acquire_fence()
+            fence = self._fence
+        current = self.transport.current_fence()
+        if current > fence:
+            raise StaleFenceError(
+                f"stale checkpoint fence: this writer holds fence "
+                f"{fence} but the domain has advanced to {current} — a "
+                "newer coordinator owns the checkpoint; refusing the "
+                "split-brain commit"
+            )
+        return fence
+
+    def validate_resume_fence(self, fence: Optional[int]) -> None:
+        """Resume-side fence validation: a checkpoint stamped with a
+        fence older than the domain's current FENCE was written by a
+        superseded coordinator and must not seed a resume."""
+        if not isinstance(self.transport, FileTransport):
+            return
+        if fence is None:
+            return
+        current = self.transport.current_fence()
+        if current and fence < current:
+            raise StaleFenceError(
+                f"stale checkpoint fence {fence}: the domain's fence "
+                f"has advanced to {current} — this checkpoint was "
+                "written by a superseded coordinator (split-brain); "
+                "resume from the current writer's checkpoint"
+            )
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, status: str = "done") -> None:
+        if isinstance(self.transport, FileTransport):
+            self.transport.post_exit(status)
+            self.transport.stop_heartbeat()
+
+
+# ---------------------------------------------------------------------------
+# process-wide domain resolution (memoized; every engine hook costs one
+# attribute read on the inactive single-process path)
+
+_domain: Optional[QuorumDomain] = None
+_resolved = False
+_resolve_lock = threading.Lock()
+
+
+def _resolve() -> Optional[QuorumDomain]:
+    # lint: env-ok -- free-form path knob: every string is a valid directory (rank/procs below parse strictly)
+    root = os.environ.get("FA_QUORUM_DIR", "").strip()
+    if root:
+        from fastapriori_tpu.utils.env import env_int
+
+        nprocs = env_int("FA_QUORUM_PROCS", 1, minimum=1)
+        rank = env_int("FA_QUORUM_RANK", 0, minimum=0)
+        if rank >= nprocs:
+            raise InputError(
+                f"FA_QUORUM_RANK={rank} is out of range for "
+                f"FA_QUORUM_PROCS={nprocs} (ranks are 0-based)"
+            )
+        dom = QuorumDomain(
+            FileTransport(root, rank, nprocs), rank, nprocs
+        )
+        atexit.register(dom.close, "atexit")
+        return dom
+    try:
+        import jax
+
+        nprocs = jax.process_count()
+        if nprocs > 1:
+            return QuorumDomain(
+                JaxTransport(jax.process_index(), nprocs),
+                jax.process_index(),
+                nprocs,
+            )
+    # lint: waive G006 -- no backend yet: single-process domain resolution must not force one
+    except Exception:  # pragma: no cover - backend not initialized
+        pass
+    return None
+
+
+def active() -> Optional[QuorumDomain]:
+    """The process-wide domain, or None (single process — the fast
+    path: one memoized read)."""
+    global _domain, _resolved
+    if _resolved:
+        return _domain
+    with _resolve_lock:
+        if not _resolved:
+            _domain = _resolve()
+            _resolved = True
+    return _domain
+
+
+def set_domain(domain: Optional[QuorumDomain]) -> None:
+    """Install a domain explicitly (tests/harnesses)."""
+    global _domain, _resolved
+    _domain = domain
+    _resolved = True
+
+
+def reload_from_env() -> None:
+    """Drop the memoized domain so FA_QUORUM_* is re-read (tests)."""
+    global _domain, _resolved
+    if _domain is not None:
+        _domain.close("reload")
+    _domain = None
+    _resolved = False
+
+
+# -- thin module-level hooks (all no-ops without a domain) ---------------
+
+
+def propose(chain: str, stage: str, reason: str = "") -> None:
+    dom = active()
+    if dom is not None:
+        dom.propose(chain, stage, reason)
+
+
+def sync(site: str, wait: bool = False) -> None:
+    dom = active()
+    if dom is not None:
+        dom.sync(site, wait=wait)
+
+
+def stage_allowed(chain: str, stage: str) -> bool:
+    dom = active()
+    return dom is None or dom.stage_allowed(chain, stage)
+
+
+def floor_stage(chain: str) -> Optional[str]:
+    dom = active()
+    return None if dom is None else dom.floor_stage(chain)
+
+
+def is_writer() -> bool:
+    """True when this process owns artifact/checkpoint writes (quorum
+    rank 0; every process when no domain is active — jax.process_index
+    gating stays with the callers)."""
+    dom = active()
+    return dom is None or dom.is_writer()
+
+
+def checkpoint_fence() -> int:
+    dom = active()
+    return 0 if dom is None else dom.checkpoint_fence()
+
+
+def validate_resume_fence(fence: Optional[int]) -> None:
+    dom = active()
+    if dom is not None:
+        dom.validate_resume_fence(fence)
+
+
+def rank_suffix() -> str:
+    """``".rank<r>"`` on multi-process domains (per-process trace /
+    flight artifacts must not clobber each other), else ""."""
+    dom = active()
+    if dom is None or dom.nprocs == 1:
+        return ""
+    return f".rank{dom.rank}"
+
+
+def rank_path(path: str) -> str:
+    """Insert the rank suffix before ``path``'s final extension (or
+    append when there is none): ``out.trace.json`` →
+    ``out.trace.rank1.json``."""
+    suffix = rank_suffix()
+    if not suffix:
+        return path
+    base, dot, ext = path.rpartition(".")
+    if dot and "/" not in ext and os.sep not in ext:
+        return f"{base}{suffix}.{ext}"
+    return path + suffix

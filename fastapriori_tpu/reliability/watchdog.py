@@ -109,6 +109,20 @@ def downgrade(
             f"cascade {chain}: {frm!r} -> {to!r} walks backward "
             f"(chain order {order})"
         )
+    if reason != "quorum":
+        # Cross-process cascade consensus (ISSUE 12): a LOCAL walk of a
+        # collective-shaping chain becomes an epoch-stamped proposal
+        # published to the fault domain the moment it happens — peers
+        # adopt the most-degraded position at their next exchange,
+        # BEFORE their next dispatch, so divergent collectives are
+        # impossible by construction.  Adoptions arrive back through
+        # this same function with reason="quorum" (the guard above
+        # keeps them from re-proposing in a loop).  No-op without a
+        # multi-process domain.
+        from fastapriori_tpu.reliability import quorum
+
+        if chain in quorum.CONSENSUS_CHAINS:
+            quorum.propose(chain, to, reason)
     ledger.record(
         "cascade",
         once_key=once_key or f"{chain}:{frm}>{to}:{reason}",

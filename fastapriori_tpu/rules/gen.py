@@ -31,7 +31,7 @@ import numpy as np
 from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.obs import trace
 from fastapriori_tpu.ops.bitmap import next_pow2 as _next_pow2
-from fastapriori_tpu.reliability import ledger, retry, watchdog
+from fastapriori_tpu.reliability import ledger, quorum, retry, watchdog
 
 Rule = Tuple[FrozenSet[int], int, float]  # (antecedent, consequent, confidence)
 
@@ -93,8 +93,16 @@ def resolve_rule_shards(context, config) -> int:
         and jax.process_count() == 1
         and context.cand_shards == 1
     )
+    # Consensus floor (ISSUE 12): once any peer degraded the rule chain
+    # past "sharded", the multi-shard join's exchanges are off the
+    # table domain-wide; the device-0 engine still runs.  Judged
+    # SEPARATELY from mesh eligibility: an explicit multi-shard request
+    # on an ineligible MESH is a config error, but the same request
+    # under a peer's degradation must degrade in lockstep (ledger
+    # event), not blame the user's valid config for a flake.
+    quorum_ok = quorum.stage_allowed("rule_engine", "sharded")
     if req == 0:
-        return context.txn_shards if eligible else 1
+        return context.txn_shards if (eligible and quorum_ok) else 1
     if req == 1:
         return 1
     if not eligible:
@@ -109,6 +117,15 @@ def resolve_rule_shards(context, config) -> int:
             f"({context.txn_shards} shards): phase 2 shards over the "
             "existing mesh, it cannot carve a sub-mesh"
         )
+    if not quorum_ok:
+        # The cascade event for this walk was already recorded when the
+        # domain adopted the peer's position; this records the local
+        # consequence (the pinned shard count) without double-walking.
+        ledger.record(
+            "rule_gen_fallback", once_key="quorum_shards",
+            reason="quorum", requested_shards=req,
+        )
+        return 1
     return req
 
 
@@ -197,6 +214,18 @@ def _pick_rule_engine(mats, context, config) -> str:
     if engine == "host":
         return "host"
     raw = _raw_rule_count(mats)
+    if not quorum.stage_allowed("rule_engine", "device"):
+        # Consensus floor (ISSUE 12): a peer already walked phase 2 to
+        # the host oracle — device/sharded joins would issue collectives
+        # it will never match.
+        if engine == "device":
+            ledger.record(
+                "rule_gen_fallback", reason="quorum", raw_rules=raw
+            )
+            watchdog.downgrade(
+                "rule_engine", "device", "host", reason="quorum"
+            )
+        return "host"
     if context is None:
         if engine == "device":
             ledger.record(
@@ -387,6 +416,13 @@ def rule_arrays_from_tables(
     ``scan_state`` (a :class:`DeviceRuleState`) additionally keeps the
     per-level device state resident for the recommender's on-device
     scan-table build."""
+    # Phase-2 consensus exchange (ISSUE 12): adopt any cascade position
+    # a peer walked during mining BEFORE resolving the rule engine, so
+    # phase 2's first dispatch is already lockstep.  A rendezvous, not
+    # a poll: every rank enters phase 2 exactly once, and the real-mesh
+    # (JaxTransport) exchange only runs at rendezvous points — its
+    # allgather must be called collectively.  No-op without a domain.
+    quorum.sync("rules.start", wait=True)
     engine = _pick_rule_engine(mats, context, config)
     if engine == "device":
         shards = resolve_rule_shards(context, config)

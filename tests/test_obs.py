@@ -158,6 +158,36 @@ def test_tracer_event_cap_counts_drops():
     assert tr.chrome_trace()["otherData"]["dropped_events"] == 2
 
 
+def test_tracer_event_cap_knob(monkeypatch):
+    """FA_TRACE_EVENTS (ISSUE 12 satellite / ROADMAP obs residue):
+    raises the 200K default ceiling for a deliberate webdocs-scale
+    capture — strictly parsed, default and counted-drop behavior
+    unchanged."""
+    from fastapriori_tpu.errors import InputError
+
+    assert trace.max_events_from_env() == trace.DEFAULT_MAX_EVENTS
+    monkeypatch.setenv("FA_TRACE_EVENTS", "5")
+    trace.reload_from_env()
+    tr = Tracer()
+    assert tr.max_events == 5
+    tr.enable()
+    for _ in range(7):
+        with tr.span("s"):
+            pass
+    assert len(tr.events()) == 5 and tr.dropped == 2
+    # The process-wide singleton follows the reload too.
+    assert TRACER.max_events == 5
+    monkeypatch.setenv("FA_TRACE_EVENTS", "many")
+    with pytest.raises(InputError, match="FA_TRACE_EVENTS"):
+        trace.reload_from_env()
+    monkeypatch.setenv("FA_TRACE_EVENTS", "0")
+    with pytest.raises(InputError, match="out of range"):
+        Tracer()
+    monkeypatch.delenv("FA_TRACE_EVENTS")
+    trace.reload_from_env()
+    assert TRACER.max_events == trace.DEFAULT_MAX_EVENTS
+
+
 def test_fetch_spans_cover_declared_sites():
     """An audited fetch produces a span named fetch.<site>, and the
     G014 census declaration stays truthful: every declared name has the

@@ -1769,3 +1769,453 @@ def test_validate_checkpoint_rejects_corrupt_lattice(tmp_path):
     ]
     ckpt.save_checkpoint(prefix, good, _meta())
     assert ckpt.validate_checkpoint(prefix) == _meta()
+
+
+# ---------------------------------------------------------------------------
+# multi-process fault domain (ISSUE 12): cascade consensus, peer-death
+# detection, fenced checkpoints — file-transport domains exercised
+# in-process (threads sharing a quorum dir), plus real 2/4-subprocess
+# meshes through tools/chaos.py's --procs harness.  The real
+# jax.distributed transport version-gates in tests/test_distributed.py.
+
+import threading as _threading
+import time as _time
+
+from fastapriori_tpu.reliability import quorum
+
+
+@pytest.fixture(autouse=True)
+def _clean_quorum_state():
+    quorum.set_domain(None)
+    yield
+    quorum.set_domain(None)
+
+
+@pytest.fixture
+def qroot(tmp_path, monkeypatch):
+    """Tight bounds so peer-death tests stay fast: one exchange waits
+    at most 1 s, heartbeats publish every 40 ms."""
+    monkeypatch.setenv("FA_QUORUM_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("FA_HEARTBEAT_MS", "40")
+    return str(tmp_path / "q")
+
+
+def _domain_pair(root, consensus=True):
+    d0 = quorum.QuorumDomain(
+        quorum.FileTransport(root, 0, 2), 0, 2, consensus=consensus
+    )
+    d1 = quorum.QuorumDomain(
+        quorum.FileTransport(root, 1, 2), 1, 2, consensus=consensus
+    )
+    return d0, d1
+
+
+def test_quorum_positions_forward_only(qroot):
+    d = quorum.QuorumDomain(quorum.FileTransport(qroot, 0, 1), 0, 1)
+    assert d.floor_stage("engine") == "fused"
+    d.propose("engine", "level", "test")
+    assert d.floor_stage("engine") == "level"
+    assert not d.stage_allowed("engine", "fused")
+    assert not d.stage_allowed("engine", "tail")
+    assert d.stage_allowed("engine", "level")
+    # Forward-only: a later less-degraded proposal can never move the
+    # position back up the chain.
+    d.propose("engine", "tail", "test")
+    assert d.floor_stage("engine") == "level"
+    # Non-consensus chains are ignored (host-local, never collective).
+    d.propose("rule_scan", "host", "test")
+    assert "rule_scan" not in d._pos
+    d.close()
+
+
+def test_quorum_wire_order_pinned():
+    """The exchanged position vector's chain order is the protocol —
+    reordering is a wire-format change (pin it)."""
+    assert quorum.CONSENSUS_CHAINS == (
+        "engine", "mine_engine", "count_reduce", "rule_engine",
+    )
+    for chain in quorum.CONSENSUS_CHAINS:
+        assert chain in watchdog.CHAINS
+
+
+def test_quorum_most_degraded_wins_with_originating_rank(qroot):
+    """A peer's local degradation is adopted by everyone at the next
+    exchange, ledger-recorded with the originating rank AND as the
+    standard cascade event (lockstep degradation, the acceptance
+    pin)."""
+    d0, d1 = _domain_pair(qroot)
+    try:
+        d1.propose("count_reduce", "dense", "transient_exhausted")
+        t = _threading.Thread(target=lambda: d1.sync("level.3"))
+        t.start()
+        d0.sync("level.3")
+        t.join()
+        assert d0.floor_stage("count_reduce") == "dense"
+        events = ledger.snapshot()
+        adopt = [e for e in events if e["kind"] == "quorum_adopt"]
+        assert adopt and adopt[0]["chain"] == "count_reduce"
+        assert adopt[0]["rank"] == 1  # the originating rank
+        casc = [
+            e for e in events
+            if e["kind"] == "cascade" and e.get("reason") == "quorum"
+        ]
+        assert casc and casc[0]["src_rank"] == 1
+    finally:
+        d0.close()
+        d1.close()
+
+
+def test_quorum_downgrade_composes_with_cascade(qroot):
+    """watchdog.downgrade IS the proposal channel: a local chain walk
+    on a collective-shaping chain publishes immediately (forward-only
+    composition with PR 9's cascade)."""
+    d0, d1 = _domain_pair(qroot)
+    quorum.set_domain(d0)
+    try:
+        watchdog.downgrade(
+            "engine", "fused", "level", reason="transient_exhausted"
+        )
+        assert d0.floor_stage("engine") == "level"
+        # The published state is already visible to a peer's poll.
+        t = _threading.Thread(target=lambda: d1.sync("level.2"))
+        t.start()
+        t.join()
+        assert d1.floor_stage("engine") == "level"
+        # Host-local chains do not touch the domain.
+        watchdog.downgrade("rule_scan", "device", "host", reason="x")
+        assert "rule_scan" not in d0._pos
+    finally:
+        quorum.set_domain(None)
+        d0.close()
+        d1.close()
+
+
+def test_quorum_epoch_monotonic(qroot):
+    d0, d1 = _domain_pair(qroot)
+    try:
+        for k in (2, 3, 4):
+            t = _threading.Thread(
+                target=lambda k=k: d1.sync(f"level.{k}")
+            )
+            t.start()
+            d0.sync(f"level.{k}")
+            t.join()
+        trail = d0.epoch_trail()
+        assert [e["site"] for e in trail] == [
+            "level.2", "level.3", "level.4",
+        ]
+        epochs = [e["epoch"] for e in trail]
+        assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs)
+    finally:
+        d0.close()
+        d1.close()
+
+
+def test_quorum_peer_lost_bounded_naming_rank(qroot):
+    """A rendezvous against a peer that never starts surfaces as a
+    classified PeerLost NAMING THE RANK within attempts x
+    FA_QUORUM_TIMEOUT_S — never an indefinite wait."""
+    d0 = quorum.QuorumDomain(quorum.FileTransport(qroot, 0, 2), 0, 2)
+    try:
+        t0 = _time.monotonic()
+        with pytest.raises(quorum.PeerLost, match="rank 1"):
+            d0.sync("run.start", wait=True)
+        elapsed = _time.monotonic() - t0
+        # 3 attempts x 1 s bound + backoff, with generous slack.
+        assert elapsed < 8.0, elapsed
+        # Classified transient (UNAVAILABLE) — the retry layer's shot
+        # already happened; what escapes is the classified error.
+        try:
+            d0.sync("run.start", wait=True)
+        except quorum.PeerLost as e:
+            assert retry.classify(e) == "transient"
+        assert any(
+            e["kind"] == "peer_lost" for e in ledger.snapshot()
+        )
+    finally:
+        d0.close()
+
+
+def test_quorum_peer_exit_marker_fails_fast(qroot):
+    """A peer that DIED (classified exit) is detected from its exit
+    marker immediately — no staleness wait."""
+    d0, d1 = _domain_pair(qroot)
+    d1.close("crashed")  # posts the exit marker, stops heartbeats
+    try:
+        t0 = _time.monotonic()
+        with pytest.raises(quorum.PeerLost, match="rank 1"):
+            d0.sync("mine.end", wait=True)
+        assert _time.monotonic() - t0 < 2.0
+    finally:
+        d0.close()
+
+
+def test_divergence_without_consensus_bounded_with_consensus_lockstep(
+    qroot,
+):
+    """THE acceptance pin: the same divergence (rank 1 walked the
+    engine chain, rank 0 did not) HANGS a raw mesh — modeled by the
+    consensus-off rendezvous, bounded by the quorum watchdog into a
+    classified MeshDivergence — while with consensus ON both ranks
+    converge and proceed in lockstep."""
+    # Without consensus: digests differ -> bounded classified error.
+    nc0, nc1 = _domain_pair(qroot + ".nc", consensus=False)
+    nc1.propose("engine", "level", "injected")
+    errs = []
+
+    def go(d):
+        try:
+            d.sync("level.4", wait=True)
+        except (quorum.MeshDivergence, quorum.PeerLost) as e:
+            errs.append(e)
+
+    t0 = _time.monotonic()
+    t = _threading.Thread(target=go, args=(nc1,))
+    t.start()
+    go(nc0)
+    t.join()
+    elapsed = _time.monotonic() - t0
+    assert any(isinstance(e, quorum.MeshDivergence) for e in errs)
+    assert elapsed < 8.0, elapsed
+    for e in errs:
+        if isinstance(e, quorum.MeshDivergence):
+            assert retry.classify(e) == "transient"  # ABORTED status
+    nc0.close()
+    nc1.close()
+
+    # Consensus-off sanity: agreeing ranks rendezvous cleanly.
+    ok0, ok1 = _domain_pair(qroot + ".ok", consensus=False)
+    t = _threading.Thread(target=lambda: ok1.sync("level.2", wait=True))
+    t.start()
+    ok0.sync("level.2", wait=True)
+    t.join()
+    ok0.close()
+    ok1.close()
+
+    # With consensus: the SAME divergence converges — rank 0 adopts
+    # and both floors agree (lockstep degradation instead of a hang).
+    ledger.reset()
+    c0, c1 = _domain_pair(qroot + ".c")
+    c1.propose("engine", "level", "injected")
+    t = _threading.Thread(target=lambda: c1.sync("level.4", wait=True))
+    t.start()
+    c0.sync("level.4", wait=True)
+    t.join()
+    assert c0.floor_stage("engine") == "level"
+    assert c1.floor_stage("engine") == "level"
+    assert any(
+        e["kind"] == "quorum_adopt" and e["rank"] == 1
+        for e in ledger.snapshot()
+    )
+    c0.close()
+    c1.close()
+
+
+# -- fenced checkpoints -----------------------------------------------
+
+
+def test_fence_monotonic_and_stale_writer_rejected(qroot):
+    """The split-brain pin: an old coordinator whose fence was
+    superseded must be REJECTED at commit time, classified."""
+    old = quorum.QuorumDomain(quorum.FileTransport(qroot, 0, 2), 0, 2)
+    fence_old = old.checkpoint_fence()
+    # A new coordinator (same domain dir — the flap's replacement
+    # writer) acquires the next fence.
+    new = quorum.QuorumDomain(quorum.FileTransport(qroot, 0, 2), 0, 2)
+    fence_new = new.checkpoint_fence()
+    assert fence_new == fence_old + 1
+    with pytest.raises(quorum.StaleFenceError, match="checkpoint fence"):
+        old.checkpoint_fence()  # the stale writer's next commit
+    assert isinstance(quorum.StaleFenceError("x"), InputError)
+    assert new.checkpoint_fence() == fence_new  # current writer is fine
+    old.close()
+    new.close()
+
+
+def test_checkpoint_fence_roundtrip_and_stale_resume_rejected(
+    tmp_path, qroot
+):
+    """save_checkpoint stamps the fence into the meta AND the manifest;
+    a resume against a domain whose FENCE has advanced rejects the
+    stale artifact (classified), while the current-epoch checkpoint
+    loads cleanly."""
+    prefix = str(tmp_path / "out") + "/"
+    levels = [(np.array([[0, 1]], np.int32), np.array([9], np.int64))]
+    writer_dom = quorum.QuorumDomain(
+        quorum.FileTransport(qroot, 0, 2), 0, 2
+    )
+    fence = writer_dom.checkpoint_fence()
+    ckpt.save_checkpoint(prefix, levels, dict(_meta(), fence=fence))
+    assert resume_io.manifest_fence(prefix) == fence
+    # Current epoch: loads, fence round-trips through the meta.
+    quorum.set_domain(writer_dom)
+    lv, meta = ckpt.load_checkpoint(prefix)
+    assert meta["fence"] == fence
+    # check_meta ignores the fence slot (writer identity, not dataset).
+    ckpt.check_meta(meta, prefix=prefix, **_meta())
+    # A NEW coordinator advances the fence; the old artifact is now a
+    # split-brain relic and must not seed a resume.
+    quorum.QuorumDomain(
+        quorum.FileTransport(qroot, 0, 2), 0, 2
+    ).checkpoint_fence()
+    with pytest.raises(quorum.StaleFenceError, match="stale checkpoint"):
+        ckpt.load_checkpoint(prefix)
+    quorum.set_domain(None)
+    # Without a domain the fence is informational: still loadable.
+    lv2, meta2 = ckpt.load_checkpoint(prefix)
+    assert meta2["fence"] == fence
+    writer_dom.close()
+
+
+def test_checkpoint_unfenced_stays_compatible(tmp_path):
+    """Single-process checkpoints (no domain) carry fence 0 and a
+    4/5-slot meta both load — no fence key, no manifest fence."""
+    prefix = str(tmp_path) + "/"
+    levels = [(np.array([[0, 1]], np.int32), np.array([9], np.int64))]
+    ckpt.save_checkpoint(prefix, levels, _meta())
+    assert resume_io.manifest_fence(prefix) is None
+    _, meta = ckpt.load_checkpoint(prefix)
+    assert "fence" not in meta
+
+
+# -- knobs / plumbing -------------------------------------------------
+
+
+def test_quorum_knob_strictness(monkeypatch):
+    monkeypatch.setenv("FA_QUORUM_TIMEOUT_S", "soon")
+    with pytest.raises(InputError, match="FA_QUORUM_TIMEOUT_S"):
+        quorum.quorum_timeout_s()
+    monkeypatch.setenv("FA_QUORUM_TIMEOUT_S", "0.0")
+    with pytest.raises(InputError, match="out of range"):
+        quorum.quorum_timeout_s()
+    monkeypatch.delenv("FA_QUORUM_TIMEOUT_S")
+    monkeypatch.setenv("FA_HEARTBEAT_MS", "fast")
+    with pytest.raises(InputError, match="FA_HEARTBEAT_MS"):
+        quorum.heartbeat_ms()
+    monkeypatch.delenv("FA_HEARTBEAT_MS")
+    monkeypatch.setenv("FA_QUORUM_DIR", "/tmp/nope")
+    monkeypatch.setenv("FA_QUORUM_PROCS", "2")
+    monkeypatch.setenv("FA_QUORUM_RANK", "2")
+    quorum.reload_from_env()
+    with pytest.raises(InputError, match="FA_QUORUM_RANK"):
+        quorum.active()
+    quorum.set_domain(None)
+
+
+def test_quorum_rank_path_suffix(qroot):
+    assert quorum.rank_path("out.trace.json") == "out.trace.json"
+    dom = quorum.QuorumDomain(quorum.FileTransport(qroot, 1, 2), 1, 2)
+    quorum.set_domain(dom)
+    try:
+        assert quorum.rank_suffix() == ".rank1"
+        assert quorum.rank_path("out.trace.json") == "out.trace.rank1.json"
+        assert quorum.rank_path("noext") == "noext.rank1"
+    finally:
+        quorum.set_domain(None)
+        dom.close()
+
+
+def test_flight_merge_orders_across_ranks(tmp_path):
+    """tools/flight_merge.py: per-rank dumps interleave into one
+    chronological stream tagged by source rank."""
+    from fastapriori_tpu.obs import flight as _flight
+    from tools.flight_merge import merge_flights
+
+    out = str(tmp_path) + "/"
+    r0 = _flight.FlightRecorder(cap=16)
+    r0.note("ledger", event="a")
+    p0 = r0.dump(out + "rank0.", "test r0")
+    _time.sleep(0.02)
+    r1 = _flight.FlightRecorder(cap=16)
+    r1.note("ledger", event="b")
+    r1.note("quorum", epoch=1, site="level.2")
+    p1 = r1.dump(out + "rank1.", "test r1")
+    merged = merge_flights([p0, p1])
+    assert [s["src"] for s in merged["sources"]] == ["rank0", "rank1"]
+    assert len(merged["events"]) == 3
+    times = [e["t_abs_s"] for e in merged["events"]]
+    assert times == sorted(times)
+    assert merged["events"][0]["src"] == "rank0"
+    assert {e["src"] for e in merged["events"]} == {"rank0", "rank1"}
+
+
+# -- real 2/4-subprocess meshes (tools/chaos.py --procs harness) -------
+
+
+@pytest.fixture(scope="module")
+def mp_fixture(tmp_path_factory):
+    """Shared inputs + clean-run baseline for the subprocess-mesh
+    scenarios (one in-process clean mine, reused by every case)."""
+    from fastapriori_tpu.cli import main as cli_main
+    from tools import chaos
+
+    root = str(tmp_path_factory.mktemp("mp"))
+    inp = chaos.make_inputs(root)
+    out_clean = os.path.join(root, "clean") + os.sep
+    os.makedirs(out_clean)
+    assert cli_main([inp, out_clean, "--min-support", "0.08"]) == 0
+    clean = {
+        n: open(out_clean + n, "rb").read()
+        for n in ("freqItemset", "recommends")
+    }
+    return root, inp, clean
+
+
+def _mp_schedule_of_kind(kind, procs, start=0):
+    from tools import chaos
+
+    for seed in range(start, start + 400):
+        sch = chaos.make_mp_schedule(seed, procs)
+        if sch["kind"] == kind:
+            return sch
+    raise AssertionError(f"no {kind} schedule in range")
+
+
+def test_mp_two_process_kill_mid_level(mp_fixture):
+    """Kill-one-rank-mid-level on a real 2-subprocess mesh: the killed
+    rank dies on its injected abort; the survivor must NOT hang — it
+    classifies the loss naming the dead rank (PeerLost exit 3) or
+    finishes; never silent divergence, never a mixed-epoch
+    checkpoint."""
+    from tools import chaos
+
+    root, inp, clean = mp_fixture
+    sch = _mp_schedule_of_kind("kill", 2)
+    out = chaos.run_mp_scenario(sch, inp, root, clean, timeout_s=120.0)
+    assert out.kind == "classified", out.detail
+
+
+def test_mp_two_process_divergence_lockstep(mp_fixture):
+    """Divergence injection (failpoint arming a chain walk on one rank
+    only) on a real 2-subprocess mesh: with cascade consensus the run
+    COMPLETES — the target walks its chain, the peer adopts
+    (quorum_adopt), and all outputs stay byte-identical."""
+    from tools import chaos
+
+    root, inp, clean = mp_fixture
+    sch = _mp_schedule_of_kind("divergence", 2)
+    out = chaos.run_mp_scenario(sch, inp, root, clean, timeout_s=120.0)
+    assert out.kind == "degraded", out.detail
+
+
+def test_mp_four_process_divergence(mp_fixture):
+    """The 4-process flavor: one rank's walk must reach THREE peers."""
+    from tools import chaos
+
+    root, inp, clean = mp_fixture
+    sch = _mp_schedule_of_kind("divergence", 4)
+    out = chaos.run_mp_scenario(sch, inp, root, clean, timeout_s=150.0)
+    assert out.kind == "degraded", out.detail
+
+
+def test_mp_schedule_deterministic():
+    from tools import chaos
+
+    for seed in range(30):
+        a = chaos.make_mp_schedule(seed, 2)
+        b = chaos.make_mp_schedule(seed, 2)
+        assert a == b
+        assert a["kind"] in chaos.MP_KINDS
+        for spec in a["failpoints_by_rank"].values():
+            site, _, rest = spec.partition(":")
+            failpoints.parse_spec(f"{site}:{rest}")  # armable

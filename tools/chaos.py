@@ -370,6 +370,370 @@ def run_scenario(
     return Outcome(kind, f"degraded={degraded} under {armed}")
 
 
+# ---------------------------------------------------------------------------
+# multi-process fault domain soak (ISSUE 12): real subprocess meshes
+# coordinated through the file-transport quorum (reliability/quorum.py)
+# — the simulated-multiprocess harness made real with actual processes,
+# because the pinned jax 0.4.37 CPU backend refuses multiprocess
+# computations (the real 2-process transport version-gates with
+# tests/test_distributed.py).  The invariant EXTENDS the single-process
+# one: all surviving ranks agree byte-identically, or all failing ranks
+# fail classified naming a rank/site; never a hang, never a mixed-epoch
+# artifact.
+
+MP_KINDS = ("kill", "divergence", "flap", "hb_delay")
+
+# Divergence injections: a transient-exhaustion spec that walks ONE
+# consensus chain on the target rank only (oom*3 exhausts the default
+# 3-attempt retry budget and the engine layer steps its chain).  Each
+# entry pins the engine AND checkpointing the schedule must force so
+# the armed site is actually on the target's path: the whole-loop
+# fused program (fetch.fused) only runs WITHOUT a checkpoint prefix,
+# the segment fold (fetch.tail) only WITH one.
+_DIVERGENCE_MENU: Tuple[Tuple[str, str, bool], ...] = (
+    ("fetch.fused:oom*3", "fused", False),
+    ("fetch.tail:oom*3", "fused", True),  # segment fold under ckpt
+)
+
+
+def make_mp_schedule(seed: int, procs: int) -> dict:
+    """ONE deterministic multi-process scenario from ``seed``: the
+    fault kind, the target rank, the per-rank failpoint spec, and the
+    pipeline shape.  Pure function of (seed, procs) — tests pin
+    same-seed equality, like :func:`make_schedule`."""
+    rng = random.Random(seed)
+    kind = rng.choice(MP_KINDS)
+    target = rng.randrange(procs)
+    engine = rng.choice(("auto", "level", "fused"))
+    # Fenced commits exercised by default; a divergence entry may turn
+    # checkpointing off when its armed site needs the whole-loop path.
+    checkpoint = True
+    failpoints_by_rank: Dict[int, str] = {}
+    if kind == "kill":
+        level = rng.choice((2, 3))
+        failpoints_by_rank[target] = f"level.{level}:abort"
+    elif kind == "divergence":
+        spec, engine, checkpoint = rng.choice(_DIVERGENCE_MENU)
+        failpoints_by_rank[target] = spec
+    elif kind == "flap":
+        # Coordinator flap: rank 0 stalls at a level boundary for
+        # longer than several heartbeat intervals but well under the
+        # quorum timeout — a SLOW coordinator must not be declared
+        # dead (the background heartbeat keeps beating through the
+        # stall) and the run must complete identically.
+        target = 0
+        failpoints_by_rank[0] = f"level.2:delay@{rng.randint(800, 1500)}"
+    else:  # hb_delay
+        # Heartbeat jitter on the target: each beat sleeps; liveness
+        # judgment must tolerate it (interval << timeout), so the run
+        # completes identically — a laggy heartbeat is not a death.
+        failpoints_by_rank[target] = (
+            f"quorum.heartbeat:delay@{rng.randint(100, 300)}"
+        )
+    return {
+        "seed": seed,
+        "kind": kind,
+        "procs": procs,
+        "target": target,
+        "engine": engine,
+        "checkpoint": checkpoint,
+        "cadence": rng.choice((1, 2)),
+        "failpoints_by_rank": failpoints_by_rank,
+    }
+
+
+def _spawn_rank(
+    schedule: dict, inp: str, out_r: str, qdir: str, rank: int,
+    log_path: str,
+) -> "subprocess.Popen":
+    import subprocess
+
+    argv = [
+        sys.executable, "-m", "fastapriori_tpu",
+        inp, out_r, "--min-support", "0.08",
+        "--engine", schedule["engine"], "--platform", "cpu",
+    ]
+    if schedule["checkpoint"]:
+        argv += [
+            "--checkpoint-every-level",
+            "--checkpoint-cadence", str(schedule["cadence"]),
+        ]
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        FA_NO_COMPILE_LOG="1",
+        FA_QUORUM_DIR=qdir,
+        FA_QUORUM_RANK=str(rank),
+        FA_QUORUM_PROCS=str(schedule["procs"]),
+        # Bounded everything: worst-case stall on a dead peer is
+        # 3 attempts x 20 s, inside the scenario timeout.
+        FA_QUORUM_TIMEOUT_S="20",
+        FA_HEARTBEAT_MS="100",
+    )
+    env.pop("FA_FAILPOINTS", None)
+    spec = schedule["failpoints_by_rank"].get(rank)
+    if spec is not None:
+        env["FA_FAILPOINTS"] = spec  # schedule specs ARE the env format
+    # lint: waive G009 -- per-rank stderr capture in a fresh temp dir, not a run artifact
+    log = open(log_path, "wb")
+    return subprocess.Popen(
+        argv, cwd=_REPO_ROOT, env=env, stdout=log, stderr=log
+    )
+
+
+def _checkpoint_epoch_consistent(
+    prefix: str, qdir: str
+) -> Optional[str]:
+    """The no-mixed-epoch-artifact assertion: a committed checkpoint's
+    manifest fence must match its meta fence and must not exceed the
+    domain's authoritative FENCE.  Returns a problem string or None."""
+    from fastapriori_tpu.io.checkpoint import CHECKPOINT_NAME
+    from fastapriori_tpu.io.resume import manifest_fence
+
+    if not os.path.exists(prefix + CHECKPOINT_NAME):
+        return None
+    m_fence = manifest_fence(prefix)
+    try:
+        with open(os.path.join(qdir, "FENCE")) as f:
+            dom_fence = int(json.load(f)["fence"])
+    except (OSError, ValueError, KeyError):
+        dom_fence = 0
+    import io as _io
+
+    import numpy as _np
+
+    with open(prefix + CHECKPOINT_NAME, "rb") as f:
+        try:
+            with _np.load(_io.BytesIO(f.read())) as z:
+                meta = z["meta"]
+                meta_fence = int(meta[4]) if meta.shape[0] >= 5 else 0
+        # lint: waive G006 -- a torn checkpoint is the MANIFEST contract's verdict, not this epoch check's
+        except Exception:
+            return None
+    if m_fence is not None and meta_fence and m_fence != meta_fence:
+        return (
+            f"mixed-epoch checkpoint under {prefix}: manifest fence "
+            f"{m_fence} != meta fence {meta_fence}"
+        )
+    if dom_fence and meta_fence > dom_fence:
+        return (
+            f"checkpoint fence {meta_fence} exceeds the domain FENCE "
+            f"{dom_fence} under {prefix}"
+        )
+    return None
+
+
+# Markers must be PRECISE contract phrases, never loose substrings: a
+# checkpoint-enabled crash's traceback contains frame names like
+# "io/checkpoint.py", so a bare "checkpoint" marker would read a
+# genuinely unclassified crash in the fence code as classified and the
+# soak would pass exactly where it must FAIL.
+_CLASSIFIED_MARKERS = (
+    "injected failpoint",  # InjectedAbort / injected transient
+    "quorum peer rank",  # PeerLost naming the rank
+    "mesh divergence",  # MeshDivergence naming both sides
+    "stale checkpoint",  # StaleFenceError (split-brain commit/resume)
+    "corrupt checkpoint",  # structural rejection
+    "fails manifest validation",  # torn-artifact contract
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "error: ",  # the CLI's classified one-liners (rc 2/3); raw
+    # tracebacks print "SomeError:" — capital E — and never match
+)
+
+
+def run_mp_scenario(
+    schedule: dict, inp: str, root: str, clean: Dict[str, bytes],
+    timeout_s: float,
+) -> Outcome:
+    """One multi-process scenario under the extended invariant."""
+    import subprocess
+
+    procs = schedule["procs"]
+    tag = f"mp{schedule['seed']}x{procs}"
+    qdir = os.path.join(root, tag + ".q")
+    outs = [
+        os.path.join(root, tag, f"r{r}") + os.sep for r in range(procs)
+    ]
+    logs = [os.path.join(root, tag, f"r{r}.log") for r in range(procs)]
+    for o in outs:
+        os.makedirs(o)
+    children = [
+        _spawn_rank(schedule, inp, outs[r], qdir, r, logs[r])
+        for r in range(procs)
+    ]
+    t0 = time.monotonic()
+    hung = False
+    while any(c.poll() is None for c in children):
+        if time.monotonic() - t0 > timeout_s:
+            hung = True
+            for c in children:
+                if c.poll() is None:
+                    c.kill()
+            break
+        time.sleep(0.1)
+    for c in children:
+        try:
+            c.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            hung = True
+    rcs = [c.returncode for c in children]
+    texts = []
+    for p in logs:
+        try:
+            with open(p, "rb") as f:
+                texts.append(f.read().decode("utf-8", "replace"))
+        except OSError:
+            texts.append("")
+    detail = f"kind={schedule['kind']} target={schedule['target']} " \
+             f"engine={schedule['engine']} rcs={rcs}"
+    if hung:
+        return Outcome("FAIL", f"hang: {detail} (no exit in {timeout_s}s)")
+    # Mixed-epoch artifact check on every rank's committed checkpoint.
+    for o in outs:
+        problem = _checkpoint_epoch_consistent(o, qdir)
+        if problem:
+            return Outcome("FAIL", f"{problem} ({detail})")
+    target = schedule["target"]
+    failed = [r for r in range(procs) if rcs[r] != 0]
+    for r in failed:
+        if not any(m in texts[r] for m in _CLASSIFIED_MARKERS):
+            return Outcome(
+                "FAIL",
+                f"rank {r} failed UNCLASSIFIED (rc={rcs[r]}) — "
+                f"{detail}; tail: {texts[r][-300:]!r}",
+            )
+    survivors = [r for r in range(procs) if rcs[r] == 0]
+    if len(survivors) >= 2 or (survivors and not failed):
+        base = None
+        for r in survivors:
+            blob = tuple(
+                _read(outs[r] + n) for n in ("freqItemset", "recommends")
+            )
+            if base is None:
+                base = blob
+            elif blob != base:
+                return Outcome(
+                    "FAIL",
+                    f"survivor outputs DIVERGE (rank {survivors[0]} vs "
+                    f"{r}) — {detail}",
+                )
+        if not failed and base is not None:
+            want = tuple(clean[n] for n in ("freqItemset", "recommends"))
+            if base != want:
+                return Outcome(
+                    "FAIL",
+                    f"survivor outputs differ from the clean run — "
+                    f"{detail}",
+                )
+    if schedule["kind"] == "divergence" and not failed:
+        # The lockstep assertion: the target walked its chain locally
+        # (cascade on its ledger warn-stream) AND at least one peer
+        # ADOPTED it through the consensus exchange — without the
+        # exchange the peers would never print quorum_adopt and a real
+        # mesh would have hung at the next collective.
+        if "cascade" not in texts[target]:
+            return Outcome(
+                "FAIL",
+                f"divergence target never walked its chain — {detail}",
+            )
+        peers = [r for r in range(procs) if r != target]
+        if not any("quorum_adopt" in texts[r] for r in peers):
+            return Outcome(
+                "FAIL",
+                f"no peer adopted the target's degradation (consensus "
+                f"exchange silent) — {detail}",
+            )
+        return Outcome("degraded", detail)
+    if schedule["kind"] == "kill":
+        if rcs[target] == 0:
+            return Outcome(
+                "FAIL", f"killed rank exited 0 — {detail}"
+            )
+        # Survivors either finished before needing the dead peer
+        # (impossible past the mine.end rendezvous, but allowed by the
+        # invariant) or failed classified naming the rank — both
+        # checked above.  A survivor that names the dead rank proves
+        # bounded peer-death detection.
+        named = any(
+            f"rank {target}" in texts[r]
+            for r in range(procs)
+            if r != target and rcs[r] != 0
+        )
+        return Outcome(
+            "classified",
+            f"{detail} peer_named={named}",
+        )
+    if failed:
+        return Outcome("classified", detail)
+    return Outcome("identical", detail)
+
+
+def main_chaos_mp(args, seeds: List[int]) -> int:
+    """The multi-process soak driver (``--procs N``): seeded schedules
+    over kill/divergence/flap/heartbeat-delay scenarios, each a real
+    N-subprocess mesh over the file-transport quorum."""
+    t0 = time.monotonic()
+    root = tempfile.mkdtemp(prefix="fa_chaos_mp_")
+    failures: List[str] = []
+    tallies: Dict[str, int] = {}
+    ran = dropped = 0
+    try:
+        inp = make_inputs(root)
+        out_clean = os.path.join(root, "clean") + os.sep
+        os.makedirs(out_clean)
+        from fastapriori_tpu.cli import main as cli_main
+
+        if cli_main([inp, out_clean, "--min-support", "0.08"]) != 0:
+            print("chaos-mp: FAIL: clean run failed", file=sys.stderr)
+            return 1
+        clean = {
+            n: _read(out_clean + n)
+            for n in ("freqItemset", "recommends")
+        }
+        print(
+            f"chaos-mp: {args.procs} processes, seeds {seeds} x "
+            f"{args.scenarios}",
+        )
+        for seed in seeds:
+            for i in range(args.scenarios):
+                if time.monotonic() - t0 > args.budget_s:
+                    dropped += 1
+                    continue
+                schedule = make_mp_schedule(seed * 100 + i, args.procs)
+                outcome = run_mp_scenario(
+                    schedule, inp, root, clean, args.scenario_timeout_s
+                )
+                ran += 1
+                tallies[outcome.kind] = tallies.get(outcome.kind, 0) + 1
+                ok = "FAIL" if outcome.kind == "FAIL" else "ok"
+                print(
+                    f"chaos-mp[{schedule['seed']}] {ok} "
+                    f"{outcome.kind}: {outcome.detail}"
+                )
+                if outcome.kind == "FAIL":
+                    failures.append(outcome.detail)
+    finally:
+        if not args.keep and not failures:
+            shutil.rmtree(root, ignore_errors=True)
+        else:
+            # The per-rank logs and rank-suffixed flight dumps are the
+            # post-mortem; tools/flight_merge.py reassembles them.
+            print(f"chaos-mp: workdirs kept under {root}")
+    wall = time.monotonic() - t0
+    if dropped:
+        print(
+            f"chaos-mp: {dropped} scenario(s) dropped past the "
+            f"{args.budget_s}s budget — coverage was NOT complete",
+            file=sys.stderr,
+        )
+    print(
+        f"chaos-mp: {'FAIL' if failures else 'OK'} scenarios={ran} "
+        f"{tallies} wall={wall:.1f}s (budget {args.budget_s}s)"
+    )
+    return 1 if failures else 0
+
+
 def main_chaos(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -390,6 +754,14 @@ def main_chaos(argv: Optional[List[str]] = None) -> int:
         help="per-scenario hang bound (the no-hang invariant)",
     )
     ap.add_argument("--keep", action="store_true", help="keep workdirs")
+    ap.add_argument(
+        "--procs", type=int, default=1,
+        help="multi-process soak (ISSUE 12): spawn this many real "
+        "subprocess ranks per scenario, coordinated through the "
+        "file-transport quorum (reliability/quorum.py); schedules "
+        "cover kill-mid-level / divergence injection / coordinator "
+        "flap / heartbeat delay (default 1 = the single-process soak)",
+    )
     args = ap.parse_args(argv)
 
     # 8 virtual CPU devices BEFORE any backend init, so the sharded
@@ -414,6 +786,8 @@ def main_chaos(argv: Optional[List[str]] = None) -> int:
 
     base = _base_seed()
     seeds = [int(s) + base for s in args.seeds.split(",") if s.strip()]
+    if args.procs > 1:
+        return main_chaos_mp(args, seeds)
     t0 = time.monotonic()
     root = tempfile.mkdtemp(prefix="fa_chaos_")
     failures: List[str] = []

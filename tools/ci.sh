@@ -11,14 +11,16 @@ cd "$(dirname "$0")/.."
 # tooling) under the EMPTY baseline, plus the inventory drift check:
 # tools/lint/inventory.json, env_registry.json and the README knob
 # table must match what the tree regenerates — inventory churn rides
-# the PR that causes it.  Wall time is logged and budgeted (<10 s).
+# the PR that causes it.  Wall time is logged and budgeted (<15 s —
+# raised from 10 s in PR 12: the linted surface was already at ~9.5 s
+# and grew by the quorum layer + mp-chaos harness + their tests).
 lint_t0=$(python -c 'import time; print(time.time())')
 python -m tools.lint --baseline tools/lint/baseline.json --check-inventory
 python - "$lint_t0" <<'EOF'
 import sys, time
 elapsed = time.time() - float(sys.argv[1])
-print(f"lint+inventory wall time: {elapsed:.2f}s (budget 10s)")
-sys.exit(1 if elapsed > 10.0 else 0)
+print(f"lint+inventory wall time: {elapsed:.2f}s (budget 15s)")
+sys.exit(1 if elapsed > 15.0 else 0)
 EOF
 
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
@@ -78,7 +80,7 @@ EOF
 # the lint-censused site inventory against the full CLI pipeline —
 # byte-identical, classified, or ledger-degraded; never a hang, silent
 # corruption, or unclassified crash.  Fixed seed set, wall-budgeted and
-# logged like lint's 10 s budget.
+# logged like lint's wall budget.
 chaos_t0=$(python -c 'import time; print(time.time())')
 env JAX_PLATFORMS=cpu python tools/chaos.py \
     --seeds 0,4,6,9 --scenarios 3 --budget-s 120
@@ -91,3 +93,20 @@ elapsed = time.time() - float(sys.argv[1])
 print(f"chaos soak wall time: {elapsed:.2f}s (hard gate 215s)")
 sys.exit(1 if elapsed > 215.0 else 0)
 EOF
+
+# Multi-process fault-domain soak (ISSUE 12): 2 real subprocess ranks
+# per scenario over the file-transport quorum — seeded kill-mid-level /
+# divergence-injection / coordinator-flap / heartbeat-delay schedules
+# under the EXTENDED invariant: all surviving ranks byte-identical, or
+# all failing ranks classified naming a rank/site; never a hang, never
+# a mixed-epoch checkpoint.  Hard gate derived like the single-process
+# soak's: soft budget (120 s) + one scenario hang bound (90 s) + slack.
+chaos_mp_t0=$(python -c 'import time; print(time.time())')
+env JAX_PLATFORMS=cpu python tools/chaos.py --procs 2 \
+    --seeds 0,3,7 --scenarios 3 --budget-s 120
+python - "$chaos_mp_t0" <<'PYEOF'
+import sys, time
+elapsed = time.time() - float(sys.argv[1])
+print(f"chaos-mp soak wall time: {elapsed:.2f}s (hard gate 240s)")
+sys.exit(1 if elapsed > 240.0 else 0)
+PYEOF

@@ -1,0 +1,130 @@
+"""Merge per-rank flight-recorder dumps into ONE ordered post-mortem
+(ISSUE 12 satellite).
+
+A multi-process fault domain dumps one ``<prefix>rank<r>.flight.json``
+per process (obs/flight.py; the rank suffix keeps them from clobbering)
+— but the failure narrative ("rank 1 degraded at epoch 7, rank 0
+adopted at epoch 8, rank 1's heartbeat stopped, rank 0 raised
+PeerLost") spans processes.  Each dump carries its recorder's
+wall-clock anchor (``t0_unix_s``); this tool rebases every event to
+absolute time, interleaves the rings, and writes (or prints) one
+chronological stream with each event tagged by its source file.
+
+Usage::
+
+    python tools/flight_merge.py out/rank0.flight.json out/rank1.flight.json
+    python tools/flight_merge.py --prefix out/        # globs *flight.json
+    python tools/flight_merge.py --prefix out/ -o merged.json
+
+Stdlib-only; no jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+
+def _label(path: str) -> str:
+    """Source tag for one dump: the rank when the filename carries one
+    (``...rank<r>.flight.json``), else the basename."""
+    m = re.search(r"rank(\d+)\.flight\.json$", os.path.basename(path))
+    return f"rank{m.group(1)}" if m else os.path.basename(path)
+
+
+def merge_flights(paths: List[str]) -> Dict:
+    """The merged document: every ring's events rebased to absolute
+    unix time (``t_abs_s``), tagged with ``src``, sorted
+    chronologically (ties broken by (src, seq) so the order is
+    deterministic).  Per-source drop accounting is preserved — a
+    wrapped ring (first_seq > 1) means the merged stream is missing
+    that source's oldest events, and the summary says so."""
+    sources = []
+    events: List[Dict] = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        src = _label(path)
+        t0 = float(doc.get("t0_unix_s") or 0.0)
+        first = doc.get("first_seq")
+        sources.append(
+            {
+                "src": src,
+                "path": path,
+                "reason": doc.get("reason"),
+                "total_events": doc.get("total_events"),
+                "ring_capacity": doc.get("ring_capacity"),
+                "dropped_before_ring": (first - 1) if first else 0,
+                "t0_unix_s": t0 or None,
+            }
+        )
+        for e in doc.get("events", []):
+            ev = dict(e)
+            ev["src"] = src
+            ev["t_abs_s"] = (
+                round(t0 + float(e.get("t_s", 0.0)), 6) if t0 else None
+            )
+            events.append(ev)
+    # Dumps without an anchor (pre-ISSUE-12 recorders) sort after
+    # anchored ones, in their own relative order — merged best-effort
+    # rather than rejected.
+    events.sort(
+        key=lambda e: (
+            e["t_abs_s"] is None,
+            e["t_abs_s"] or e.get("t_s", 0.0),
+            e["src"],
+            e.get("seq", 0),
+        )
+    )
+    return {"version": 1, "sources": sources, "events": events}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "files", nargs="*", help="flight.json dumps to merge"
+    )
+    ap.add_argument(
+        "--prefix",
+        default=None,
+        help="glob <prefix>*flight.json instead of naming files",
+    )
+    ap.add_argument(
+        "-o", "--output", default=None,
+        help="write merged JSON here (default: stdout)",
+    )
+    args = ap.parse_args(argv)
+    paths = list(args.files)
+    if args.prefix:
+        paths.extend(sorted(glob.glob(args.prefix + "*flight.json")))
+    paths = sorted(set(paths))
+    if not paths:
+        print(
+            "flight_merge: no flight.json inputs (name files or pass "
+            "--prefix)",
+            file=sys.stderr,
+        )
+        return 2
+    merged = merge_flights(paths)
+    body = json.dumps(merged, indent=1) + "\n"
+    if args.output:
+        # lint: waive G009 -- offline post-mortem tool output, not a run artifact (no manifest to join)
+        with open(args.output, "w") as f:
+            f.write(body)
+        print(
+            f"flight_merge: {len(merged['events'])} events from "
+            f"{len(paths)} dump(s) -> {args.output}",
+            file=sys.stderr,
+        )
+    else:
+        sys.stdout.write(body)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
