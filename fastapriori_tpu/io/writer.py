@@ -96,6 +96,7 @@ def write_artifact_bytes(
     is exactly what resume-side validation must catch.  Records into
     ``manifest[name]`` when given; returns ``path``."""
     site = "write." + name
+    # lint: waive G013 -- write.<name> site family: one site per artifact name, enumerated by MANIFEST.json and armed per-name by the chaos schedules (a static census would need the artifact-name universe, which is data)
     failpoints.fire(site)
     trunc = failpoints.truncation(site)
     digest = hashlib.sha256()

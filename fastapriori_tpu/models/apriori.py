@@ -237,6 +237,7 @@ class FastApriori:
                         "fence": quorum.checkpoint_fence(),
                     },
                 )
+        # lint: waive G013 -- level.<k> site family: depth-indexed (k is the mining level), bounded by the lattice depth and armed per-level by the chaos kill-mid-level schedules
         failpoints.fire(f"level.{k}")
         # Level-boundary consensus exchange (ISSUE 12): publish this
         # process's cascade positions, adopt any peer's more-degraded

@@ -47,6 +47,8 @@ from typing import Any, Dict, List, Optional
 # stale), and tests/test_obs.py pins that a declared site really
 # produces a span when traced.
 FETCH_SITE_SPANS = (
+    "fetch.counts",
+    "fetch.counts_drain",
     "fetch.counts_resolve",
     "fetch.fused",
     "fetch.level_bits",
@@ -58,6 +60,7 @@ FETCH_SITE_SPANS = (
     "fetch.pair_regather",
     "fetch.pair_sparse",
     "fetch.rec_match",
+    "fetch.rule_counts",
     "fetch.rule_mask",
     "fetch.rule_mask_shard",
     "fetch.serve_match",

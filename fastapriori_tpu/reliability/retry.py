@@ -214,6 +214,7 @@ def call_with_retries(
         t0 = time.perf_counter()
         while True:
             try:
+                # lint: waive G013 -- central instrumentation: `site` is the caller's audited label, censused at its fetch/definition site (this is the ONE shared fire point every label routes through)
                 failpoints.fire(site)
                 result = watchdog.guard(thunk, site)
                 if site.startswith("fetch."):

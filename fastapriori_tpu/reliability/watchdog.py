@@ -72,6 +72,7 @@ CHAINS: Dict[str, Tuple[str, ...]] = {
     # host numpy oracle.
     "rule_engine": ("sharded", "device", "host"),
     # Recommender first-match scan: resident device table -> host scan.
+    # lint: waive G016 -- host-local tier: the resident scan's pmin/pmax run on THIS process's own device mesh (serving is single-host by design, PR 10); a per-process device->host walk changes no cross-process collective, so the position vector does not carry it
     "rule_scan": ("device", "host"),
     # Serving admission control (serve/server.py): accepting requests ->
     # shedding them ("0" answers) under overload.  Each overload episode

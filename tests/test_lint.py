@@ -475,6 +475,134 @@ CASES = [
      "    v = retry.fetch(lambda: np.asarray(y), 'b')"
      "  # lint: waive G014 -- test waiver\n"
      "    return u, v\n"),
+    # -- G015: rank-divergent values must not steer collectives --------
+    ("G015", "flag", "pkg/mod.py",
+     "import os\n"
+     "from jax import lax\n"
+     "def count(x):\n"
+     "    if os.environ.get('FA_FAST', '') == '1':\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),  # unguarded env branch changes collective count
+    ("G015", "flag", "pkg/mod.py",
+     "from jax import lax\n"
+     "def count(x):\n"
+     "    try:\n"
+     "        y = quick(x)\n"
+     "    except Exception as e:\n"
+     "        y = lax.psum(x, 'txn')\n"
+     "    return y\n"),  # only the failing rank takes the psum path
+    ("G015", "pass", "pkg/mod.py",
+     "import os\n"
+     "from jax import lax\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def count(x):\n"
+     "    fast = os.environ.get('FA_FAST', '') == '1'\n"
+     "    if fast and quorum.stage_allowed('count_reduce', 'sparse'):\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),  # the consensus floor sanitizes the decision
+    ("G015", "pass", "pkg/mod.py",
+     "from jax import lax\n"
+     "def count(x, enabled):\n"
+     "    if enabled:\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),  # uniform parameter: peers branch identically
+    ("G015", "waived", "pkg/mod.py",
+     "import os\n"
+     "from jax import lax\n"
+     "def count(x):\n"
+     "    # lint: waive G015 -- test waiver (single-process-only path)\n"
+     "    if os.environ.get('FA_FAST', '') == '1':\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),
+    # -- G016: collective-shaping chains must be consensus-registered --
+    ("G016", "flag", "pkg/mod.py",
+     "from jax import lax\n"
+     "CHAINS = {'myengine': ('fast', 'slow'),\n"
+     "          'logchain': ('a', 'b')}\n"
+     "CONSENSUS_CHAINS = ('logchain',)\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def run(x, bad):\n"
+     "    if bad:\n"
+     "        downgrade('myengine', 'fast', 'slow')\n"
+     "        downgrade('logchain', 'a', 'b')\n"
+     "    return lax.psum(x, 'txn')\n"),
+    # ^ myengine walks in a collective-bearing fn, unregistered
+    ("G016", "flag", "pkg/mod.py",
+     "CHAINS = {'myengine': ('fast', 'slow')}\n"
+     "CONSENSUS_CHAINS = ('ghost',)\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"),  # registered chain that does not exist in CHAINS
+    ("G016", "pass", "pkg/mod.py",
+     "from jax import lax\n"
+     "CHAINS = {'myengine': ('fast', 'slow')}\n"
+     "CONSENSUS_CHAINS = ('myengine',)\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def run(x, bad):\n"
+     "    if bad:\n"
+     "        downgrade('myengine', 'fast', 'slow')\n"
+     "    return lax.psum(x, 'txn')\n"),  # registered and walked
+    ("G016", "pass", "pkg/mod.py",
+     "from jax import lax\n"
+     "CHAINS = {'myengine': ('fast', 'slow')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def run(x, bad):\n"
+     "    if bad:\n"
+     "        downgrade('myengine', 'fast', 'slow')\n"
+     "    return lax.psum(x, 'txn')\n"),
+    # ^ no CONSENSUS_CHAINS declared: pre-quorum tree, no registry to check
+    ("G016", "waived", "pkg/mod.py",
+     "from jax import lax\n"
+     "CHAINS = {\n"
+     "    # lint: waive G016 -- host-local test chain: never crosses the mesh\n"
+     "    'myengine': ('fast', 'slow'),\n"
+     "    'otherchain': ('a', 'b'),\n"
+     "}\n"
+     "CONSENSUS_CHAINS = ('otherchain',)\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def run(x, bad):\n"
+     "    if bad:\n"
+     "        downgrade('myengine', 'fast', 'slow')\n"
+     "        downgrade('otherchain', 'a', 'b')\n"
+     "    return lax.psum(x, 'txn')\n"),
+    # -- G017: mid-loop re-clamps must be exchange-dominated -----------
+    ("G017", "flag", "pkg/mod.py",
+     "from jax import lax\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def mine(levels, x):\n"
+     "    for k in levels:\n"
+     "        if not quorum.stage_allowed('count_reduce', 'sparse'):\n"
+     "            x = x + 1\n"
+     "        x = lax.psum(x, 'txn')\n"
+     "    return x\n"),  # loop never exchanges: the floor cannot move
+    ("G017", "pass", "pkg/mod.py",
+     "from jax import lax\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def mine(levels, x):\n"
+     "    for k in levels:\n"
+     "        quorum.sync('level')\n"
+     "        if not quorum.stage_allowed('count_reduce', 'sparse'):\n"
+     "            x = x + 1\n"
+     "        x = lax.psum(x, 'txn')\n"
+     "    return x\n"),  # boundary exchange dominates the re-clamp
+    ("G017", "pass", "pkg/mod.py",
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def pick():\n"
+     "    return quorum.stage_allowed('count_reduce', 'sparse')\n"),
+    # ^ start-of-phase clamp outside any loop: rendezvous-covered
+    ("G017", "waived", "pkg/mod.py",
+     "from jax import lax\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def mine(levels, x):\n"
+     "    for k in levels:\n"
+     "        # lint: waive G017 -- test waiver (lockstep argument)\n"
+     "        if not quorum.stage_allowed('count_reduce', 'sparse'):\n"
+     "            x = x + 1\n"
+     "        x = lax.psum(x, 'txn')\n"
+     "    return x\n"),
     # -- waiver-grammar edge cases (engine, pinned by ISSUE 5) ---------
     # (a) a waiver above a decorator attaches to the decorated line
     ("G003", "waived", "pkg/mod.py",
@@ -556,7 +684,7 @@ def test_every_rule_has_all_three_case_kinds():
 
 def test_all_rules_registered_and_distinct():
     ids = [r.id for r in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 14
+    assert len(ids) == len(set(ids)) == 17
     assert all(hasattr(r, "name") and r.name for r in ALL_RULES)
 
 
@@ -1053,6 +1181,393 @@ def test_inventory_modes_refuse_partial_paths(capsys):
     )
     assert rc == 2
     assert "full default paths" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# v3: collective census + rank-divergence taint (tools/lint/collective.py)
+
+
+def test_collective_census_captures_axis_engine_and_guards():
+    src = (
+        "from jax import lax\n"
+        "def f(x, flag):\n"
+        "    if flag:\n"
+        "        return lax.psum(x, 'txn')\n"
+        "    return x\n"
+    )
+    r1 = engine.lint_sources([MESH_DECL, ("pkg/mod.py", src)])
+    r2 = engine.lint_sources([MESH_DECL, ("pkg/mod.py", src)])
+    assert r1.inventory == r2.inventory  # deterministic
+    sites = r1.inventory["collective_sites"]
+    assert {
+        "collective": "psum",
+        "axis": "txn",
+        "engine": "pkg.mod:f",
+        "guards": "flag",
+        "path": "pkg/mod.py",
+        "count": 1,
+    } in sites
+
+
+def test_collective_census_multi_operand_sort_only():
+    src = (
+        "from jax import lax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "def join_kernel(a, b, idx):\n"
+        "    srt = lax.sort((a, b, idx), num_keys=2)\n"
+        "    one = lax.sort(a)\n"
+        "    return srt, one\n"
+    )
+    inv = engine.lint_sources([MESH_DECL, ("pkg/mod.py", src)]).inventory
+    sorts = [
+        s for s in inv["collective_sites"] if s["collective"] == "sort"
+    ]
+    assert len(sorts) == 1  # the single-operand local sort is free
+
+
+def test_collective_census_excludes_test_files():
+    src = (
+        "from jax import lax\n"
+        "def f(x):\n"
+        "    return lax.psum(x, 'txn')\n"
+    )
+    inv = engine.lint_sources(
+        [MESH_DECL, ("tests/test_x.py", src)]
+    ).inventory
+    assert inv["collective_sites"] == []
+
+
+def test_collective_census_drift_trips_check_inventory(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (tmp_path / "tools" / "lint").mkdir(parents=True)
+    (pkg / "meshdef.py").write_text(MESH_DECL[1])
+    (pkg / "mod.py").write_text(
+        "from jax import lax\n"
+        "def f(x, flag):\n"
+        "    if flag:\n"
+        "        return lax.psum(x, 'txn')\n"
+        "    return x\n"
+    )
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--write-inventory"]) == 0
+    capsys.readouterr()
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--check-inventory"]) == 0
+    # Re-guarding the collective is census churn: the drift gate trips
+    # until the inventory is regenerated.
+    (pkg / "mod.py").write_text(
+        "from jax import lax\n"
+        "def f(x, flag, extra):\n"
+        "    if flag and extra:\n"
+        "        return lax.psum(x, 'txn')\n"
+        "    return x\n"
+    )
+    capsys.readouterr()
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--check-inventory"]) == 1
+    assert "drift" in capsys.readouterr().err
+
+
+def test_rank_taint_lattice_sources_and_sanitizers():
+    """Taint-lattice unit table: divergence sources taint, consensus
+    primitives sanitize, the fixpoint propagates across call hops."""
+    from tools.lint import flow
+    from tools.lint.graph import PackageGraph
+
+    files = [
+        FileContext(
+            "pkg/a.py",
+            "import os\n"
+            "def knob():\n"
+            "    return os.environ.get('FA_X', '')\n",
+        ),
+        FileContext(
+            "pkg/b.py",
+            "from pkg.a import knob\n"
+            "def fwd():\n"
+            "    return knob() == '1'\n",
+        ),
+        FileContext(
+            "pkg/c.py",
+            "from fastapriori_tpu.reliability import quorum\n"
+            "from pkg.a import knob\n"
+            "def clamped():\n"
+            "    want = knob() == '1'\n"
+            "    return want and quorum.stage_allowed('engine', 'fused')\n",
+        ),
+        FileContext(
+            "pkg/d.py",
+            "import time\n"
+            "def now():\n"
+            "    return time.monotonic()\n",
+        ),
+        FileContext(
+            "pkg/e.py",
+            "def pure(x):\n"
+            "    return x + 1\n",
+        ),
+    ]
+    graph = PackageGraph(files)
+    summaries, clamped = flow.rank_summaries(files, graph, None)
+    assert summaries["pkg.a.knob"] == flow.RANK_DIVERGENT
+    assert summaries["pkg.b.fwd"] == flow.RANK_DIVERGENT  # one hop
+    assert summaries["pkg.c.clamped"] == flow.RANK_UNIFORM
+    assert "pkg.c.clamped" in clamped
+    assert summaries["pkg.d.now"] == flow.RANK_DIVERGENT
+    assert summaries["pkg.e.pure"] == flow.RANK_UNIFORM
+    assert "pkg.e.pure" not in clamped
+
+
+def test_rank_taint_caught_exception_is_divergent():
+    from tools.lint import flow
+
+    ctx = FileContext(
+        "pkg/mod.py",
+        "def f(x):\n"
+        "    try:\n"
+        "        y = g(x)\n"
+        "    except ValueError as exc:\n"
+        "        y = exc\n"
+        "    return y\n",
+    )
+    rf = flow.RankFlow(ctx)
+    env = {}
+    fn = ctx.tree.body[0]
+    rf.run(fn.body, env)
+    assert env["exc"] == flow.RANK_DIVERGENT
+    assert env["y"] == flow.RANK_DIVERGENT
+
+
+def test_g015_divergence_through_helper_chain_still_flags():
+    """A divergent value laundered through a helper in another file
+    must still flag at the branch (the rank fixpoint mirrors G011's)."""
+    helper = (
+        "pkg/h.py",
+        "import os\n"
+        "def knob():\n"
+        "    return os.environ.get('FA_X', '') == '1'\n",
+    )
+    user = (
+        "pkg/mod.py",
+        "from jax import lax\n"
+        "from pkg.h import knob\n"
+        "def count(x):\n"
+        "    if knob():\n"
+        "        return lax.psum(x, 'txn')\n"
+        "    return x\n",
+    )
+    result = engine.lint_sources([MESH_DECL, helper, user])
+    hits = [f for f in result.findings if f.rule == "G015"]
+    assert hits and hits[0].path == "pkg/mod.py"
+
+
+def test_g015_reaches_collective_through_bearing_callee():
+    """The branch suite need not spell the collective itself: a call
+    into a collective-bearing function counts."""
+    ops = (
+        "pkg/ops.py",
+        "from jax import lax\n"
+        "def reduce_counts(x):\n"
+        "    return lax.psum(x, 'txn')\n",
+    )
+    user = (
+        "pkg/mod.py",
+        "import os\n"
+        "from pkg.ops import reduce_counts\n"
+        "def count(x):\n"
+        "    if os.environ.get('FA_X', '') == '1':\n"
+        "        return reduce_counts(x)\n"
+        "    return x\n",
+    )
+    result = engine.lint_sources([MESH_DECL, ops, user])
+    hits = [f for f in result.findings if f.rule == "G015"]
+    assert hits and hits[0].path == "pkg/mod.py"
+
+
+def test_g015_sync_clamped_callee_is_a_barrier():
+    """A callee that runs the rendezvous exchange itself re-uniforms
+    the mesh before its collectives: branches above it are exempt."""
+    mine = (
+        "pkg/mine.py",
+        "from jax import lax\n"
+        "from fastapriori_tpu.reliability import quorum\n"
+        "def fit(x):\n"
+        "    quorum.sync('mine.start')\n"
+        "    return lax.psum(x, 'txn')\n",
+    )
+    user = (
+        "pkg/mod.py",
+        "import os\n"
+        "from pkg.mine import fit\n"
+        "def main(x):\n"
+        "    if os.environ.get('FA_X', '') == '1':\n"
+        "        return fit(x)\n"
+        "    return x\n",
+    )
+    result = engine.lint_sources([MESH_DECL, mine, user])
+    assert not [f for f in result.findings if f.rule == "G015"]
+
+
+def test_g015_unrelated_sync_call_is_not_a_sanitizer():
+    """`mm.sync()` (mmap flush) must not clamp the function: only a
+    quorum-resolved sync is a rendezvous (review regression)."""
+    src = (
+        "import os\n"
+        "from jax import lax\n"
+        "def count(x, mm):\n"
+        "    mm.sync()\n"
+        "    if os.environ.get('FA_FAST', '') == '1':\n"
+        "        return lax.psum(x, 'txn')\n"
+        "    return x\n"
+    )
+    result = engine.lint_sources([MESH_DECL, ("pkg/mod.py", src)])
+    assert [f for f in result.findings if f.rule == "G015"]
+    # ...while the quorum spelling still sanitizes/clamps.
+    ok = src.replace("mm.sync()", "quorum.sync('level')").replace(
+        "import os\n",
+        "import os\nfrom fastapriori_tpu.reliability import quorum\n",
+    )
+    clean = engine.lint_sources([MESH_DECL, ("pkg/mod.py", ok)])
+    assert not [f for f in clean.findings if f.rule == "G015"]
+
+
+def test_g013_kwonly_label_default_resolves():
+    """A keyword-only label parameter's default is a compile-time
+    constant too (review regression: kw_defaults were skipped)."""
+    src = (
+        "import numpy as np\n"
+        "from fastapriori_tpu.reliability import retry\n"
+        "COVERAGE = ('fetch.counts',)\n"
+        "def gather(arr, *, site='counts'):\n"
+        "    return retry.fetch_async(np.asarray(arr), site)\n"
+    )
+    result = engine.lint_sources([("pkg/mod.py", src)])
+    labels = {e["label"] for e in result.inventory["fetch_sites"]}
+    assert "counts" in labels
+    assert not [
+        f for f in result.findings
+        if f.rule == "G013" and "not statically resolvable" in f.message
+    ]
+
+
+def test_analysis_cache_subset_run_keeps_other_entries(tmp_path):
+    """A targeted single-file run must not evict the rest of the warm
+    cache (review regression)."""
+    from tools.lint import cache
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (tmp_path / "tools" / "lint").mkdir(parents=True)
+    (pkg / "a.py").write_text("A = 'one'\n")
+    (pkg / "b.py").write_text("B = 'two'\n")
+    engine.lint_paths(["pkg"], root=str(tmp_path))
+    assert set(cache.load(str(tmp_path))) == {"pkg/a.py", "pkg/b.py"}
+    engine.lint_paths(["pkg/a.py"], root=str(tmp_path))
+    assert set(cache.load(str(tmp_path))) == {"pkg/a.py", "pkg/b.py"}
+
+
+def test_g013_label_resolution_closes_the_residue():
+    """f-strings/``+``/``.format`` over compile-time constants census;
+    a genuinely dynamic label flags as a blind spot."""
+    src = (
+        "import numpy as np\n"
+        "from fastapriori_tpu.reliability import retry, failpoints\n"
+        "PREFIX = 'pair'\n"
+        "COVERAGE = ('fetch.pair_sparse', 'fetch.pair_x')\n"
+        "def pull(x, k):\n"
+        "    u = retry.fetch(lambda: np.asarray(x), f'{PREFIX}_sparse')\n"
+        "    v = retry.fetch(lambda: np.asarray(x), PREFIX + '_x')\n"
+        "    failpoints.fire('lvl.{}'.format(k))\n"
+        "    return u, v\n"
+    )
+    result = engine.lint_sources([("pkg/mod.py", src)])
+    inv = result.inventory
+    labels = {e["label"] for e in inv["fetch_sites"]}
+    assert {"pair_sparse", "pair_x"} <= labels
+    blind = [
+        f for f in result.findings
+        if f.rule == "G013" and "not statically resolvable" in f.message
+    ]
+    assert len(blind) == 1 and blind[0].line == 8  # the dynamic fire
+
+
+def test_g013_param_flow_censuses_per_inflowing_label():
+    """A label parameter censuses once per compile-time value flowing
+    into it package-wide (the gather_level_counts_start pattern)."""
+    helper = (
+        "pkg/mesh.py",
+        "import numpy as np\n"
+        "from fastapriori_tpu.reliability import retry\n"
+        "COVERAGE = ('fetch.counts', 'fetch.counts_drain')\n"
+        "def gather_start(arr, site='counts'):\n"
+        "    return retry.fetch_async(np.asarray(arr), site)\n",
+    )
+    caller = (
+        "pkg/mod.py",
+        "from pkg.mesh import gather_start\n"
+        "def drain(arr):\n"
+        "    return gather_start(arr, site='counts_drain')\n",
+    )
+    result = engine.lint_sources([helper, caller])
+    labels = {e["label"] for e in result.inventory["fetch_sites"]}
+    assert {"counts", "counts_drain"} <= labels
+    assert not [
+        f for f in result.findings
+        if f.rule == "G013" and "not statically resolvable" in f.message
+    ]
+
+
+def test_analysis_cache_roundtrip_is_bit_identical(tmp_path, capsys):
+    """Warm (cached) and cold runs must produce identical findings and
+    inventories; a touched file invalidates its fragment."""
+    from tools.lint import cache
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (tmp_path / "tools" / "lint").mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        "# lint: waive G008 -- census me\n"
+        "X = 'const'\n"
+        "def f(acc=[]):\n"
+        "    return acc\n"
+    )
+    r_cold = engine.lint_paths(["pkg"], root=str(tmp_path))
+    assert (tmp_path / cache.CACHE_PATH).exists()
+    r_warm = engine.lint_paths(["pkg"], root=str(tmp_path))
+    as_dicts = lambda r: [f.to_dict() for f in r.findings]  # noqa: E731
+    assert as_dicts(r_cold) == as_dicts(r_warm)
+    assert r_cold.inventory == r_warm.inventory
+    assert any(
+        w["justification"] == "census me"
+        for w in r_warm.inventory["waivers"]
+    )
+    # Edit the file (same size, different bytes => force mtime bump).
+    mod = pkg / "mod.py"
+    mod.write_text(mod.read_text().replace("G008", "G007"))
+    os.utime(mod, (1, 1))
+    os.utime(mod)  # fresh mtime
+    r_edit = engine.lint_paths(["pkg"], root=str(tmp_path))
+    assert any(
+        "G007" in w["tokens"] for w in r_edit.inventory["waivers"]
+    )
+    # A corrupted cache file is a miss, never an error.
+    (tmp_path / cache.CACHE_PATH).write_text("{not json")
+    r_bad = engine.lint_paths(["pkg"], root=str(tmp_path))
+    assert as_dicts(r_bad) == as_dicts(r_edit)
+
+
+def test_analysis_cache_drops_on_lint_source_change(tmp_path):
+    from tools.lint import cache
+
+    files = {"pkg/mod.py": {"mtime_ns": 1, "size": 2}}
+    (tmp_path / "tools" / "lint").mkdir(parents=True, exist_ok=True)
+    cache.save(str(tmp_path), files)
+    # No tools/lint/*.py under this root: fingerprint is stable, loads.
+    assert cache.load(str(tmp_path)) == files
+    # A linter source appearing (or changing) drops the cache.
+    (tmp_path / "tools" / "lint" / "x.py").write_text("X = 1\n")
+    assert cache.load(str(tmp_path)) == {}
 
 
 def test_stacked_waiver_segments_parse_independently():

@@ -1,0 +1,160 @@
+"""Per-file analysis cache for graftlint (ISSUE 13 satellite).
+
+The lint gate re-tokenizes every file's comment/waiver map and re-walks
+its constant tables on every run, even though both depend ONLY on that
+file's bytes.  This cache keys those per-file facts by ``(mtime, size)``
+so a warm run skips the tokenize pass and the symbol-table base for
+every unchanged file — the census passes (waiver census, constant
+resolution) read the cached fragments instead.
+
+Two deliberate scope limits keep it correct:
+
+- Only facts derivable from the file's OWN bytes are cached (comments,
+  waiver segments, module-level string/int constants).  Anything
+  resolved across files (fetch labels through cross-file constants,
+  the collective census's axis resolution) is recomputed every run —
+  an ``(mtime, size)`` key on one file cannot witness another file's
+  edit.
+- The cache key includes a fingerprint of ``tools/lint/*.py`` itself
+  (name + mtime + size), so editing the linter invalidates everything:
+  a stale analyzer must never answer for a new rule.
+
+The cache file (``tools/lint/.cache.json``) is a pure wall-time
+optimization: deleting it is always safe, results are bit-identical
+either way (pinned by tests), and a torn write is re-read as a miss.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+SCHEMA = 1
+
+CACHE_PATH = os.path.join("tools", "lint", ".cache.json")
+
+
+def lint_fingerprint(root: str = ".") -> str:
+    """Name+mtime+size over the linter's own sources: any edit to
+    tools/lint/ drops the whole cache."""
+    lint_dir = os.path.join(root, "tools", "lint")
+    parts: List[str] = []
+    try:
+        names = sorted(os.listdir(lint_dir))
+    except OSError:
+        return "no-lint-dir"
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        full = os.path.join(lint_dir, name)
+        try:
+            st = os.stat(full)
+        except OSError:
+            continue
+        parts.append(f"{name}:{st.st_mtime_ns}:{st.st_size}")
+    return "|".join(parts)
+
+
+def load(root: str = ".") -> Dict[str, dict]:
+    """The per-file fragment map, or empty on any mismatch/corruption
+    (a cache problem must never be a lint problem)."""
+    path = os.path.join(root, CACHE_PATH)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("schema") != SCHEMA:
+        return {}
+    if data.get("lint_fp") != lint_fingerprint(root):
+        return {}
+    files = data.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def save(root: str, files: Dict[str, dict]) -> None:
+    """Best-effort atomic write (tmp + replace); failure is silent —
+    the next run simply starts cold."""
+    path = os.path.join(root, CACHE_PATH)
+    doc = {
+        "schema": SCHEMA,
+        "comment": (
+            "graftlint per-file analysis cache — safe to delete; "
+            "regenerated on every run (tools/lint/cache.py)."
+        ),
+        "lint_fp": lint_fingerprint(root),
+        "files": files,
+    }
+    tmp = path + ".tmp"
+    try:
+        # lint: waive G009 -- throwaway wall-time cache, not a run artifact: a torn write is re-read as a miss and regenerated
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def fragment_key(full_path: str) -> Optional[Tuple[int, int]]:
+    try:
+        st = os.stat(full_path)
+    except OSError:
+        return None
+    return st.st_mtime_ns, st.st_size
+
+
+def lookup(
+    files: Dict[str, dict], rel_path: str, full_path: str
+) -> Optional[dict]:
+    """The cached fragment for ``rel_path`` when its (mtime, size)
+    still match, else None."""
+    entry = files.get(rel_path)
+    if not isinstance(entry, dict):
+        return None
+    key = fragment_key(full_path)
+    if key is None:
+        return None
+    if entry.get("mtime_ns") != key[0] or entry.get("size") != key[1]:
+        return None
+    return entry
+
+
+def to_fragment(ctx, full_path: str) -> Optional[dict]:
+    """Serialize a FileContext's own-bytes-only facts."""
+    key = fragment_key(full_path)
+    if key is None or ctx.tree is None:
+        return None
+    return {
+        "mtime_ns": key[0],
+        "size": key[1],
+        "comments": {str(k): v for k, v in ctx.comments.items()},
+        "waivers": {
+            str(line): [[sorted(tokens), just] for tokens, just in segs]
+            for line, segs in ctx.waiver_details.items()
+        },
+        "str_consts": dict(ctx.str_consts),
+        "int_consts": dict(ctx.int_consts),
+    }
+
+
+def apply_fragment(ctx, fragment: dict) -> None:
+    """Install cached comment/waiver/constant facts on a FileContext
+    BEFORE its own scan would run (engine.FileContext skips the
+    tokenize + constant walks when these are pre-set)."""
+    ctx.comments = {int(k): v for k, v in fragment["comments"].items()}
+    waiver_details: Dict[int, List[Tuple[Set[str], str]]] = {}
+    waivers: Dict[int, Set[str]] = {}
+    for line, segs in fragment["waivers"].items():
+        parsed = [(set(tokens), just) for tokens, just in segs]
+        waiver_details[int(line)] = parsed
+        waivers[int(line)] = set().union(*(t for t, _ in parsed))
+    ctx.waiver_details = waiver_details
+    ctx.waivers = waivers
+    ctx.str_consts = dict(fragment["str_consts"])
+    ctx.int_consts = {
+        k: int(v) for k, v in fragment["int_consts"].items()
+    }

@@ -93,6 +93,14 @@ def build_parser() -> argparse.ArgumentParser:
             "table drift from what this run would regenerate"
         ),
     )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help=(
+            "skip the per-file analysis cache (tools/lint/.cache.json; "
+            "mtime+size keyed, results bit-identical either way)"
+        ),
+    )
     return p
 
 
@@ -221,7 +229,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     result = engine.lint_paths(
-        paths, root=args.root, baseline=baseline, rules=rules
+        paths,
+        root=args.root,
+        baseline=baseline,
+        rules=rules,
+        use_cache=not args.no_cache,
     )
 
     if args.write_inventory or args.check_inventory:

@@ -1,0 +1,484 @@
+"""graftlint v3: the SPMD collective-consistency substrate (ISSUE 13).
+
+PR 12 made divergent collectives *survivable* (quorum consensus bounds a
+mismatched collective into PeerLost); this layer makes them *provable*
+at lint time.  Three pieces, all pure stdlib like graph/flow:
+
+- **Static collective census** — every collective-issuing call site in
+  the package (``psum``/``pmean``/``pmin``/``pmax``/``all_gather``/
+  ``psum_scatter``/``all_to_all``/``ppermute``, plus multi-operand
+  ``lax.sort`` — the comparator-exchange shape the sharded rule join
+  uses), with its mesh axis, issuing engine path (module:function
+  chain), and the enclosing branch conditions.  Ships in
+  ``tools/lint/inventory.json`` as ``collective_sites`` under the same
+  drift machinery as the fetch/failpoint censuses: adding, moving, or
+  re-guarding a collective must ride its PR.
+
+- **Collective-bearing closures** over the v2 call graph — which
+  functions reach a collective dispatch.  Two variants: ``bearing_any``
+  (plain reachability, G016's "does this chain walk sit on a collective
+  path") and ``bearing_guarded``, which refuses to propagate through
+  SYNC-CLAMPED functions (functions that run a ``quorum.sync``
+  rendezvous themselves): a branch above ``fit()`` cannot diverge the
+  mesh, because every rank re-exchanges positions at ``mine.start``
+  before the first collective — that is the rendezvous-point-exchange
+  sanitizer, applied structurally.
+
+- **Chain declarations** — static parses of ``watchdog.CHAINS`` and
+  ``quorum.CONSENSUS_CHAINS`` from the linted sources (the linter never
+  imports the package), so G016 can drift-check the registration both
+  ways against the live module text.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# Communication-issuing collectives (G002's census minus the free
+# ``axis_index``/``axis_size`` queries, which exchange nothing).
+COLLECTIVE_NAMES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "psum_scatter": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+}
+
+_SYNC_TERMINAL = "sync"
+_QUORUM_SYNC_FQ = "fastapriori_tpu.reliability.quorum.sync"
+
+
+class CollectiveSite:
+    """One censused collective call site."""
+
+    __slots__ = ("name", "axis", "engine", "guards", "ctx", "node")
+
+    def __init__(self, name, axis, engine, guards, ctx, node):
+        self.name = name
+        self.axis = axis
+        self.engine = engine
+        self.guards = guards
+        self.ctx = ctx
+        self.node = node
+
+    def to_entry(self) -> dict:
+        return {
+            "collective": self.name,
+            "axis": self.axis,
+            "engine": self.engine,
+            "guards": " && ".join(self.guards),
+            "path": self.ctx.path,
+        }
+
+
+def _unparse(node: ast.AST, limit: int = 72) -> str:
+    try:
+        text = " ".join(ast.unparse(node).split())
+    except (ValueError, RecursionError):  # pragma: no cover
+        text = type(node).__name__
+    return text if len(text) <= limit else text[: limit - 1] + "…"
+
+
+def _is_multi_operand_sort(call: ast.Call) -> bool:
+    """``lax.sort((a, b, ...), num_keys=K)`` — the multi-operand
+    comparator sort the sharded rule join uses as an exchange layout.
+    A plain single-array sort is local and free."""
+    from tools.lint.engine import terminal_name
+
+    if terminal_name(call.func) != "sort":
+        return False
+    for kw in call.keywords:
+        if kw.arg == "num_keys":
+            return True
+    return bool(call.args) and isinstance(
+        call.args[0], (ast.Tuple, ast.List)
+    )
+
+
+def _axis_of(call: ast.Call, ctx, pkg) -> str:
+    """The collective's mesh axis: a resolved literal, the plumbing
+    parameter's name (``param:axis_name``), or ``dynamic``."""
+    from tools.lint.engine import terminal_name
+
+    t = terminal_name(call.func)
+    pos = COLLECTIVE_NAMES.get(t)
+    expr: Optional[ast.AST] = None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            expr = kw.value
+    if expr is None and pos is not None and len(call.args) > pos:
+        expr = call.args[pos]
+    if expr is None:
+        return ""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        parts = [_axis_of_single(e, ctx, pkg) for e in expr.elts]
+        return ",".join(parts)
+    return _axis_of_single(expr, ctx, pkg)
+
+
+def _axis_of_single(expr: ast.AST, ctx, pkg) -> str:
+    from tools.lint.engine import resolve_str, terminal_name
+
+    s = resolve_str(expr, ctx, pkg)
+    if s is not None:
+        return s
+    t = terminal_name(expr)
+    if t is not None:
+        return f"param:{t}"
+    return "dynamic"
+
+
+def census(pkg) -> List[CollectiveSite]:
+    """Every collective site in every NON-TEST file, with engine path
+    and guard stack (cached per run)."""
+    cached = getattr(pkg, "_collective_census", None)
+    if cached is not None:
+        return cached
+    from tools.lint.engine import is_test_path, terminal_name
+    from tools.lint.graph import module_name
+
+    out: List[CollectiveSite] = []
+
+    def visit(node, ctx, fn_chain, guards):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_chain = fn_chain + [node.name]
+            guards = []
+        elif isinstance(node, ast.If):
+            cond = _unparse(node.test)
+            visit(node.test, ctx, fn_chain, guards)
+            for child in node.body:
+                visit(child, ctx, fn_chain, guards + [cond])
+            for child in node.orelse:
+                visit(child, ctx, fn_chain, guards + [f"not ({cond})"])
+            return
+        elif isinstance(node, ast.IfExp):
+            cond = _unparse(node.test)
+            visit(node.test, ctx, fn_chain, guards)
+            visit(node.body, ctx, fn_chain, guards + [cond])
+            visit(node.orelse, ctx, fn_chain, guards + [f"not ({cond})"])
+            return
+        elif isinstance(node, (ast.While, ast.For)):
+            header = (
+                f"while {_unparse(node.test)}"
+                if isinstance(node, ast.While)
+                else f"for {_unparse(node.target)}"
+            )
+            for child in ast.iter_child_nodes(node):
+                in_suite = child in node.body or child in node.orelse
+                visit(
+                    child,
+                    ctx,
+                    fn_chain,
+                    guards + [header] if in_suite else guards,
+                )
+            return
+        elif isinstance(node, ast.ExceptHandler):
+            what = _unparse(node.type) if node.type is not None else ""
+            guards = guards + [f"except {what}".rstrip()]
+        elif isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            if t in COLLECTIVE_NAMES or _is_multi_operand_sort(node):
+                engine = module_name(ctx.path) + ":" + ".".join(
+                    fn_chain or ["<module>"]
+                )
+                out.append(
+                    CollectiveSite(
+                        "sort" if t == "sort" else t,
+                        _axis_of(node, ctx, pkg),
+                        engine,
+                        list(guards),
+                        ctx,
+                        node,
+                    )
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, ctx, fn_chain, guards)
+
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        # Cheap pre-filter: most files name no collective at all.
+        if not any(
+            name in ctx.source for name in COLLECTIVE_NAMES
+        ) and "sort" not in ctx.source:
+            continue
+        for stmt in ctx.tree.body:
+            visit(stmt, ctx, [], [])
+    pkg._collective_census = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sync detection + collective-bearing closures
+
+
+def is_sync_call(call: ast.Call, ctx, pkg) -> bool:
+    """A ``quorum.sync`` position-vector exchange (rendezvous point).
+    Matched by the resolved fully-qualified name, the dotted
+    ``quorum.sync`` spelling, or a bare ``sync`` imported from the
+    quorum module."""
+    from tools.lint.engine import dotted_name
+
+    d = dotted_name(call.func)
+    if d is None:
+        return False
+    if d == _SYNC_TERMINAL or d.endswith(".sync"):
+        fq = pkg.graph.resolve_expr(ctx, call.func)
+        if fq == _QUORUM_SYNC_FQ:
+            return True
+        return d.endswith("quorum.sync") or (
+            fq is not None and fq.endswith("quorum.sync")
+        )
+    return False
+
+
+def _fn_has_sync(fn: ast.AST, ctx, pkg) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and is_sync_call(node, ctx, pkg):
+            return True
+    return False
+
+
+def sync_clamped(pkg) -> Set[str]:
+    """Fully-qualified names of functions that run a position-vector
+    exchange themselves (cached per run)."""
+    cached = getattr(pkg, "_sync_clamped", None)
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for ctx in pkg.files:
+        table = pkg.graph.by_path.get(ctx.path)
+        if table is None or ctx.tree is None:
+            continue
+        if "sync" not in ctx.source:
+            continue
+        for local, fn in table.functions.items():
+            if _fn_has_sync(fn, ctx, pkg):
+                out.add(f"{table.name}.{local}")
+    pkg._sync_clamped = out
+    return out
+
+
+def _direct_collective_fns(pkg) -> Set[str]:
+    from tools.lint.engine import terminal_name
+
+    out: Set[str] = set()
+    sites = census(pkg)
+    by_path: Dict[str, List[CollectiveSite]] = {}
+    for s in sites:
+        by_path.setdefault(s.ctx.path, []).append(s)
+    for path, file_sites in by_path.items():
+        table = pkg.graph.by_path.get(path)
+        if table is None:
+            continue
+        site_ids = {id(s.node) for s in file_sites}
+        for local, fn in table.functions.items():
+            for node in ast.walk(fn):
+                if id(node) in site_ids:
+                    out.add(f"{table.name}.{local}")
+                    break
+    return out
+
+
+def callee_map(pkg) -> Dict[str, Set[str]]:
+    """``fq function -> resolvable callee fqs`` over the whole package
+    (cached per run — graph resolution is the expensive part of every
+    closure, and both bearing variants share it)."""
+    cached = getattr(pkg, "_callee_map", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, Set[str]] = {}
+    for ctx in pkg.files:
+        table = pkg.graph.by_path.get(ctx.path)
+        if table is None or ctx.tree is None:
+            continue
+        for local, fn in table.functions.items():
+            qual = f"{table.name}.{local}"
+            callees: Set[str] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                fq = pkg.graph.resolve_call_fq(ctx, node)
+                if fq is not None:
+                    callees.add(fq)
+            out[qual] = callees
+    pkg._callee_map = out
+    return out
+
+
+def _bearing_closure(pkg, barrier: bool) -> Set[str]:
+    """Fixpoint reachability: a function bears collectives when it
+    censuses one directly or calls a bearing function.  With
+    ``barrier`` set, reachability refuses to cross SYNC-CLAMPED callees
+    (their entry rendezvous re-uniforms the mesh before the collective
+    dispatches)."""
+    bearing = set(_direct_collective_fns(pkg))
+    clamped = sync_clamped(pkg) if barrier else set()
+    # A sync-clamped function's own collectives sit BEHIND its
+    # rendezvous: callers reaching only them cannot diverge the mesh.
+    bearing -= clamped
+    calls = callee_map(pkg)
+    changed = True
+    while changed:
+        changed = False
+        for qual, callees in calls.items():
+            if qual in bearing:
+                continue
+            for callee in callees:
+                if callee in bearing and callee not in clamped:
+                    bearing.add(qual)
+                    changed = True
+                    break
+    return bearing
+
+
+def bearing_any(pkg) -> Set[str]:
+    """Functions from which a collective dispatch is reachable (no
+    barriers) — G016's reachability predicate."""
+    cached = getattr(pkg, "_bearing_any", None)
+    if cached is None:
+        cached = pkg._bearing_any = _bearing_closure(pkg, barrier=False)
+    return cached
+
+
+def bearing_guarded(pkg) -> Set[str]:
+    """Reachability that stops at sync-clamped callees — G015's
+    predicate: a divergent branch only matters when a collective can
+    dispatch before the next rendezvous re-uniforms the mesh."""
+    cached = getattr(pkg, "_bearing_guarded", None)
+    if cached is None:
+        cached = pkg._bearing_guarded = _bearing_closure(pkg, barrier=True)
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# chain declarations (static parses of the live modules)
+
+
+def chains_decl(pkg) -> Dict[str, Tuple]:
+    """``chain -> (stage order, ctx, dict-key node)`` parsed from the
+    ``CHAINS = {...}`` assignment (reliability/watchdog.py in the real
+    tree).  Empty when the linted tree declares none."""
+    cached = getattr(pkg, "_chains_decl", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, Tuple] = {}
+    for ctx in pkg.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.nodes(ast.Assign, ast.AnnAssign):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Name) and t.id == "CHAINS"
+                for t in targets
+            ):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Dict):
+                continue
+            for key, val in zip(value.keys, value.values):
+                if not (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                ):
+                    continue
+                stages = tuple(
+                    e.value
+                    for e in ast.walk(val)
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+                out[key.value] = (stages, ctx, key)
+    pkg._chains_decl = out
+    return out
+
+
+def consensus_decl(pkg) -> Dict[str, Tuple]:
+    """``chain -> (ctx, element node)`` parsed from the
+    ``CONSENSUS_CHAINS = (...)`` assignment (reliability/quorum.py)."""
+    cached = getattr(pkg, "_consensus_decl", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, Tuple] = {}
+    for ctx in pkg.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.nodes(ast.Assign, ast.AnnAssign):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Name) and t.id == "CONSENSUS_CHAINS"
+                for t in targets
+            ):
+                continue
+            if node.value is None:
+                continue
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    out[sub.value] = (ctx, sub)
+    pkg._consensus_decl = out
+    return out
+
+
+def consensus_chain_names(pkg) -> Optional[Set[str]]:
+    """The registered chain-name set, or None when the linted tree
+    declares no CONSENSUS_CHAINS (fixture packages — every downgrade
+    then counts as a sanitizer; there is no registry to hold it to)."""
+    decl = consensus_decl(pkg)
+    return set(decl) if decl else None
+
+
+_CHAIN_WALK_TERMINALS = {
+    "stage_allowed",
+    "floor_stage",
+    "propose",
+    "downgrade",
+}
+
+
+def chain_walk_calls(pkg) -> List[Tuple[str, object, ast.Call, str]]:
+    """Every ``stage_allowed``/``floor_stage``/``propose``/``downgrade``
+    call with a resolvable chain-name first argument, as
+    ``(chain, ctx, call, enclosing-fn-qualname-or-"")`` over NON-TEST
+    files (cached per run)."""
+    cached = getattr(pkg, "_chain_walk_calls", None)
+    if cached is not None:
+        return cached
+    from tools.lint.engine import is_test_path, resolve_str, terminal_name
+
+    out: List[Tuple[str, object, ast.Call, str]] = []
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        table = pkg.graph.by_path.get(ctx.path)
+        enclosing = ctx.enclosing_functions()
+        fn_names: Dict[int, str] = {}
+        if table is not None:
+            for local, fn in table.functions.items():
+                fn_names[id(fn)] = f"{table.name}.{local}"
+        for node in ctx.nodes(ast.Call):
+            if terminal_name(node.func) not in _CHAIN_WALK_TERMINALS:
+                continue
+            if not node.args:
+                continue
+            chain = resolve_str(node.args[0], ctx, pkg)
+            if chain is None:
+                continue
+            fn = enclosing.get(id(node))
+            qual = fn_names.get(id(fn), "") if fn is not None else ""
+            out.append((chain, ctx, node, qual))
+    pkg._chain_walk_calls = out
+    return out

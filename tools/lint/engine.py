@@ -123,9 +123,17 @@ def _parse_waiver_tokens(comment: str) -> Set[str]:
 
 
 class FileContext:
-    """One parsed file: AST + comment map + waiver map + module constants."""
+    """One parsed file: AST + comment map + waiver map + module constants.
 
-    def __init__(self, path: str, source: str):
+    ``fragment`` is an optional per-file cache entry (tools/lint/
+    cache.py, keyed by mtime+size): when given, the tokenize comment
+    scan and the module-constant walks are skipped and the cached
+    facts installed instead — bit-identical results, pinned by tests.
+    """
+
+    def __init__(
+        self, path: str, source: str, fragment: Optional[dict] = None
+    ):
         self.path = path.replace(os.sep, "/")
         self.source = source
         self.lines = source.splitlines()
@@ -147,15 +155,60 @@ class FileContext:
         # line -> [(tokens, justification)] per stacked segment (the
         # inventory's waiver census reads the justifications).
         self.waiver_details: Dict[int, List[Tuple[Set[str], str]]] = {}
-        self._scan_comments()
         self.str_consts: Dict[str, str] = {}
         self.int_consts: Dict[str, int] = {}
+        use_fragment = fragment is not None and self.tree is not None
+        if use_fragment:
+            from tools.lint import cache as _cache
+
+            _cache.apply_fragment(self, fragment)
+        else:
+            self._scan_comments()
+        # Node-type index: ONE ast.walk per file, shared by every rule
+        # and census pass (the profiled v2 wall was dominated by each
+        # rule re-walking every whole-file tree — lint wall time is
+        # CI-budgeted at 15 s).  Subtree walks (function bodies) still
+        # use ast.walk; only whole-tree scans go through the index.
+        self._by_type: Dict[type, List[ast.AST]] = {}
+        self._enclosing_fn: Optional[Dict[int, ast.AST]] = None
         # Decorated def/class line -> extra lines whose waivers attach
         # to it (each decorator line + the line above the first one).
         self._decorator_alt: Dict[int, List[int]] = {}
+        self._functions_bfs: List[ast.AST] = []
         if self.tree is not None:
-            self._collect_consts()
+            for node in ast.walk(self.tree):
+                self._by_type.setdefault(type(node), []).append(node)
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self._functions_bfs.append(node)
+            if not use_fragment:
+                self._collect_consts()
             self._collect_decorator_spans()
+
+    def nodes(self, *types: type) -> List[ast.AST]:
+        """Every node of the given AST type(s), in one-walk BFS order
+        per type (deterministic; use for whole-file scans)."""
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, ()))
+        return out
+
+    def enclosing_functions(self) -> Dict[int, ast.AST]:
+        """``id(node) -> innermost enclosing FunctionDef`` for every
+        node under a function (built lazily once per file and shared:
+        G012 and the v3 collective rules all need it).  Functions are
+        visited in BFS order, so the deepest function's assignment
+        wins."""
+        if self._enclosing_fn is None:
+            enclosing: Dict[int, ast.AST] = {}
+            for fn in self._functions_bfs:
+                for sub in ast.walk(fn):
+                    enclosing[id(sub)] = fn
+            self._enclosing_fn = enclosing
+        return self._enclosing_fn
 
     def _line(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -194,11 +247,9 @@ class FileContext:
                     self.int_consts[tgt.id] = node.value.value
 
     def _collect_decorator_spans(self) -> None:
-        for node in ast.walk(self.tree):
-            if not isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-            ):
-                continue
+        for node in self.nodes(
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef
+        ):
             if not node.decorator_list:
                 continue
             first = min(d.lineno for d in node.decorator_list)
@@ -268,9 +319,7 @@ class PackageContext:
         calls, plus the ``axis_names=`` / ``axis_name=`` keywords of
         ``shard_map(...)``-style calls (the keyword spelling ROADMAP
         queued for G002)."""
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             t = terminal_name(node.func)
             if t in self._MESH_CTORS:
                 exprs = list(node.args) + [
@@ -328,6 +377,72 @@ def resolve_str(
             return pkg.str_consts[node.id]
     if pkg is not None and isinstance(node, (ast.Name, ast.Attribute)):
         return pkg.graph.resolve_str_const(ctx, node)
+    return None
+
+
+def resolve_label(
+    node: ast.AST, ctx: FileContext, pkg: Optional["PackageContext"] = None
+) -> Optional[str]:
+    """Compile-time string resolution for site labels (the G013 v3
+    closure): literals, module/package/cross-file constants
+    (:func:`resolve_str`), f-strings, ``+``/``%`` concatenation, and
+    ``.format(...)`` — each over resolvable parts only.  ``None`` when
+    any part is genuinely dynamic (a loop variable, a parameter): such
+    labels are census blind spots and G013 flags them for a waiver."""
+    s = resolve_str(node, ctx, pkg)
+    if s is not None:
+        return s
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                sub = resolve_label(v.value, ctx, pkg)
+                if sub is None:
+                    return None
+                parts.append(sub)
+            else:
+                return None
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = resolve_label(node.left, ctx, pkg)
+        right = resolve_label(node.right, ctx, pkg)
+        if left is not None and right is not None:
+            return left + right
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        fmt = resolve_label(node.left, ctx, pkg)
+        if fmt is None:
+            return None
+        rhs = (
+            list(node.right.elts)
+            if isinstance(node.right, ast.Tuple)
+            else [node.right]
+        )
+        vals = [resolve_label(r, ctx, pkg) for r in rhs]
+        if any(v is None for v in vals):
+            return None
+        try:
+            return fmt % tuple(vals)
+        except (TypeError, ValueError):
+            return None
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+        and not node.keywords
+    ):
+        fmt = resolve_label(node.func.value, ctx, pkg)
+        if fmt is None:
+            return None
+        vals = [resolve_label(a, ctx, pkg) for a in node.args]
+        if any(v is None for v in vals):
+            return None
+        try:
+            return fmt.format(*vals)
+        except (IndexError, KeyError, ValueError):
+            return None
     return None
 
 
@@ -438,22 +553,45 @@ def lint_paths(
     baseline: Optional[dict] = None,
     rules: Optional[Sequence] = None,
     env_registry: Optional[dict] = None,
+    use_cache: bool = True,
 ) -> LintResult:
+    from tools.lint import cache as _cache
+
     if rules is None:
         from tools.lint.rules import ALL_RULES as rules  # noqa: N811
     if env_registry is None:
         env_registry = load_env_registry(root)
+    cached = _cache.load(root) if use_cache else {}
+    fresh: Dict[str, dict] = {}
     files = []
     for fp in iter_py_files(paths, root):
         rel = os.path.relpath(fp, root)
+        rel_posix = rel.replace(os.sep, "/")
         try:
             with open(fp, "r", encoding="utf-8") as fh:
-                files.append(FileContext(rel, fh.read()))
+                source = fh.read()
         except (OSError, UnicodeDecodeError) as e:
             files.append(FileContext(rel, ""))
             files[-1].parse_error = Finding(
-                "G000", rel.replace(os.sep, "/"), 1, 0, f"unreadable: {e}", ""
+                "G000", rel_posix, 1, 0, f"unreadable: {e}", ""
             )
+            continue
+        fragment = _cache.lookup(cached, rel_posix, fp)
+        ctx = FileContext(rel, source, fragment=fragment)
+        files.append(ctx)
+        if use_cache:
+            fragment = fragment or _cache.to_fragment(ctx, fp)
+            if fragment is not None:
+                fresh[rel_posix] = fragment
+    if use_cache:
+        # Keep entries for files this (possibly subset-path) run never
+        # visited: a targeted `tools.lint some/file.py` must not evict
+        # the full tree's warm cache.  Stale entries self-invalidate
+        # at lookup (mtime+size) and die with the next lint-source
+        # fingerprint change.
+        for rel_posix, entry in cached.items():
+            fresh.setdefault(rel_posix, entry)
+        _cache.save(root, fresh)
     findings, parse_errors, pkg = _run_rules(
         files, rules, env_registry=env_registry
     )
@@ -508,48 +646,140 @@ def is_test_path(path: str) -> bool:
     return any(p in ("tests", "tests_tpu") for p in parts)
 
 
-def fetch_label_sites(ctx: FileContext, pkg: "PackageContext"):
-    """``(label, call-node)`` for every audited-fetch-helper call with a
-    literal site label in this file, resolved to the reliability module
-    through the graph (a local ``fetch()`` of some cache API does not
-    count; a renamed import still does)."""
-    out = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
+def _param_label_values(
+    ctx: FileContext,
+    pkg: "PackageContext",
+    fn: ast.AST,
+    param: str,
+) -> List[str]:
+    """Every compile-time value flowing into ``fn``'s ``param`` across
+    the package: the parameter's literal default plus the resolved
+    argument at every graph-resolvable call site (the
+    ``gather_level_counts_start(site=...)`` pattern — the helper's ONE
+    fetch call fans out to one censused label per caller)."""
+    values: List[str] = []
+    args = fn.args
+    params = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+    if param in params:
+        idx = params.index(param)
+        d_idx = idx - (len(params) - len(args.defaults))
+        if 0 <= d_idx < len(args.defaults):
+            v = resolve_label(args.defaults[d_idx], ctx, pkg)
+            if v is not None:
+                values.append(v)
+    else:
+        # Keyword-only label parameter: its default lives in
+        # kw_defaults, and call sites can only pass it by keyword.
+        for kwarg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if kwarg.arg == param and default is not None:
+                v = resolve_label(default, ctx, pkg)
+                if v is not None:
+                    values.append(v)
+    # Bound-method calls drop the explicit self/cls argument.
+    call_idx = params.index(param) if param in params else -1
+    if call_idx >= 0 and params and params[0] in ("self", "cls"):
+        call_idx -= 1
+    for other in pkg.files:
+        if other.tree is None:
             continue
+        for call in other.nodes(ast.Call):
+            hit = pkg.graph.resolve_call(other, call)
+            if hit is None or hit[1] is not fn:
+                continue
+            expr = None
+            for kw in call.keywords:
+                if kw.arg == param:
+                    expr = kw.value
+            if expr is None and 0 <= call_idx < len(call.args):
+                expr = call.args[call_idx]
+            if expr is None:
+                continue
+            v = resolve_label(expr, other, pkg)
+            if v is not None:
+                values.append(v)
+    return values
+
+
+def fetch_label_sites(ctx: FileContext, pkg: "PackageContext"):
+    """``(resolved, unresolved)`` for this file's audited-fetch-helper
+    calls (resolved to the reliability module through the graph — a
+    local ``fetch()`` of some cache API does not count; a renamed
+    import still does).  ``resolved`` is ``[(label, call-node)]``:
+    labels are compile-time resolved (:func:`resolve_label` — literals,
+    constants, f-strings/``%``/``.format`` over resolvables), and a
+    label that is a PARAMETER of the enclosing helper censuses once per
+    compile-time value flowing into it package-wide (default +
+    resolvable call-site arguments).  ``unresolved`` is the call nodes
+    whose label stayed dynamic — census blind spots G013 flags."""
+    resolved = []
+    unresolved = []
+    enclosing = None
+    for node in ctx.nodes(ast.Call):
         fq = pkg.graph.resolve_expr(ctx, node.func)
         if fq not in _RETRY_FETCH_FQS:
             continue
-        label = None
-        for a in list(node.args) + [
+        exprs = list(node.args) + [
             kw.value for kw in node.keywords if kw.arg == "site"
-        ]:
-            if isinstance(a, ast.Constant) and isinstance(a.value, str):
-                label = a.value
+        ]
+        label = None
+        for a in exprs:
+            label = resolve_label(a, ctx, pkg)
+            if label is not None:
                 break
         if label is not None:
-            out.append((label, node))
-    return out
+            resolved.append((label, node))
+            continue
+        # Param-flow: a Name argument that is a parameter of the
+        # enclosing function censuses per inflowing value.
+        if enclosing is None:
+            enclosing = ctx.enclosing_functions()
+        fn = enclosing.get(id(node))
+        values: List[str] = []
+        if fn is not None:
+            fn_params = {
+                a.arg
+                for a in list(fn.args.posonlyargs)
+                + list(fn.args.args)
+                + list(fn.args.kwonlyargs)
+            }
+            for a in exprs:
+                if isinstance(a, ast.Name) and a.id in fn_params:
+                    values = _param_label_values(ctx, pkg, fn, a.id)
+                    if values:
+                        break
+        if values:
+            for v in sorted(set(values)):
+                resolved.append((v, node))
+        else:
+            unresolved.append(node)
+    return resolved, unresolved
 
 
 def failpoint_fire_sites(ctx: FileContext, pkg: "PackageContext"):
-    """``(site, call-node)`` for literal ``failpoints.fire("...")``
-    sites (dynamic sites — f-strings, variables — are not censusable
-    and are deliberately skipped)."""
-    out = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    """``(resolved, unresolved)`` for ``failpoints.fire(...)`` sites.
+    Labels resolve through the same compile-time machinery as fetch
+    labels (v3 closed the G013 residue: constants, f-strings,
+    ``"write." + name``-style concatenation over resolvables).  Sites
+    that stay dynamic land in ``unresolved`` and G013 flags them — the
+    three real ones (the retry helper's central instrumentation, the
+    atomic writer's per-artifact family, the per-level family) carry
+    waivers naming their site families."""
+    resolved = []
+    unresolved = []
+    for node in ctx.nodes(ast.Call):
         fq = pkg.graph.resolve_expr(ctx, node.func)
         if fq != _FAILPOINT_FIRE_FQ:
             d = dotted_name(node.func)
             if d is None or not d.endswith("failpoints.fire"):
                 continue
-        if node.args and isinstance(node.args[0], ast.Constant) and (
-            isinstance(node.args[0].value, str)
-        ):
-            out.append((node.args[0].value, node))
-    return out
+        if not node.args:
+            continue
+        label = resolve_label(node.args[0], ctx, pkg)
+        if label is not None:
+            resolved.append((label, node))
+        else:
+            unresolved.append(node)
+    return resolved, unresolved
 
 
 def env_read_sites(ctx: FileContext):
@@ -557,7 +787,7 @@ def env_read_sites(ctx: FileContext):
     .get``/``os.getenv``/``os.environ[...]`` (loads only — tests that
     SET knobs are not reads)."""
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes(ast.Call, ast.Subscript):
         name_node = None
         if isinstance(node, ast.Call):
             d = dotted_name(node.func) or ""
@@ -592,10 +822,8 @@ def str_constant_paths(pkg: "PackageContext") -> Dict[str, Set[str]]:
     for ctx in pkg.files:
         if ctx.tree is None:
             continue
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Constant) and isinstance(
-                node.value, str
-            ):
+        for node in ctx.nodes(ast.Constant):
+            if isinstance(node.value, str):
                 out.setdefault(node.value, set()).add(ctx.path)
     pkg._str_constant_paths = out
     return out
@@ -613,23 +841,32 @@ def env_var_references(pkg: "PackageContext") -> Dict[str, Set[str]]:
 
 
 def site_census(pkg: "PackageContext"):
-    """``(fetch_sites, fire_sites, env_reads)`` over every NON-TEST
-    file, each as ``[(key, ctx, node)]`` — built once per run and
-    shared by G013 and the inventory builder."""
+    """``(fetch_sites, fire_sites, env_reads, unresolved)`` over every
+    NON-TEST file — the first three as ``[(key, ctx, node)]``,
+    ``unresolved`` as ``[(kind, ctx, node)]`` for fetch/fire sites
+    whose label stayed dynamic after the compile-time resolution (G013
+    flags those: a label the census cannot prove is a blind spot).
+    Built once per run and shared by G013 and the inventory builder."""
     cached = getattr(pkg, "_site_census", None)
     if cached is not None:
         return cached
-    fetches, fires, envs = [], [], []
+    fetches, fires, envs, unresolved = [], [], [], []
     for ctx in pkg.files:
         if ctx.tree is None or is_test_path(ctx.path):
             continue
-        for label, node in fetch_label_sites(ctx, pkg):
+        resolved, blind = fetch_label_sites(ctx, pkg)
+        for label, node in resolved:
             fetches.append((label, ctx, node))
-        for site, node in failpoint_fire_sites(ctx, pkg):
+        for node in blind:
+            unresolved.append(("fetch", ctx, node))
+        resolved, blind = failpoint_fire_sites(ctx, pkg)
+        for site, node in resolved:
             fires.append((site, ctx, node))
+        for node in blind:
+            unresolved.append(("failpoint", ctx, node))
         for name, node in env_read_sites(ctx):
             envs.append((name, ctx, node))
-    pkg._site_census = (fetches, fires, envs)
+    pkg._site_census = (fetches, fires, envs, unresolved)
     return pkg._site_census
 
 
@@ -647,9 +884,7 @@ def span_declarations(pkg: "PackageContext"):
     for ctx in pkg.files:
         if ctx.tree is None or is_test_path(ctx.path):
             continue
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
-                continue
+        for node in ctx.nodes(ast.Assign, ast.AnnAssign):
             targets = (
                 node.targets
                 if isinstance(node, ast.Assign)
@@ -687,7 +922,7 @@ def build_inventory(pkg: "PackageContext") -> dict:
     """Deterministic contract inventory over the linted package (test
     files are excluded from the site censuses — they exercise sites,
     they do not define them — but included in the waiver census)."""
-    fetch_census, fire_census, env_census = site_census(pkg)
+    fetch_census, fire_census, env_census, _unresolved = site_census(pkg)
     fetches = [{"label": l, "path": c.path} for l, c, _n in fetch_census]
     fires = [{"site": s, "path": c.path} for s, c, _n in fire_census]
     envs = [{"name": n, "path": c.path} for n, c, _n in env_census]
@@ -708,6 +943,14 @@ def build_inventory(pkg: "PackageContext") -> dict:
         {"label": v, "path": c.path}
         for v, c, _n in span_declarations(pkg)
     ]
+    # The v3 collective census (tools/lint/collective.py): every
+    # collective-issuing call site with its mesh axis, issuing engine
+    # path, and enclosing branch conditions — the artifact G015-G017
+    # prove their guard properties against, drift-checked like the
+    # fetch/failpoint censuses.
+    from tools.lint import collective as coll
+
+    collectives = [s.to_entry() for s in coll.census(pkg)]
     return {
         "version": 1,
         "comment": (
@@ -719,6 +962,7 @@ def build_inventory(pkg: "PackageContext") -> dict:
         "failpoint_sites": _counted(fires),
         "span_sites": _counted(spans),
         "env_reads": _counted(envs),
+        "collective_sites": _counted(collectives),
         "waivers": _counted(waivers),
     }
 
@@ -744,7 +988,7 @@ def regenerate_env_registry(
             nontest_names.add(name)
     names = nontest_names | (set(old_vars) & set(refs))
     readers: Dict[str, Set[str]] = {}
-    for name, ctx, _node in site_census(pkg)[2]:
+    for name, ctx, _node in site_census(pkg)[2]:  # env reads
         readers.setdefault(name, set()).add(ctx.path)
     # Knobs read through the strict helpers (utils/env.py) have no
     # literal os.environ read at the call site — the literal name
@@ -752,9 +996,7 @@ def regenerate_env_registry(
     for ctx in pkg.files:
         if ctx.tree is None or is_test_path(ctx.path):
             continue
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             for a in list(node.args) + [kw.value for kw in node.keywords]:
                 if isinstance(a, ast.Constant) and isinstance(
                     a.value, str

@@ -121,16 +121,11 @@ class ShapeFlow:
             if t in _PASSTHROUGH_CALLS:
                 return arg_state
             if self.graph is not None:
-                hit = self.graph.resolve_call(self.ctx, node)
-                if hit is not None:
-                    mod, fn = hit
-                    for local, cand in mod.functions.items():
-                        if cand is fn:
-                            state = self.summaries.get(
-                                f"{mod.name}.{local}"
-                            )
-                            if state is not None:
-                                return state
+                fq = self.graph.resolve_call_fq(self.ctx, node)
+                if fq is not None:
+                    state = self.summaries.get(fq)
+                    if state is not None:
+                        return state
             return CLEAN
         return CLEAN
 
@@ -375,6 +370,353 @@ def return_summaries(
         if not changed:
             break
     return out
+
+
+# -- rank-divergence taint (v3, ISSUE 13) -----------------------------------
+#
+# Values are RANK_UNIFORM or RANK_DIVERGENT.  A divergent value is one
+# that can legitimately differ across the processes of a multi-process
+# mesh: environment reads (PR 12's chaos harness arms failpoints
+# per-rank through FA_FAILPOINTS), wall-clock and RNG reads, degradation
+# ledger state (each rank walks its own cascade), caught exceptions
+# (only the failing rank enters the handler), and per-rank identity
+# (process_index, heartbeat ages).  The ONLY sanctioned ways back to
+# uniformity are the consensus primitives (reliability/quorum.py):
+# ``stage_allowed``/``floor_stage`` answer from the domain-agreed
+# position vector, ``sync`` exchanges it, and a ``downgrade`` of a
+# CONSENSUS_CHAINS-registered chain publishes an epoch-stamped proposal
+# peers adopt before their next dispatch.  G015 walks this lattice to
+# prove no unguarded divergent value can change which (or how many)
+# collectives a rank issues.
+
+RANK_UNIFORM, RANK_DIVERGENT = 0, 1
+
+# Consensus primitives, matched by terminal name (the v1 convention:
+# `quorum.stage_allowed` and an imported bare `stage_allowed` both
+# count).  `sync` is NOT here: the terminal is far too common (mmap,
+# file objects) to let any `.sync()` clamp a function — it must spell
+# or resolve to the quorum module (see RankFlow._is_sanitizer).
+# `downgrade` is conditional on the chain's registration.
+RANK_SANITIZER_NAMES = ("stage_allowed", "floor_stage", "propose")
+
+# Call terminals that read a per-rank source.  env helper names are the
+# strict parsers of utils/env.py; ledger snapshot/summary expose this
+# rank's cascade history; process_index/heartbeat_age are rank identity.
+_RANK_DIVERGENT_TERMINALS = {
+    "getenv",
+    "env_flag",
+    "env_choice",
+    "env_int",
+    "env_float",
+    "process_index",
+    "heartbeat_age",
+    "perf_counter",
+    "perf_counter_ns",
+}
+
+# Dotted spellings (exact or suffix) that read a per-rank source.
+_RANK_DIVERGENT_DOTTED_SUFFIXES = (
+    "environ.get",
+    "ledger.snapshot",
+    "ledger.summary",
+)
+_RANK_DIVERGENT_DOTTED = {
+    "os.getenv",
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.time_ns",
+    "time.monotonic_ns",
+}
+_RANK_DIVERGENT_ROOTS = ("random.", "np.random.", "numpy.random.")
+
+
+def _rank_call_kind(call: ast.Call) -> Optional[str]:
+    """"divergent" / "sanitizer" / "downgrade" / None for a call, by
+    terminal/dotted name (graph resolution refines this in eval)."""
+    from tools.lint.engine import dotted_name, terminal_name
+
+    t = terminal_name(call.func)
+    if t == "downgrade":
+        return "downgrade"
+    if t in RANK_SANITIZER_NAMES:
+        return "sanitizer"
+    if t in _RANK_DIVERGENT_TERMINALS:
+        return "divergent"
+    d = dotted_name(call.func)
+    if d is not None:
+        if d in _RANK_DIVERGENT_DOTTED or d.startswith(
+            _RANK_DIVERGENT_ROOTS
+        ):
+            return "divergent"
+        if d.endswith(_RANK_DIVERGENT_DOTTED_SUFFIXES):
+            return "divergent"
+    return None
+
+
+class RankFlow:
+    """Per-function rank-divergence walk (statement-ordered, same
+    approximation contract as ShapeFlow: branches share an environment,
+    the worse state wins).
+
+    ``summaries`` maps fully-qualified function names to the rank state
+    of their return value; ``consensus_chains`` is the statically
+    parsed ``quorum.CONSENSUS_CHAINS`` set (None = no registration
+    declared in the linted tree, in which case every ``downgrade`` is
+    accepted as a sanitizer — pre-quorum fixture trees have no
+    registry to hold them to)."""
+
+    def __init__(
+        self,
+        ctx,
+        graph: Optional[PackageGraph] = None,
+        summaries: Optional[Dict[str, int]] = None,
+        consensus_chains: Optional[Set[str]] = None,
+    ):
+        self.ctx = ctx
+        self.graph = graph
+        self.summaries = summaries or {}
+        self.consensus_chains = consensus_chains
+
+    def _is_sanitizer(self, call: ast.Call) -> bool:
+        """True when ``call`` is a consensus primitive: stage_allowed /
+        floor_stage / propose, a quorum-resolved ``sync`` rendezvous,
+        or a downgrade whose chain argument is consensus-registered."""
+        kind = _rank_call_kind(call)
+        if kind == "sanitizer":
+            return True
+        from tools.lint.engine import dotted_name, terminal_name
+
+        if terminal_name(call.func) == "sync":
+            # Must spell (or graph-resolve to) the quorum module — any
+            # other `.sync()` (mmap, files) is unrelated host work and
+            # must NOT clamp the enclosing function.
+            d = dotted_name(call.func) or ""
+            if d.endswith("quorum.sync"):
+                return True
+            if self.graph is not None:
+                fq = self.graph.resolve_expr(self.ctx, call.func)
+                if fq is not None and fq.endswith(
+                    "reliability.quorum.sync"
+                ):
+                    return True
+            return False
+        if kind == "downgrade":
+            if self.consensus_chains is None:
+                return True
+            from tools.lint.engine import resolve_str
+
+            chain = None
+            if call.args:
+                chain = resolve_str(call.args[0], self.ctx, None)
+            return chain is not None and chain in self.consensus_chains
+        return False
+
+    def contains_sanitizer(self, root: ast.AST) -> bool:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) and self._is_sanitizer(node):
+                return True
+        return False
+
+    # -- expression evaluation ------------------------------------------
+    def eval(self, node: ast.AST, env: Dict[str, int]) -> int:
+        if isinstance(node, ast.Constant):
+            return RANK_UNIFORM
+        if isinstance(node, ast.Name):
+            return env.get(node.id, RANK_UNIFORM)
+        if isinstance(node, ast.Attribute):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return max(
+                (self.eval(e, env) for e in node.elts),
+                default=RANK_UNIFORM,
+            )
+        if isinstance(node, ast.Dict):
+            return max(
+                (
+                    self.eval(e, env)
+                    for e in list(node.keys) + list(node.values)
+                    if e is not None
+                ),
+                default=RANK_UNIFORM,
+            )
+        if isinstance(node, ast.BinOp):
+            return max(self.eval(node.left, env), self.eval(node.right, env))
+        if isinstance(node, ast.BoolOp):
+            return max(self.eval(v, env) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return max(
+                self.eval(node.left, env),
+                max(self.eval(c, env) for c in node.comparators),
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, ast.IfExp):
+            return max(
+                self.eval(node.test, env),
+                self.eval(node.body, env),
+                self.eval(node.orelse, env),
+            )
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.eval(node.elt, env)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.JoinedStr):
+            return max(
+                (self.eval(v, env) for v in node.values),
+                default=RANK_UNIFORM,
+            )
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Call):
+            if self._is_sanitizer(node):
+                return RANK_UNIFORM
+            if _rank_call_kind(node) == "divergent":
+                return RANK_DIVERGENT
+            if self.graph is not None:
+                fq = self.graph.resolve_call_fq(self.ctx, node)
+                if fq is not None:
+                    state = self.summaries.get(fq)
+                    if state is not None:
+                        return state
+            # Unresolved calls propagate their argument states: parsing
+            # or arithmetic on a divergent read stays divergent.
+            return max(
+                (
+                    self.eval(a, env)
+                    for a in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                ),
+                default=RANK_UNIFORM,
+            )
+        return RANK_UNIFORM
+
+    # -- statement walk -------------------------------------------------
+    def _assign(self, target: ast.AST, state: int, env: Dict[str, int]):
+        if isinstance(target, ast.Name):
+            env[target.id] = state
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign(el, state, env)
+
+    def run(self, body: Sequence[ast.stmt], env: Dict[str, int]) -> None:
+        """Statement-ordered assignment walk (no sinks — G015 interleaves
+        its own branch checks; see rules.DivergentCollectiveRule)."""
+        for stmt in body:
+            self.step(stmt, env)
+
+    def step(self, stmt: ast.stmt, env: Dict[str, int]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested scope, analyzed separately
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            if stmt.value is None:
+                return
+            state = self.eval(stmt.value, env)
+            targets = (
+                stmt.targets
+                if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                self._assign(t, state, env)
+        elif isinstance(stmt, ast.AugAssign):
+            state = max(
+                self.eval(stmt.target, env), self.eval(stmt.value, env)
+            )
+            self._assign(stmt.target, state, env)
+        elif isinstance(stmt, ast.For):
+            self._assign(stmt.target, self.eval(stmt.iter, env), env)
+            self.run(stmt.body + stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self.run(stmt.body + stmt.orelse, env)
+        elif isinstance(stmt, ast.If):
+            self.run(stmt.body, env)
+            self.run(stmt.orelse, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._assign(
+                        item.optional_vars,
+                        self.eval(item.context_expr, env),
+                        env,
+                    )
+            self.run(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            self.run(stmt.body, env)
+            for h in stmt.handlers:
+                if h.name:
+                    env[h.name] = RANK_DIVERGENT
+                self.run(h.body, env)
+            self.run(stmt.orelse + stmt.finalbody, env)
+
+
+def rank_summaries(
+    files: Sequence,
+    graph: PackageGraph,
+    consensus_chains: Optional[Set[str]] = None,
+    max_rounds: int = 5,
+) -> Tuple[Dict[str, int], Set[str]]:
+    """``(summaries, clamped)``: the rank state of every package
+    function's return value, iterated to the same bounded fixpoint as
+    :func:`return_summaries`, plus the set of CONSENSUS-CLAMPED
+    functions — those that call a consensus primitive anywhere in
+    their body.  A clamped function's return value is RANK_UNIFORM by
+    fiat (``_count_reduce_engine`` reads env AND consults
+    ``stage_allowed``: whatever it answers, every peer adopts the
+    agreed floor before the next dispatch), and G015 skips branches
+    inside clamped functions — the consensus floor is consulted in
+    that decision region, which is exactly the guard the rule
+    demands."""
+    out: Dict[str, int] = {}
+    clamped: Set[str] = set()
+    fns = []  # (ctx, qualified name, fn node, flow for round 0)
+    for ctx in files:
+        table = graph.by_path.get(ctx.path)
+        if table is None:
+            continue
+        # The graph rides along so bare `sync` imported from quorum
+        # resolves during the clamped-set scan.
+        flow0 = RankFlow(ctx, graph=graph, consensus_chains=consensus_chains)
+        for local, fn in table.functions.items():
+            qual = f"{table.name}.{local}"
+            out[qual] = RANK_UNIFORM
+            if flow0.contains_sanitizer(fn):
+                clamped.add(qual)
+            fns.append((ctx, qual, fn))
+
+    def compute(flow: RankFlow, fn) -> int:
+        env: Dict[str, int] = {}
+        flow.run(fn.body, env)
+        state = RANK_UNIFORM
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                state = max(state, flow.eval(node.value, env))
+        return state
+
+    for _round in range(max_rounds):
+        first = _round == 0
+        changed = False
+        flows: Dict[str, RankFlow] = {}
+        for ctx, qual, fn in fns:
+            if qual in clamped:
+                continue  # stays RANK_UNIFORM by fiat
+            flow = flows.get(ctx.path)
+            if flow is None:
+                flow = flows[ctx.path] = RankFlow(
+                    ctx,
+                    graph=None if first else graph,
+                    summaries=None if first else out,
+                    consensus_chains=consensus_chains,
+                )
+            state = compute(flow, fn)
+            if state != out[qual]:
+                out[qual] = state
+                changed = True
+        if not changed:
+            break
+    return out, clamped
 
 
 # -- donation tracking ------------------------------------------------------

@@ -54,6 +54,13 @@ class ModuleTable:
         self.functions: Dict[str, ast.AST] = {}
         if ctx.tree is not None:
             self._collect()
+        # Reverse lookup: id(FunctionDef) -> fully-qualified name (the
+        # v3 closures resolve calls on the hot path; a linear scan of
+        # ``functions`` per resolved call does not scale).
+        self.fq_by_id: Dict[int, str] = {
+            id(fn): f"{self.name}.{local}"
+            for local, fn in self.functions.items()
+        }
 
     def _package(self, level: int) -> str:
         """Base package a ``level``-dot relative import resolves against."""
@@ -63,7 +70,7 @@ class ModuleTable:
         return base
 
     def _collect(self) -> None:
-        for node in ast.walk(self.ctx.tree):
+        for node in self.ctx.nodes(ast.Import, ast.ImportFrom):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.asname:
@@ -192,18 +199,24 @@ class PackageGraph:
                 return self.lookup_function(candidates[0])
         return None
 
+    def resolve_call_fq(self, ctx, call: ast.Call) -> Optional[str]:
+        """Resolve a call straight to its target's fully-qualified
+        name (the shared reverse lookup the v3 rules/closures use)."""
+        hit = self.resolve_call(ctx, call)
+        if hit is None:
+            return None
+        mod, target = hit
+        return mod.fq_by_id.get(id(target))
+
     def callees(self, ctx, fn: ast.AST) -> Set[str]:
         """Fully-qualified names of every resolvable call in ``fn``
         (test/diagnostic surface for the call graph)."""
         out: Set[str] = set()
         for node in ast.walk(fn):
             if isinstance(node, ast.Call):
-                hit = self.resolve_call(ctx, node)
-                if hit is not None:
-                    mod, target = hit
-                    for local, cand in mod.functions.items():
-                        if cand is target:
-                            out.add(f"{mod.name}.{local}")
+                fq = self.resolve_call_fq(ctx, node)
+                if fq is not None:
+                    out.add(fq)
         return out
 
     # -- cross-file constants ---------------------------------------------

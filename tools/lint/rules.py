@@ -114,9 +114,7 @@ def _device_functions(ctx: FileContext) -> List[ast.FunctionDef]:
     """Functions whose bodies are traced/compiled: @jit/@shard_map
     decorated, or ``*_kernel``-named (the Pallas kernel convention)."""
     out = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for node in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
         if node.name.endswith("_kernel") or node.name == "_kernel":
             out.append(node)
         elif any(_decorator_marks_device_fn(d) for d in node.decorator_list):
@@ -222,9 +220,7 @@ class HostSyncRule(Rule):
         if not any(d in ctx.path for d in self.fetch_audit_dirs):
             return
         audited = self._helper_audited_calls(ctx)
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             if node.lineno in traced_lines:
                 continue  # already reported above
             if id(node) in audited:
@@ -250,7 +246,7 @@ class HostSyncRule(Rule):
         ``fetch(...)`` (a cache API, a kwarg) must NOT exempt the device
         sync nested in its arguments."""
         names: Set[str] = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes(ast.Import, ast.ImportFrom):
             if isinstance(node, ast.ImportFrom) and node.module:
                 if node.module == self._RETRY_MODULE:
                     for a in node.names:
@@ -282,9 +278,7 @@ class HostSyncRule(Rule):
         if not helper_names:
             return set()
         out: Set[int] = set()
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             d = dotted_name(node.func)
             if d not in helper_names:
                 continue
@@ -366,9 +360,7 @@ class CollectiveAxisRule(Rule):
         )
 
     def check(self, ctx, pkg):
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             t = terminal_name(node.func)
             if t not in self._COLLECTIVES:
                 continue
@@ -394,13 +386,10 @@ class RecompileHazardRule(Rule):
     name = "recompile-hazard"
     aliases = ("compile-cache-ok",)
 
-    def _jit_calls(self, root: ast.AST) -> Iterator[ast.Call]:
-        for node in ast.walk(root):
-            if isinstance(node, ast.Call) and _is_jit_spelling(node.func):
-                yield node
-
     def check(self, ctx, pkg):
-        for node in self._jit_calls(ctx.tree):
+        for node in ctx.nodes(ast.Call):
+            if not _is_jit_spelling(node.func):
+                continue
             for kw in node.keywords:
                 if kw.arg in ("static_argnums", "static_argnames") and (
                     isinstance(kw.value, (ast.List, ast.Set, ast.Dict))
@@ -489,7 +478,7 @@ class DtypeDisciplineRule(Rule):
     def check(self, ctx, pkg):
         allowed = any(p in ctx.path for p in self.allowed_path_parts)
         if not allowed:
-            for node in ast.walk(ctx.tree):
+            for node in ctx.nodes(ast.Attribute, ast.Call):
                 if (
                     isinstance(node, ast.Attribute)
                     and node.attr in self._WIDE
@@ -519,9 +508,7 @@ class DtypeDisciplineRule(Rule):
                                     "modules",
                                 )
         # Exactness claims vs f32 accumulation.
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
             doc = ast.get_docstring(fn) or ""
             if "exact" not in fn.name.lower() and not re.search(
                 r"\bexact", doc, re.IGNORECASE
@@ -560,7 +547,7 @@ class PallasConstraintRule(Rule):
     aliases = ("tile-ok",)
 
     def _imports_pallas(self, ctx: FileContext) -> bool:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes(ast.Import, ast.ImportFrom):
             if isinstance(node, ast.ImportFrom) and (
                 ("pallas" in (node.module or ""))
                 or any("pallas" in a.name for a in node.names)
@@ -575,9 +562,7 @@ class PallasConstraintRule(Rule):
     def check(self, ctx, pkg):
         if not self._imports_pallas(ctx):
             return
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             if terminal_name(node.func) != "BlockSpec":
                 continue
             shape = node.args[0] if node.args else None
@@ -602,9 +587,7 @@ class PallasConstraintRule(Rule):
                     "of 8 (Mosaic tile granularity)",
                 )
         # Python `if` on ref values inside kernel bodies.
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
             ref_params = {
                 a.arg
                 for a in list(fn.args.args) + list(fn.args.posonlyargs)
@@ -646,9 +629,7 @@ class SilentExceptRule(Rule):
     _BROAD = {"Exception", "BaseException"}
 
     def check(self, ctx, pkg):
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
+        for node in ctx.nodes(ast.ExceptHandler):
             broad = node.type is None or (
                 terminal_name(node.type) in self._BROAD
             )
@@ -706,9 +687,7 @@ class HazardousDefaultsRule(Rule):
     }
 
     def check(self, ctx, pkg):
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
             for default in list(fn.args.defaults) + [
                 d for d in fn.args.kw_defaults if d is not None
             ]:
@@ -819,9 +798,7 @@ class ArtifactWriteRule(Rule):
         parts = ctx.path.split("/")
         if "tests" in parts:
             return
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             t = terminal_name(node.func)
             if t == "open_write":
                 yield self.finding(
@@ -935,27 +912,23 @@ class ShapeBucketRule(Rule):
         # mesh.py builds and wraps per compile key) are traced bodies
         # too: their shapes are static per trace, keyed by the caller.
         wrapped = set()
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             t = terminal_name(node.func)
             if t in _JIT_NAMES or t in _SHARD_NAMES:
                 for a in node.args[:1]:
                     if isinstance(a, ast.Name):
                         wrapped.add(a.id)
-        for fn in ast.walk(ctx.tree):
-            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if fn.name in wrapped:
-                    traced_fns.append(fn)
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            if fn.name in wrapped:
+                traced_fns.append(fn)
         for fn in traced_fns:
             for node in ast.walk(fn):
                 traced.add(id(node))
         sf = flow.ShapeFlow(ctx, pkg.graph, summaries)
         scopes = [ctx.tree.body]
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if id(node) not in traced:
-                    scopes.append(node.body)
+        for node in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            if id(node) not in traced:
+                scopes.append(node.body)
         seen = set()
         for body in scopes:
             for call, desc, state in sf.walk(body, {}):
@@ -1026,14 +999,10 @@ class EnvContractRule(Rule):
         reads = eng.env_read_sites(ctx)
         if not reads:
             return
-        # Innermost enclosing function per read node: ast.walk is
-        # breadth-first, so nested defs are visited after their parents
-        # and the deepest function's assignment wins.
-        enclosing = {}
-        for fn in ast.walk(ctx.tree):
-            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for sub in ast.walk(fn):
-                    enclosing[id(sub)] = fn
+        # Innermost enclosing function per read node (shared file-level
+        # map; functions are visited breadth-first, so the deepest
+        # function's assignment wins).
+        enclosing = ctx.enclosing_functions()
         for name, node in reads:
             fn = enclosing.get(id(node))
             if fn is None:
@@ -1101,6 +1070,13 @@ class SiteCensusRule(Rule):
     tools/failpoint_smoke.py) — or carry a waiver saying why injection
     cannot reach it.  Test files exercise sites, they do not define
     them, so their calls are exempt from the census.
+
+    v3 closed the dynamic-label residue: labels resolve through
+    compile-time constants (f-strings, ``+``/``%``/``.format`` over
+    literals and cross-file constants, and helper-parameter flow — a
+    label parameter censuses once per resolvable inflowing value), so
+    a label the resolver still cannot prove is now a FINDING, not a
+    silent skip: resolve it, or waive naming the site family.
     """
 
     id = "G013"
@@ -1122,7 +1098,20 @@ class SiteCensusRule(Rule):
     def check_package(self, pkg):
         from tools.lint import engine as eng
 
-        fetch_sites, fire_sites, _envs = eng.site_census(pkg)
+        fetch_sites, fire_sites, _envs, unresolved = eng.site_census(pkg)
+        # Blind spots: a fetch/fire label the compile-time resolver
+        # cannot prove is invisible to the census (and to the
+        # uniqueness/coverage checks below) — flag it where it is
+        # issued.
+        for kind, ctx, node in unresolved:
+            yield self.finding(
+                ctx,
+                node,
+                f"{kind} site label is not statically resolvable — the "
+                "census (and its uniqueness/coverage guarantees) cannot "
+                "see it; build it from compile-time constants, or waive "
+                "naming the dynamic site family",
+            )
         # Uniqueness: flag EVERY site of a duplicated label, so the
         # finding lands next to both spellings.
         for sites, what in ((fetch_sites, "fetch label"), (
@@ -1194,7 +1183,7 @@ class SpanCensusRule(Rule):
         if not declared:
             return
         declared_set = {v for v, _c, _n in declared}
-        fetch_sites, _fires, _envs = eng.site_census(pkg)
+        fetch_sites, _fires, _envs, _blind = eng.site_census(pkg)
         live = set()
         for label, ctx, node in fetch_sites:
             want = f"fetch.{label}"
@@ -1221,6 +1210,363 @@ class SpanCensusRule(Rule):
             )
 
 
+# ---------------------------------------------------------------------------
+# v3 collective-consistency rules (tools/lint/collective.py + the rank
+# taint lattice in flow.py): PR 12 bounded a divergent collective into
+# PeerLost at runtime; these rules prove at lint time that no unguarded
+# rank-divergent value can change a collective's shape or count.
+
+
+def _rank_facts(pkg):
+    """``(summaries, clamped, consensus_set)`` — the rank-taint
+    fixpoint, cached per run (G015 consults it per file)."""
+    from tools.lint import collective as coll
+    from tools.lint import flow
+
+    cached = getattr(pkg, "_rank_facts", None)
+    if cached is None:
+        consensus = coll.consensus_chain_names(pkg)
+        summaries, clamped = flow.rank_summaries(
+            pkg.files, pkg.graph, consensus
+        )
+        cached = pkg._rank_facts = (summaries, clamped, consensus)
+    return cached
+
+
+class DivergentCollectiveRule(Rule):
+    """G015 — rank-divergent values must not steer collective dispatch.
+
+    A branch whose test is RANK_DIVERGENT (env reads, wall-clock, RNG,
+    ledger/cascade state, caught exceptions, per-rank identity — see
+    flow.py's rank lattice) and whose suites issue — or reach, through
+    the call graph — a mesh collective, changes WHICH or HOW MANY
+    collectives this rank dispatches relative to its peers: the exact
+    mesh-hang class PR 12's quorum bounds into PeerLost at runtime.
+    The consensus primitives are the only sanctioned guards: a branch
+    is exempt when its test consults ``stage_allowed``/``floor_stage``,
+    when the divergent value came from a CONSENSUS-CLAMPED function
+    (one that consults the floor itself), or when the enclosing
+    function runs the consensus machinery.  Reachability stops at
+    sync-clamped callees (``fit`` re-exchanges at ``mine.start`` before
+    its first collective), and an except handler that re-raises or
+    walks a registered cascade chain is the sanctioned divergence
+    path.  The chaos harness's divergence-injection scenario
+    (``tools/chaos.py --procs N``, scenario "divergence") is the
+    runtime counterpart of this static guarantee.
+    """
+
+    id = "G015"
+    name = "divergent-collective-guard"
+    aliases = ("consensus-ok",)
+
+    def _is_collective_call(self, node: ast.Call) -> bool:
+        from tools.lint import collective as coll
+
+        t = terminal_name(node.func)
+        return t in coll.COLLECTIVE_NAMES or coll._is_multi_operand_sort(
+            node
+        )
+
+    def _suite_reaches_collective(
+        self, stmts, ctx, pkg, bearing
+    ) -> Optional[str]:
+        """A collective dispatched under these statements: directly, or
+        through a graph-resolved call into the bearing closure.
+        Returns a short description or None."""
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._is_collective_call(node):
+                    return f"`{terminal_name(node.func)}` on line {node.lineno}"
+                fq = pkg.graph.resolve_call_fq(ctx, node)
+                if fq is not None and fq in bearing:
+                    return f"collective-bearing call `{fq}`"
+        return None
+
+    def check(self, ctx, pkg):
+        from tools.lint import collective as coll
+        from tools.lint import engine as eng
+        from tools.lint import flow
+
+        if eng.is_test_path(ctx.path):
+            return
+        summaries, clamped, consensus = _rank_facts(pkg)
+        bearing = coll.bearing_guarded(pkg)
+        if not bearing and not any(
+            name in ctx.source for name in coll.COLLECTIVE_NAMES
+        ):
+            return
+        rf = flow.RankFlow(ctx, pkg.graph, summaries, consensus)
+        scopes = [ctx.tree.body]
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            # A scope that runs the consensus machinery anywhere is the
+            # guard itself — skip it (and its nested defs are checked
+            # on their own).
+            if not rf.contains_sanitizer(fn):
+                scopes.append(fn.body)
+        seen: Set[int] = set()
+        for body in scopes:
+            yield from self._walk(body, rf, {}, ctx, pkg, bearing, seen)
+
+    def _walk(self, body, rf, env, ctx, pkg, bearing, seen):
+        from tools.lint import flow
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate scope
+            if isinstance(stmt, (ast.If, ast.While)):
+                state = rf.eval(stmt.test, env)
+                if (
+                    state == flow.RANK_DIVERGENT
+                    and id(stmt) not in seen
+                    and not rf.contains_sanitizer(stmt.test)
+                ):
+                    what = self._suite_reaches_collective(
+                        stmt.body + stmt.orelse, ctx, pkg, bearing
+                    )
+                    if what is not None:
+                        seen.add(id(stmt))
+                        yield self.finding(
+                            ctx,
+                            stmt,
+                            "rank-divergent branch steers collective "
+                            f"dispatch ({what}): peers may issue "
+                            "different collectives and the mesh hangs; "
+                            "consult quorum.stage_allowed / exchange at "
+                            "a rendezvous point first, or waive with "
+                            "the lockstep argument",
+                        )
+                yield from self._walk(
+                    stmt.body, rf, env, ctx, pkg, bearing, seen
+                )
+                yield from self._walk(
+                    stmt.orelse, rf, env, ctx, pkg, bearing, seen
+                )
+            elif isinstance(stmt, ast.For):
+                rf._assign(stmt.target, rf.eval(stmt.iter, env), env)
+                yield from self._walk(
+                    stmt.body + stmt.orelse, rf, env, ctx, pkg, bearing,
+                    seen,
+                )
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        rf._assign(
+                            item.optional_vars,
+                            rf.eval(item.context_expr, env),
+                            env,
+                        )
+                yield from self._walk(
+                    stmt.body, rf, env, ctx, pkg, bearing, seen
+                )
+            elif isinstance(stmt, ast.Try):
+                yield from self._walk(
+                    stmt.body, rf, env, ctx, pkg, bearing, seen
+                )
+                for h in stmt.handlers:
+                    if h.name:
+                        env[h.name] = flow.RANK_DIVERGENT
+                    raises = any(
+                        isinstance(s, ast.Raise) for s in ast.walk(h)
+                    )
+                    if (
+                        not raises
+                        and id(h) not in seen
+                        and not rf.contains_sanitizer(h)
+                    ):
+                        what = self._suite_reaches_collective(
+                            h.body, ctx, pkg, bearing
+                        )
+                        if what is not None:
+                            seen.add(id(h))
+                            yield self.finding(
+                                ctx,
+                                h,
+                                "except handler issues collectives "
+                                f"({what}) on a path only the failing "
+                                "rank takes; re-raise, walk a "
+                                "CONSENSUS_CHAINS-registered cascade, "
+                                "or waive with the lockstep argument",
+                            )
+                    yield from self._walk(
+                        h.body, rf, env, ctx, pkg, bearing, seen
+                    )
+                yield from self._walk(
+                    stmt.orelse + stmt.finalbody, rf, env, ctx, pkg,
+                    bearing, seen,
+                )
+            else:
+                rf.step(stmt, env)
+
+
+class ChainConsensusRule(Rule):
+    """G016 — collective-shaping cascade chains must be
+    consensus-registered.
+
+    ``watchdog.CHAINS`` is the one escalation policy; a chain whose
+    downgrade changes collective shape or count is only divergence-safe
+    because ``quorum.CONSENSUS_CHAINS`` carries it in the exchanged
+    position vector.  This rule re-derives "collective-shaping" from
+    the census: a chain walked (``stage_allowed``/``floor_stage``/
+    ``propose``/``downgrade``) from a collective-bearing function — or
+    from a module that dispatches collectives — must appear in
+    ``CONSENSUS_CHAINS``; registered chains must exist in ``CHAINS``
+    and still be walked somewhere.  Both artifacts are parsed from the
+    linted sources (never imported), so the check drift-locks the live
+    modules both ways.  Trees declaring no ``CONSENSUS_CHAINS`` are
+    exempt (pre-quorum fixtures have no registry to check).
+    """
+
+    id = "G016"
+    name = "chain-consensus-registration"
+    aliases = ("chain-ok",)
+
+    def check(self, ctx, pkg):
+        return iter(())
+
+    def check_package(self, pkg):
+        from tools.lint import collective as coll
+
+        chains = coll.chains_decl(pkg)
+        consensus = coll.consensus_decl(pkg)
+        if not chains or not consensus:
+            return
+        bearing = coll.bearing_any(pkg)
+        # Module names derived from the tables (never by string surgery
+        # on the fq — a nested-module cut and a Class.method cut are
+        # indistinguishable in the joined string).
+        bearing_modules = {
+            mod.name
+            for mod in pkg.graph.modules.values()
+            if any(fq in bearing for fq in mod.fq_by_id.values())
+        }
+        walked: Dict[str, Tuple] = {}
+        shaping: Dict[str, str] = {}
+        for chain, wctx, node, qual in coll.chain_walk_calls(pkg):
+            walked.setdefault(chain, (wctx, node))
+            if chain in shaping:
+                continue
+            if qual and qual in bearing:
+                shaping[chain] = f"walked from collective-bearing `{qual}`"
+            else:
+                from tools.lint.graph import module_name
+
+                mod = module_name(wctx.path)
+                if mod in bearing_modules:
+                    shaping[chain] = (
+                        f"walked in collective-dispatching module {mod} "
+                        f"({wctx.path}:{node.lineno})"
+                    )
+        for chain, (stages, cctx, key) in sorted(chains.items()):
+            if chain in consensus or chain not in shaping:
+                continue
+            yield self.finding(
+                cctx,
+                key,
+                f"cascade chain {chain!r} shapes collectives "
+                f"({shaping[chain]}) but is not registered in "
+                "quorum.CONSENSUS_CHAINS — a local walk of this chain "
+                "diverges the mesh; register it (a protocol change: "
+                "the position vector grows) or waive with the "
+                "host-local/lockstep argument",
+            )
+        for chain, (qctx, node) in sorted(consensus.items()):
+            if chain not in chains:
+                yield self.finding(
+                    qctx,
+                    node,
+                    f"CONSENSUS_CHAINS entry {chain!r} does not exist "
+                    "in watchdog.CHAINS — stale registration (the "
+                    "exchanged position vector carries a dead slot)",
+                )
+            elif chain not in walked:
+                yield self.finding(
+                    qctx,
+                    node,
+                    f"CONSENSUS_CHAINS entry {chain!r} is never walked "
+                    "(no stage_allowed/propose/downgrade site remains) "
+                    "— drop the registration or restore the walk",
+                )
+
+
+class SyncCoverageRule(Rule):
+    """G017 — mid-mine re-clamp sites must be exchange-dominated.
+
+    A ``quorum.stage_allowed`` consulted inside a loop is a MID-MINE
+    re-clamp: it re-reads the consensus floor each iteration so an
+    adoption lands before the next dispatch.  That only helps if the
+    loop actually runs the position-vector exchange — otherwise the
+    floor can never change and the re-clamp is theater while a peer's
+    degradation goes unadopted until the mesh hangs.  The innermost
+    enclosing loop must contain a ``quorum.sync`` call, directly or
+    through one resolvable callee (``_checkpoint_levels`` carries the
+    level-boundary sync in the real tree).  Start-of-phase clamps
+    (outside any loop) are covered by the phase rendezvous and exempt.
+    """
+
+    id = "G017"
+    name = "sync-point-coverage"
+    aliases = ("sync-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import collective as coll
+        from tools.lint import engine as eng
+
+        if eng.is_test_path(ctx.path):
+            return
+        if "stage_allowed" not in ctx.source:
+            return
+        # Innermost enclosing loop per node: loops sorted by line; a
+        # nested loop re-assigns its subtree after its parent did.
+        loop_of: Dict[int, ast.AST] = {}
+        loops = sorted(
+            ctx.nodes(ast.For, ast.While), key=lambda n: n.lineno
+        )
+        for loop in loops:
+            for sub in ast.walk(loop):
+                if sub is not loop:
+                    loop_of[id(sub)] = loop
+        if not loop_of:
+            return
+        clamped = coll.sync_clamped(pkg)
+        synced_loops: Dict[int, bool] = {}
+        for node in ctx.nodes(ast.Call):
+            if terminal_name(node.func) != "stage_allowed":
+                continue
+            loop = loop_of.get(id(node))
+            if loop is None:
+                continue  # start-of-phase clamp: rendezvous-covered
+            ok = synced_loops.get(id(loop))
+            if ok is None:
+                ok = self._loop_has_sync(loop, ctx, pkg, clamped)
+                synced_loops[id(loop)] = ok
+            if not ok:
+                yield self.finding(
+                    ctx,
+                    node,
+                    "mid-loop stage_allowed re-clamp is not dominated "
+                    "by a position-vector exchange: the enclosing loop "
+                    "never runs quorum.sync (directly or via a callee), "
+                    "so the consensus floor it re-reads can never move "
+                    "— add the boundary sync or waive with the "
+                    "lockstep argument",
+                )
+
+    def _loop_has_sync(self, loop, ctx, pkg, clamped) -> bool:
+        from tools.lint import collective as coll
+
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            if coll.is_sync_call(node, ctx, pkg):
+                return True
+            fq = pkg.graph.resolve_call_fq(ctx, node)
+            if fq is not None and fq in clamped:
+                return True
+        return False
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncRule(),
     CollectiveAxisRule(),
@@ -1236,6 +1582,9 @@ ALL_RULES: Sequence[Rule] = (
     EnvContractRule(),
     SiteCensusRule(),
     SpanCensusRule(),
+    DivergentCollectiveRule(),
+    ChainConsensusRule(),
+    SyncCoverageRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
