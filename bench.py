@@ -462,6 +462,16 @@ def _emit_final(merged) -> int:
                 "vertical_vs_bitmap_k_le3"
             ),
         }
+    hv = (merged.get("scaling") or {}).get("hier_vs_flat") or {}
+    if hv.get("collective_vs_flat") is not None:
+        # The ISSUE 15 headline: hierarchical-exchange collective bytes
+        # over the flat sparse exchange's, at the largest virtual mesh
+        # both series ran on (per-level intra/inter series in the
+        # record file).
+        compact["hier"] = {
+            "devices": hv.get("devices"),
+            "collective_vs_flat": hv["collective_vs_flat"],
+        }
     rsc = (merged.get("rules_full_scale") or {}).get("scaling") or {}
     d4 = (rsc.get("devices") or {}).get("4") or {}
     if d4.get("join_vs_1dev") is not None:
@@ -527,6 +537,7 @@ def _emit_final(merged) -> int:
         "engine_compare",
         "rule_scaling_4dev",
         "serve_movielens",
+        "hier",
         "webdocs_link_probe_mbyte_s",
         "mfu_pct",
     ):
@@ -1772,9 +1783,14 @@ from fastapriori_tpu.models.apriori import FastApriori
 # shallow-tail fold's per-iteration reduction — sparse since r7
 # (ops/fused.py, the PR-6 residue) — shows its bytes in the same
 # per-level comms fields as the classic levels.
+# argv[5]: exchange_groups for the ISSUE-15 hierarchical series —
+# 1 pins the flat single-level exchange (the r6-comparable sparse
+# series), 0 lets the auto topology group the mesh (sqrt grouping on
+# these virtual meshes; flat below 8 devices where hier cannot win).
 cfg = MinerConfig(min_support=float(sys.argv[3]), num_devices=int(sys.argv[2]),
                   engine="level", log_metrics=True,
-                  count_reduce=sys.argv[4], tail_fuse_rows=8192)
+                  count_reduce=sys.argv[4], tail_fuse_rows=8192,
+                  exchange_groups=int(sys.argv[5]) if len(sys.argv) > 5 else 1)
 m = FastApriori(config=cfg)
 m.run_file(sys.argv[1])
 rec_start = len(m.metrics.records)  # comms for the WARM run only
@@ -1785,22 +1801,30 @@ psum = sum(r.get("psum_bytes", 0) for r in warm)
 gather = sum(r.get("gather_bytes", 0) for r in warm)
 eng = next((r["engine"] for r in warm if r.get("event") == "count_reduce"),
            "dense")
-levels = [
-    {"k": r.get("k"), "reduce": r.get("reduce", "dense"),
-     "psum_bytes": r.get("psum_bytes", 0),
-     "gather_bytes": r.get("gather_bytes", 0)}
-    for r in warm if r.get("event") == "level"
-]
-levels += [
-    {"k": "tail", "reduce": r.get("reduce", "dense"),
-     "psum_bytes": r.get("psum_bytes", 0),
-     "gather_bytes": r.get("gather_bytes", 0),
-     "levels": r.get("levels", 0)}
-    for r in warm if r.get("event") == "tail_fuse"
-]
+exch = next((r for r in warm if r.get("event") == "level"
+             and r.get("exchange")), {}).get("exchange", "flat")
+intra = sum(r.get("intra_bytes", 0) for r in warm)
+inter = sum(r.get("inter_bytes", 0) for r in warm)
+
+def _lvl(r, k):
+    d = {"k": k, "reduce": r.get("reduce", "dense"),
+         "psum_bytes": r.get("psum_bytes", 0),
+         "gather_bytes": r.get("gather_bytes", 0)}
+    # Per-stage (intra/inter) collective bytes per level — the
+    # ISSUE-15 series the hierarchical exchange is judged on.
+    for f in ("exchange", "intra_bytes", "inter_bytes"):
+        if r.get(f) is not None:
+            d[f] = r[f]
+    if k == "tail":
+        d["levels"] = r.get("levels", 0)
+    return d
+
+levels = [_lvl(r, r.get("k")) for r in warm if r.get("event") == "level"]
+levels += [_lvl(r, "tail") for r in warm if r.get("event") == "tail_fuse"]
 print(json.dumps({"wall_s": wall, "psum_bytes": psum,
                   "gather_bytes": gather, "count_reduce": eng,
-                  "levels": levels}))
+                  "exchange": exch, "intra_bytes": intra,
+                  "inter_bytes": inter, "levels": levels}))
 """
 
 
@@ -1824,7 +1848,11 @@ def _scaling_measure(args, deadline=None) -> dict:
     f.close()
     out = {"platform": "virtual-cpu", "n_txns": small.n_txns, "devices": {}}
     try:
-        for n in (1, 2, 4, 8):
+        # 16/32 virtual devices extend the curve into the regime the
+        # hierarchical exchange exists for (ISSUE 15: the flat mask
+        # gather is linear in S; the acceptance figure is hier strictly
+        # below flat at S >= 8, sublinear at 16/32).
+        for n in (1, 2, 4, 8, 16, 32):
             timeout = 1800.0
             if deadline is not None:
                 timeout = min(timeout, max(deadline - time.monotonic(), 0))
@@ -1835,15 +1863,24 @@ def _scaling_measure(args, deadline=None) -> dict:
                     )
                     break
             # Dense first (the r5-comparable psum-invariance series),
-            # then — on real meshes — the sparse engine, whose measured
-            # gather+psum bytes are THE r6 acceptance figure (ISSUE 6:
-            # per-dispatch collective bytes <= 25% of dense at mid
-            # levels on 4+ devices).
-            engines = ("dense",) if n == 1 else ("dense", "sparse")
-            for engine in engines:
+            # then — on real meshes — the sparse engine with the FLAT
+            # exchange (the r6 acceptance figure: collective bytes <=
+            # 25% of dense at 4+ devices), then — where the auto
+            # topology actually groups (n >= 8) — the HIERARCHICAL
+            # exchange, whose bytes-vs-flat ratio is the ISSUE-15
+            # acceptance figure.  Child argv: (engine, exchange_groups);
+            # groups=1 pins flat, 0 = auto grouping.
+            engines = [("dense", 1)]
+            if n > 1:
+                engines.append(("sparse", 1))
+            if n >= 8:
+                engines.append(("hier", 0))
+            for engine, xgroups in engines:
                 proc = subprocess.run(
                     [sys.executable, "-c", _SCALING_CHILD, f.name, str(n),
-                     str(args.min_support), engine],
+                     str(args.min_support),
+                     "sparse" if engine == "hier" else engine,
+                     str(xgroups)],
                     capture_output=True,
                     timeout=timeout,
                 )
@@ -1861,7 +1898,7 @@ def _scaling_measure(args, deadline=None) -> dict:
                         out["devices"][str(n)] = rec
                     else:
                         out["devices"].setdefault(str(n), {})[
-                            "sparse"
+                            engine
                         ] = rec
     finally:
         os.unlink(f.name)
@@ -1889,6 +1926,24 @@ def _scaling_measure(args, deadline=None) -> dict:
                 / rec["psum_bytes"],
                 4,
             )
+        hr = rec.get("hier")
+        if hr:
+            if rec.get("psum_bytes"):
+                hr["collective_vs_dense"] = round(
+                    (hr["psum_bytes"] + hr["gather_bytes"])
+                    / rec["psum_bytes"],
+                    4,
+                )
+            if sp and (sp["psum_bytes"] + sp["gather_bytes"]):
+                # The headline ISSUE-15 figure: the two-level
+                # exchange's total collective bytes as a fraction of
+                # the flat sparse exchange's on the same mesh
+                # (strictly < 1 wherever the auto topology groups).
+                hr["collective_vs_flat"] = round(
+                    (hr["psum_bytes"] + hr["gather_bytes"])
+                    / (sp["psum_bytes"] + sp["gather_bytes"]),
+                    4,
+                )
         print(
             f"scaling[virtual-cpu] n={n}: {rec.get('wall_s', 0.0):.2f}s "
             f"overhead_vs_1dev={ov} psum={rec.get('psum_bytes')}"
@@ -1896,12 +1951,29 @@ def _scaling_measure(args, deadline=None) -> dict:
                 f" sparse_vs_dense={sp['collective_vs_dense']}"
                 if sp and "collective_vs_dense" in sp
                 else ""
+            )
+            + (
+                f" hier_vs_flat={hr['collective_vs_flat']}"
+                f" (exchange={hr.get('exchange')})"
+                if hr and "collective_vs_flat" in hr
+                else ""
             ),
             file=sys.stderr,
         )
     ov8 = (out["devices"].get("8") or {}).get("overhead_vs_1dev")
     if ov8 is not None:
         out["sharding_overhead_8dev"] = ov8
+    # The largest mesh with both series carries the record's headline
+    # hier-vs-flat ratio (rendered on the compact driver line).
+    for n in ("32", "16", "8"):
+        hr = (out["devices"].get(n) or {}).get("hier") or {}
+        if hr.get("collective_vs_flat") is not None:
+            out["hier_vs_flat"] = {
+                "devices": int(n),
+                "collective_vs_flat": hr["collective_vs_flat"],
+                "exchange": hr.get("exchange"),
+            }
+            break
     return out
 
 

@@ -560,7 +560,12 @@ def _run(args) -> int:
             # default_backend guard below for the friendly diagnostic.
             try:
                 jax.config.update("jax_num_cpu_devices", args.num_devices)
-            except RuntimeError:
+            except (RuntimeError, AttributeError):
+                # AttributeError: jax < 0.5 has no such option — there
+                # the XLA_FLAGS device-count split (conftest/bench
+                # convention) is the only mechanism; the env var is the
+                # caller's job and the guard below still verifies the
+                # platform.
                 pass
         # The config only takes effect at backend init; if a caller already
         # initialized backends in this process, fail loudly rather than

@@ -119,6 +119,22 @@ class MinerConfig:
     # payload) — such dispatches stay dense even under count_reduce=
     # "sparse".
     count_sparse_min: int = 4096
+    # Hierarchical (two-level) exchange topology for the pod-scale
+    # collectives (parallel/hier.py, ISSUE 15 / ROADMAP direction 3):
+    # the txn axis's S shards view as a (groups, per_group) grid — the
+    # sparse count reduction's mask-union gather and compact psum run
+    # intra-group then once across groups (per-shard gather bytes drop
+    # from S·N/8 to (per_group+groups)·N/8), and the sharded rule
+    # join's table reassembly restages the same way.  0 = auto (group
+    # at process boundaries on a real multi-host mesh; the divisor of
+    # S nearest √S on single-process virtual meshes; flat below S=8
+    # where the hierarchy cannot strictly win); 1 = flat (the
+    # single-level oracle exchange, also the hier→flat cascade
+    # fallback); any other value must divide the txn shard count
+    # (InputError otherwise).  Bit-exact at any topology — OR/int32-sum
+    # are associative and the reassembly preserves shard order.
+    # FA_EXCHANGE_GROUPS overrides, strictly parsed.
+    exchange_groups: int = 0
     # Mining-engine LAYOUT choice (ROADMAP item 3): "bitmap" runs the
     # horizontal bitmap-matmul engines (the fused/level machinery below
     # — and the differential oracle, pinned bit-exact on every corpus);

@@ -149,6 +149,11 @@ class FastApriori:
         self.metrics = MetricsLogger(
             enabled=self.config.log_metrics
         ).bind_global_ledger()
+        # One-shot W_s cross-check latch + exchanged-totals cache
+        # (ISSUE 15): the mine.start weight-total rendezvous fires once
+        # per miner / once per (t_pad, n_proc).
+        self._wstotals_verified = False
+        self._wstotals_cache: Dict[Tuple[int, int], np.ndarray] = {}
         # Mid-mine resume state (io/checkpoint.py): levels already
         # counted by an interrupted run, consumed by the first mine.
         self._resume_levels: Optional[list] = None
@@ -282,8 +287,13 @@ class FastApriori:
             reason = "one_txn_shard"
         elif ctx.cand_shards != 1:
             reason = "cand_mesh"
-        elif data.shard is not None or jax.process_count() != 1:
-            reason = "multi_process"
+        elif not self._wstotals_available(data):
+            # The blanket multi-process refusal is GONE (ISSUE 15):
+            # the mine.start W_s exchange supplies the cross-host
+            # shard weight totals, so only a sharded CompressedData
+            # with no transport spanning its ingest world still
+            # forces dense.
+            reason = "no_wstotals_transport"
         elif not quorum.stage_allowed("count_reduce", "sparse"):
             # Cross-process consensus floor (ISSUE 12): a peer already
             # degraded this chain — start at the agreed position so
@@ -338,19 +348,231 @@ class FastApriori:
         remainder (ops/count.py ``_heavy_gate``), so shard 0's budget
         carries the remainder total."""
         s = self.context.txn_shards
-        w = np.zeros(t_pad, dtype=np.int64)
-        w[: data.total_count] = data.weights
-        if heavy:
-            low = w % 128
-            per = low.reshape(s, -1).sum(axis=1)
-            per[0] += int((w - low).sum())
+        shard = data.shard
+        if shard is not None and shard.num_processes > 1:
+            # (cached per (t_pad, n_proc) — see _shard_weight_totals)
+            # Multi-process sharded ingest: this process knows only ITS
+            # rows' weights — the per-shard totals cross hosts ONCE at
+            # the mine.start rendezvous (ISSUE 15, the PR-6 "W_s never
+            # crosses hosts" residue).  The multi-host path never uses
+            # the heavy split (heavy is None there by construction).
+            per = self._shard_weight_totals(data, t_pad)
         else:
-            per = w.reshape(s, -1).sum(axis=1)
+            if heavy:
+                w = np.zeros(t_pad, dtype=np.int64)
+                w[: data.total_count] = data.weights
+                low = w % 128
+                per = low.reshape(s, -1).sum(axis=1)
+                per[0] += int((w - low).sum())
+            else:
+                per = self._per_shard_row_totals(data, t_pad, s)
+            # Full-replica fault domains (every rank mines the whole
+            # corpus on its own mesh — the chaos --procs shape): the
+            # W_s vector SHAPES the sparse collectives via the prune
+            # thresholds, so divergent ingests must surface at the
+            # rendezvous, not as silently divergent counts.  The
+            # exchange carries the CANONICAL raw totals (no heavy
+            # split) so every rank posts the same payload regardless
+            # of which engine path reached here first.
+            self._verify_wstotals(data, t_pad)
         total = int(per.sum())
         if total <= 0:
             return np.ones(s, dtype=np.int32)
         thr = -(-(int(data.min_count) * per) // total)  # exact ceil
         return np.maximum(1, thr).astype(np.int32)
+
+    @staticmethod
+    def _per_shard_row_totals(
+        data: CompressedData, pad: int, n_slices: int
+    ) -> np.ndarray:
+        """The ONE canonical per-slice weight-total computation (pad
+        with zero rows, reshape into ``n_slices`` contiguous row
+        ranges, sum) — shared by the local threshold path, the W_s
+        exchange payload, and the advisory cross-check, so the vector
+        the rendezvous verifies can never drift from the vector the
+        thresholds are built from."""
+        w = np.zeros(pad, dtype=np.int64)
+        # lint: host-data -- multiplicity weights are host numpy
+        w[: data.total_count] = data.weights
+        return w.reshape(n_slices, -1).sum(axis=1)
+
+    def _wstotals_available(self, data: CompressedData) -> bool:
+        """True when the per-shard weight totals the sparse thresholds
+        need are computable on this mesh: always single-process; on a
+        multi-process ingest, whenever a transport spans every ingest
+        process (the jax.distributed world itself, or a quorum file
+        domain of the same width) for the one-time mine.start W_s
+        exchange.  The ONE gate both engine resolutions consult — a
+        sharded CompressedData with no transport is the only remaining
+        dense/bitmap forcer (PR 6/7 residue closed otherwise)."""
+        shard = data.shard
+        n_proc = (
+            shard.num_processes if shard is not None
+            else jax.process_count()
+        )
+        if n_proc == 1:
+            return True
+        if shard is None:
+            # Non-sharded data on a multi-process mesh: every process
+            # holds the full weights — totals are local arithmetic.
+            return True
+        # The MESH itself must span the ingest world: the count
+        # collectives (psum/union) only cover all shards when jax's
+        # process world matches the ingest's.  A quorum file domain is
+        # a W_s TRANSPORT, not a mesh — unlocking mining on its say-so
+        # would count each rank's local rows against the global
+        # min_count (review finding on the first cut of this gate);
+        # _shard_weight_totals still prefers it for the exchange
+        # itself when both are present.
+        return jax.process_count() == n_proc
+
+    def _shard_weight_totals(self, data: CompressedData, t_pad: int):
+        """The one-time cross-host W_s exchange (fixed shape: this
+        process's [S_local] per-shard weight totals; S_local =
+        txn_shards / num_processes), at the existing mine.start quorum
+        rendezvous — over the quorum domain's transport when one spans
+        the ingest processes, else the jax.distributed tiny-table
+        channel sharded ingest already uses (mesh.allgather_bytes).
+        Concatenation in process order IS shard order (the mesh's
+        device order is process-major), so the result drops into the
+        weighted-pigeonhole formula unchanged."""
+        from fastapriori_tpu.parallel import mesh as mesh_mod
+
+        shard = data.shard
+        n_proc = shard.num_processes
+        s = self.context.txn_shards
+        cache_key = (t_pad, n_proc)
+        cached = self._wstotals_cache.get(cache_key)
+        if cached is not None:
+            # One rendezvous per mine: the fused setup and the level
+            # loop both need the thresholds, and the exchanged totals
+            # are static for a given padding — re-running the bounded
+            # cross-host round trip would also desynchronize the
+            # per-site round counters if one rank's engine path
+            # resolved differently.
+            return cached
+        local_shards = s // n_proc
+        local_pad = t_pad // n_proc
+        per_local = self._per_shard_row_totals(
+            data, local_pad, local_shards
+        )
+        # lint: host-data -- per-shard totals are host numpy (weights never touch the device here)
+        gathered = quorum.exchange("mine.wstotals", per_local.tolist())
+        if gathered is not None and len(gathered) == n_proc:
+            per = np.concatenate(
+                [
+                    # lint: host-data -- exchanged payloads are python int lists
+                    np.asarray(gathered[r], dtype=np.int64)
+                    for r in range(n_proc)
+                ]
+            )
+        else:
+            blobs = mesh_mod.allgather_bytes(
+                per_local.astype("<i8").tobytes()
+            )
+            per = np.concatenate(
+                [np.frombuffer(b, dtype="<i8") for b in blobs]
+            )
+        if per.size != s:
+            from fastapriori_tpu.errors import InputError
+
+            raise InputError(
+                f"W_s exchange returned {per.size} shard totals for a "
+                f"{s}-shard mesh ({n_proc} ingest processes) — the "
+                "transport does not span the ingest world"
+            )
+        ledger.record(
+            "wstotals_exchange", once_key="mine", procs=n_proc,
+            shards=s,
+        )
+        self._wstotals_cache[cache_key] = per
+        return per
+
+    def _verify_wstotals(self, data: CompressedData, t_pad: int) -> None:
+        """Advisory W_s cross-check on full-replica file-transport
+        domains (tools/chaos.py --procs: every rank mines the same
+        corpus): exchange the locally-computed totals at the same
+        mine.start rendezvous site and classify any mismatch as a
+        MeshDivergence naming both ranks — thresholds derived from
+        divergent ingests would issue sparse collectives whose unions
+        never match, the exact failure mode the consensus layer exists
+        to bound.  One-shot per miner, and the payload is the
+        CANONICAL raw per-shard totals (never the heavy-split
+        redistribution), so every rank posts an identical vector no
+        matter which engine path reaches the check first.  No-op
+        without a file domain (the real-mesh transport's
+        collective-count discipline does not admit an optional
+        exchange)."""
+        dom = quorum.active()
+        if (
+            self._wstotals_verified
+            or dom is None
+            or dom.nprocs == 1
+            or not isinstance(dom.transport, quorum.FileTransport)
+        ):
+            return
+        self._wstotals_verified = True
+        per = self._per_shard_row_totals(
+            data, t_pad, self.context.txn_shards
+        )
+        # lint: host-data -- raw weight totals are host numpy
+        gathered = dom.exchange("mine.wstotals", per.tolist())
+        mine = [int(v) for v in per]
+        for rank in sorted(gathered):
+            if rank != dom.rank and gathered[rank] != mine:
+                raise quorum.MeshDivergence(
+                    "ABORTED: mesh divergence at 'mine.wstotals': rank "
+                    f"{dom.rank} derived shard weight totals {mine} "
+                    f"while rank {rank} derived {gathered[rank]} — "
+                    "sparse prune thresholds from divergent ingests "
+                    "can never issue matching collectives"
+                )
+        ledger.record(
+            "wstotals_exchange", once_key="verify", procs=dom.nprocs,
+            verified=True,
+        )
+
+    # -- exchange topology (ISSUE 15: pod-scale hierarchical exchange) --
+    def _exchange_spec(self):
+        """Resolve the two-level exchange topology for this mine's
+        sparse collectives (parallel/hier.py resolve_spec):
+        ``FA_EXCHANGE_GROUPS`` (strict) over ``config.exchange_groups``
+        — 0 = auto (process boundaries on real multi-host meshes, the
+        divisor nearest √S on single-process virtual ones, flat where
+        the hierarchy cannot strictly win), 1 = flat, any other value
+        must divide the txn axis (InputError).  The quorum consensus
+        floor clamps hier→flat — a peer that walked the exchange chain
+        already issues the flat collectives, and matching their
+        shape/count is mandatory.  The resolved topology lands on the
+        ledger so a record always names which exchange moved its
+        bytes."""
+        from fastapriori_tpu.parallel.hier import resolve_active_spec
+
+        spec = resolve_active_spec(
+            self.context.txn_shards, self.config, unclamped=True
+        )
+        if spec is not None and not quorum.stage_allowed(
+            "exchange", "hier"
+        ):
+            # Consensus floor (the _count_reduce_engine pattern): the
+            # adoption already recorded the cascade walk; this is the
+            # local clamp honoring it.  Recorded ONLY when hier would
+            # otherwise have run — a mine that resolves flat anyway
+            # (knob, small mesh) was never clamped by anyone.
+            ledger.record(
+                "exchange_fallback", once_key="quorum", reason="quorum"
+            )
+            spec = None
+        ledger.record(
+            "exchange_engine",
+            once_key=f"spec:{spec}",
+            engine="hier" if spec is not None else "flat",
+            groups=spec[0] if spec is not None else 1,
+            per_group=spec[1] if spec is not None else (
+                self.context.txn_shards
+            ),
+        )
+        return spec
 
     # -- mining-engine layout choice (ROADMAP item 3: vertical Eclat) --
     _MINE_ENGINES = ("auto", "bitmap", "vertical")
@@ -474,8 +696,18 @@ class FastApriori:
         reason = None
         if ctx.cand_shards != 1:
             reason = "cand_mesh"
-        elif data.shard is not None or jax.process_count() != 1:
+        elif data.shard is None and jax.process_count() != 1:
+            # Non-sharded data on a multi-process mesh: there is no
+            # local-row slice to build a lane block from.
             reason = "multi_process"
+        elif data.shard is not None and not self._wstotals_available(
+            data
+        ):
+            reason = "no_wstotals_transport"
+        elif data.shard is not None and (
+            ctx.txn_shards % data.shard.num_processes != 0
+        ):
+            reason = "mesh_split"
         elif not self._has_csr(data):
             reason = "no_csr"
         elif not quorum.stage_allowed("mine_engine", "vertical"):
@@ -548,34 +780,83 @@ class FastApriori:
         # (FA_INGEST_THREADS): the arena build's reduceat pass splits
         # run-aligned across it (PR-7 residue — it was single-threaded).
         n_threads = ingest_thread_count(cfg.ingest_threads)
+        shard = data.shard
+        multi = shard is not None and shard.num_processes > 1
         with self.metrics.timed("arena_build") as m:
-            arena_np, f_pad, t_pad = vops.build_tid_arena_csr(
-                data.basket_indices,
-                data.basket_offsets,
-                data.num_items,
-                32 * ctx.txn_shards,
-                cfg.item_tile,
-                n_threads=n_threads,
-            )
-            planes_np, scales = vops.weight_bit_planes(
-                # lint: host-data -- CompressedData weights are host numpy
-                np.asarray(data.weights, dtype=np.int64), t_pad
-            )
-            # Census first (vectorized), bucket fill only when the
-            # compressed upload wins: the pow2-bucketed segment lists
-            # pay off below ~half occupancy; dense corpora skip both
-            # the per-item fill loop and the scatter dispatch.
-            _, payload, seg_stats = vops.compress_arena(
-                arena_np, f_pad, build=False
-            )
-            use_compressed = payload * 2 <= arena_np.nbytes
-            buckets = (
-                vops.compress_arena(arena_np, f_pad)[0]
-                if use_compressed
-                else None
-            )
-            arena, upload_bytes = ctx.upload_tid_arena(arena_np, buckets)
-            w_planes = ctx.upload_lane_planes(planes_np)
+            if multi:
+                # Multi-process lane sharding (ISSUE 15, the PR-7
+                # "vertical falls back to bitmap on multi-process
+                # ingest" residue): each process builds ONLY its rows'
+                # lanes, padded to the SAME local row count (max over
+                # shards, 32·local_devices-aligned so lanes split
+                # evenly over this process's devices), and the global
+                # arena assembles with zero cross-host data movement —
+                # the lane twin of the bitmap path's sharded branch.
+                # The bit-plane count derives from the ingest-exchanged
+                # GLOBAL max weight (SPMD static shapes).
+                from fastapriori_tpu.ops.bitmap import pad_axis
+
+                n_proc = shard.num_processes
+                local_devices = max(ctx.txn_shards // n_proc, 1)
+                local_pad = max(
+                    pad_axis(c, 32 * local_devices)
+                    for c in shard.local_counts
+                )
+                arena_np, f_pad, t_local = vops.build_tid_arena_csr(
+                    data.basket_indices,
+                    data.basket_offsets,
+                    data.num_items,
+                    local_pad,
+                    cfg.item_tile,
+                    n_threads=n_threads,
+                )
+                assert t_local == local_pad, (t_local, local_pad)
+                t_pad = local_pad * n_proc
+                planes_np, scales = vops.weight_bit_planes(
+                    # lint: host-data -- CompressedData weights are host numpy
+                    np.asarray(data.weights, dtype=np.int64),
+                    local_pad,
+                    min_planes=max(
+                        int(shard.max_weight).bit_length(), 1
+                    ),
+                )
+                use_compressed = False
+                seg_stats = {"occupancy": -1.0}
+                arena, upload_bytes = ctx.upload_tid_arena_local(
+                    arena_np
+                )
+                w_planes = ctx.upload_lane_planes_local(planes_np)
+            else:
+                arena_np, f_pad, t_pad = vops.build_tid_arena_csr(
+                    data.basket_indices,
+                    data.basket_offsets,
+                    data.num_items,
+                    32 * ctx.txn_shards,
+                    cfg.item_tile,
+                    n_threads=n_threads,
+                )
+                planes_np, scales = vops.weight_bit_planes(
+                    # lint: host-data -- CompressedData weights are host numpy
+                    np.asarray(data.weights, dtype=np.int64), t_pad
+                )
+                # Census first (vectorized), bucket fill only when the
+                # compressed upload wins: the pow2-bucketed segment
+                # lists pay off below ~half occupancy; dense corpora
+                # skip both the per-item fill loop and the scatter
+                # dispatch.
+                _, payload, seg_stats = vops.compress_arena(
+                    arena_np, f_pad, build=False
+                )
+                use_compressed = payload * 2 <= arena_np.nbytes
+                buckets = (
+                    vops.compress_arena(arena_np, f_pad)[0]
+                    if use_compressed
+                    else None
+                )
+                arena, upload_bytes = ctx.upload_tid_arena(
+                    arena_np, buckets
+                )
+                w_planes = ctx.upload_lane_planes(planes_np)
             m.update(
                 shape=[f_pad + 1, t_pad // 32],
                 planes=len(scales),
@@ -616,6 +897,13 @@ class FastApriori:
                 reason="tiny_candidate_set", site="fused",
             )
             count_reduce = "dense"  # tiny candidate space: psum wins
+        # Exchange topology for the fused program's sparse collectives
+        # (ISSUE 15) — this setup is shared by both fused flavors and
+        # runs before build(), the one place their compiles are keyed;
+        # the packed-upload path never passes _level_loop's install.
+        ctx.set_exchange_spec(
+            self._exchange_spec() if count_reduce == "sparse" else None
+        )
         sparse_thr = (
             self._sparse_thresholds(data, t_pad, heavy=False)
             if count_reduce == "sparse"
@@ -1949,10 +2237,12 @@ class FastApriori:
                         )
 
                         g2, p2 = sparse_psum_bytes(
-                            f_pad * f_pad, caps[0], ctx.txn_shards
+                            f_pad * f_pad, caps[0], ctx.txn_shards,
+                            ctx.exchange_spec,
                         )
                         gl, pl = sparse_psum_bytes(
-                            m_cap * f_pad, caps[1], ctx.txn_shards
+                            m_cap * f_pad, caps[1], ctx.txn_shards,
+                            ctx.exchange_spec,
                         )
                         psum_b = p2 + (n_iters - 1) * pl
                         gather_b = g2 + (n_iters - 1) * gl
@@ -2355,6 +2645,14 @@ class FastApriori:
         # fallback.  Resolved once per mine; the per-shard prune
         # thresholds are static (shard weight totals).
         count_reduce, _cr_req = self._count_reduce_engine(data)
+        # Exchange topology for every sparse collective this mine
+        # issues (ISSUE 15): resolved once, installed on the context —
+        # the kernel builders key their compiles on it, so a later
+        # hier→flat clamp recompiles (and re-issues) flat collectives
+        # from the next dispatch on.
+        ctx.set_exchange_spec(
+            self._exchange_spec() if count_reduce == "sparse" else None
+        )
         sparse_thr = (
             self._sparse_thresholds(data, t_pad, heavy is not None)
             if count_reduce == "sparse"
@@ -2493,24 +2791,55 @@ class FastApriori:
                         sp_cap = self._sparse_cap(
                             f_pad_p * f_pad_p, hint_key=spk
                         )
-                    if vertical:
-                        idx, cnt, n2, tri, counts_dev, rinfo = (
-                            ctx.vertical_pair_gather(
+                    def _pair_dispatch(sp_cap_, thr_):
+                        if vertical:
+                            return ctx.vertical_pair_gather(
                                 bitmap, w_digits, scales, min_count, f,
                                 cap, cfg.level_txn_chunk,
                                 fast_f32=fast_f32,
-                                sparse_cap=sp_cap, sparse_thr=sparse_thr,
+                                sparse_cap=sp_cap_, sparse_thr=thr_,
                             )
+                        return ctx.pair_gather(
+                            bitmap, w_digits, scales, min_count, f,
+                            cap,
+                            heavy_b=hb, heavy_w=hw,
+                            fast_f32=fast_f32,
+                            sparse_cap=sp_cap_, sparse_thr=thr_,
                         )
-                    else:
+
+                    try:
                         idx, cnt, n2, tri, counts_dev, rinfo = (
-                            ctx.pair_gather(
-                                bitmap, w_digits, scales, min_count, f,
-                                cap,
-                                heavy_b=hb, heavy_w=hw,
-                                fast_f32=fast_f32,
-                                sparse_cap=sp_cap, sparse_thr=sparse_thr,
+                            _pair_dispatch(sp_cap, sparse_thr)
+                        )
+                    except Exception as exc:
+                        # Transient exhaustion at the SPARSE pair fetch
+                        # walks the cascade like the level path
+                        # (exchange hier→flat first, then count_reduce
+                        # sparse→dense) and redoes the pair dense —
+                        # exact either way; the dense fetch is its own
+                        # audited site with a fresh retry budget.
+                        # Dense-engine exhaustion has nowhere to walk
+                        # and re-raises classified.
+                        if sp_cap is None or not watchdog.transient(
+                            exc
+                        ):
+                            raise
+                        site_p = "vpair" if vertical else "pair"
+                        if ctx.exchange_spec is not None:
+                            watchdog.downgrade(
+                                "exchange", "hier", "flat",
+                                reason="transient_exhausted",
+                                site=site_p,
                             )
+                            ctx.set_exchange_spec(None)
+                        watchdog.downgrade(
+                            "count_reduce", "sparse", "dense",
+                            reason="transient_exhausted", site=site_p,
+                            error=f"{type(exc).__name__}: {exc}"[:200],
+                        )
+                        count_reduce, sparse_thr = "dense", None
+                        idx, cnt, n2, tri, counts_dev, rinfo = (
+                            _pair_dispatch(None, None)
                         )
                     if rinfo.get("fallback") == "sparse_overflow":
                         # Remember the true union size so repeat runs
@@ -2554,6 +2883,14 @@ class FastApriori:
                     reduce=rinfo["reduce"],
                     psum_bytes=rinfo["psum_bytes"],
                     gather_bytes=rinfo["gather_bytes"],
+                    **{
+                        kf: rinfo[kf]
+                        for kf in (
+                            "exchange", "intra_bytes", "inter_bytes",
+                            "exchange_groups",
+                        )
+                        if kf in rinfo
+                    },
                 )
             if need_n2:
                 # Cold path: the pair gather above doubles as the fused
@@ -2711,6 +3048,18 @@ class FastApriori:
                     reason="quorum", k=int(k),
                 )
                 count_reduce, sparse_thr = "dense", None
+            if ctx.exchange_spec is not None and not quorum.stage_allowed(
+                "exchange", "hier"
+            ):
+                # A peer walked hier→flat: the very next sparse
+                # dispatch must issue the FLAT collectives (the spec is
+                # in every kernel cache key, so this re-clamp is a
+                # recompile, not a silent shape mismatch).
+                ledger.record(
+                    "exchange_fallback", once_key="quorum",
+                    reason="quorum", k=int(k),
+                )
+                ctx.set_exchange_spec(None)
             if fused_ckpt and not quorum.stage_allowed("engine", "fused"):
                 fused_ckpt = False  # per-level (still checkpointed)
             if tail_ok and not quorum.stage_allowed("engine", "tail"):
@@ -3187,13 +3536,25 @@ class FastApriori:
             n_iters = max(int(np.count_nonzero(n_lvl)), 1)
             d_eff = len(scales)
             if sp_cap is not None:
-                from fastapriori_tpu.ops.count import sparse_psum_bytes
+                from fastapriori_tpu.ops.count import (
+                    sparse_psum_bytes,
+                    sparse_stage_bytes,
+                )
 
+                xspec = ctx.exchange_spec
                 g_b, p_b = sparse_psum_bytes(
-                    p_cap * f_pad, sp_cap, ctx.txn_shards
+                    p_cap * f_pad, sp_cap, ctx.txn_shards, xspec
+                )
+                i_b, e_b = sparse_stage_bytes(
+                    p_cap * f_pad, sp_cap, ctx.txn_shards, xspec
                 )
                 psum_b = n_iters * p_b
                 gather_b = n_iters * g_b
+                met.update(
+                    intra_bytes=n_iters * i_b,
+                    inter_bytes=n_iters * e_b,
+                    exchange="hier" if xspec is not None else "flat",
+                )
             else:
                 psum_b = n_iters * 4 * p_cap * f_pad
                 gather_b = 0
@@ -3507,14 +3868,30 @@ class FastApriori:
                     nb_pad * (1 + d_eff) * t_pad * p_cap * f_pad
                 )
             if sp_cap is not None:
-                from fastapriori_tpu.ops.count import sparse_psum_bytes
+                from fastapriori_tpu.ops.count import (
+                    sparse_psum_bytes,
+                    sparse_stage_bytes,
+                )
 
+                xspec = ctx.exchange_spec
                 g_b, p_b = sparse_psum_bytes(
-                    c_cap, sp_cap, ctx.txn_shards
+                    c_cap, sp_cap, ctx.txn_shards, xspec
+                )
+                i_b, e_b = sparse_stage_bytes(
+                    c_cap, sp_cap, ctx.txn_shards, xspec
                 )
                 stats["psum_bytes"] += nb_pad * p_b
                 stats["gather_bytes"] += nb_pad * g_b
+                stats["intra_bytes"] = (
+                    stats.get("intra_bytes", 0) + nb_pad * i_b
+                )
+                stats["inter_bytes"] = (
+                    stats.get("inter_bytes", 0) + nb_pad * e_b
+                )
                 stats["reduce"] = "sparse"
+                stats["exchange"] = (
+                    "hier" if xspec is not None else "flat"
+                )
             else:
                 stats["psum_bytes"] += nb_pad * 4 * c_cap
         empty = (
@@ -3562,9 +3939,21 @@ class FastApriori:
             # cascade: recount the whole level dense (its fetch is a
             # separate audited site with a fresh retry budget) instead
             # of killing the mine.  Dense-engine exhaustion has nowhere
-            # further to walk and re-raises classified.
+            # further to walk and re-raises classified.  A hierarchical
+            # exchange ALSO walks its own chain first (hier→flat — the
+            # two-level collectives are the newest moving part, and the
+            # flat exchange is the cheaper exact fallback), so the
+            # dense recount below and every later sparse dispatch run
+            # flat.
             if count_reduce != "sparse" or not watchdog.transient(exc):
                 raise
+            if ctx.exchange_spec is not None:
+                watchdog.downgrade(
+                    "exchange", "hier", "flat",
+                    reason="transient_exhausted",
+                    site="vlevel" if vertical else "level", k=s + 1,
+                )
+                ctx.set_exchange_spec(None)
             recount = "transient_exhausted"
             watchdog.downgrade(
                 "count_reduce", "sparse", "dense",

@@ -422,10 +422,23 @@ def rule_level_kernel(
 # kernel is mesh-polymorphic (S=1 reproduces the single-chip engine).
 
 
-def _tiled_all_gather(x: jnp.ndarray, axis_name: str, axis: int):
+def _tiled_all_gather(
+    x: jnp.ndarray, axis_name: str, axis: int, groups=None
+):
     """``all_gather`` of per-shard blocks, concatenated along ``axis`` in
     shard order — the layout inverse of a P(AXIS)-sharded placement.
-    Spelled as stack+reshape (the 0.4.x-safe form under shard_map)."""
+    Spelled as stack+reshape (the 0.4.x-safe form under shard_map).
+    ``groups``: a ``(groups, per_group)`` grid routes the reassembly
+    through the two-level hierarchy (parallel/hier.py
+    hier_tiled_all_gather — intra-group chunk assembly, then one
+    inter-group exchange of whole group chunks): identical shard-order
+    layout, bit for bit, with the slow tier moving ``groups-1`` large
+    contiguous chunks per level instead of ``S-per_group`` small
+    blocks."""
+    if groups is not None:
+        from fastapriori_tpu.parallel.hier import hier_tiled_all_gather
+
+        return hier_tiled_all_gather(x, axis_name, axis, groups)
     g = lax.all_gather(x, axis_name)  # [S, ...]
     if axis == 0:
         return g.reshape((-1,) + x.shape[1:])
@@ -450,6 +463,7 @@ def rule_level_shard_kernel(
     first: bool,
     axis_name: str,
     n_shards: int,
+    groups=None,
 ):
     """Sharded twin of :func:`rule_level_kernel`, still ONE dispatch per
     level: each shard runs the k→(k-1) packed-key binary searches and the
@@ -523,9 +537,9 @@ def rule_level_shard_kernel(
     # per-block MSB-first packing concatenates into exactly the j-major
     # bitmask the single-chip kernel emits); denominators go as int32.
     ok_full = _unpack_bits_msb(
-        _tiled_all_gather(pack_bits_msb(ok), axis_name, 1)
+        _tiled_all_gather(pack_bits_msb(ok), axis_name, 1, groups=groups)
     )
-    d_full = _tiled_all_gather(d, axis_name, 1)  # [k, N_pad]
+    d_full = _tiled_all_gather(d, axis_name, 1, groups=groups)  # [k, N_pad]
     miss = lax.psum(miss, axis_name)
     miss_u = miss.astype(jnp.uint32)
     packed = jnp.concatenate(
@@ -540,8 +554,8 @@ def rule_level_shard_kernel(
     # at upload"): rows arrive sharded over the link, the full table is
     # reassembled once over ICI, and the lex sort for the NEXT level's
     # search runs replicated on it — identical on every shard.
-    mat_full = _tiled_all_gather(mat, axis_name, 0)  # [N_pad, k]
-    cnts_full = _tiled_all_gather(cnts, axis_name, 0)  # [N_pad]
+    mat_full = _tiled_all_gather(mat, axis_name, 0, groups=groups)
+    cnts_full = _tiled_all_gather(cnts, axis_name, 0, groups=groups)
     valid_full = jnp.arange(n_pad, dtype=jnp.int32) < n_real.astype(
         jnp.int32
     )
@@ -564,17 +578,43 @@ def rule_level_shard_kernel(
     )
 
 
-def rule_shard_bytes(k: int, n_pad: int, n_shards: int) -> tuple:
+def rule_shard_bytes(
+    k: int, n_pad: int, n_shards: int, groups=None
+) -> tuple:
     """(gather_bytes, psum_bytes) payload model of one sharded rule-level
     dispatch — the per-level comms accounting rules/gen.py records next
     to the mining collectives: the packed survivor-mask + denominator
     block exchanges and the table reassembly land ``S×`` their payload
     (every shard receives every block), the miss counter is one int32
-    psum."""
+    psum.  Reassembly totals are topology-invariant (every shard must
+    end holding every block; the hierarchy restages, it cannot shrink a
+    concatenation) — ``groups`` changes the intra/inter attribution and
+    the slow-tier message count, not this total; see
+    :func:`rule_shard_stage_bytes`."""
     mask_b = k * (n_pad // 8)
     den_b = 4 * k * n_pad
     table_b = 4 * n_pad * k + 4 * n_pad  # mat_full + cnts_full
     return n_shards * (mask_b + den_b + table_b), 4 * n_shards
+
+
+def rule_shard_stage_bytes(
+    k: int, n_pad: int, n_shards: int, groups=None
+) -> tuple:
+    """Per-shard ``(intra_bytes, inter_bytes, inter_msgs)`` attribution
+    of :func:`rule_shard_bytes`' gather total: flat puts every block on
+    the single slow tier in ``3·(S-1)`` messages (mask + denominator +
+    table exchanges); the hierarchical reassembly assembles group
+    chunks intra-group first, so the slow tier moves ``groups`` whole
+    chunks in ``3·(groups-1)`` messages — the staging win bench's rule
+    scaling records per level."""
+    per_shard = (
+        k * (n_pad // 8) + 4 * k * n_pad + 4 * n_pad * k + 4 * n_pad
+    ) // n_shards
+    from fastapriori_tpu.parallel.hier import gather_stage_bytes
+
+    intra, inter = gather_stage_bytes(per_shard, n_shards, groups)
+    msgs = 3 * ((groups[0] if groups else n_shards) - 1)
+    return intra, inter, msgs
 
 
 # ---------------------------------------------------------------------------

@@ -68,6 +68,7 @@ def local_sparse_psum(
     cap: int,  # static union-compaction slot budget (pow2)
     axis_name: str,
     valid: Optional[jnp.ndarray] = None,  # bool, same shape: candidate mask
+    groups: Optional[tuple] = None,  # (groups, per_group) two-level grid
 ) -> tuple:
     """Threshold-sparse replacement for the dense ``lax.psum`` over the
     txn mesh axis (ROADMAP item 2; *Sparse Allreduce*, arxiv 1312.3020):
@@ -93,6 +94,14 @@ def local_sparse_psum(
        scatter back so callers see the same [N]-shaped tensor, zero at
        provably-infrequent positions.
 
+    ``groups``: a ``(groups, per_group)`` grid over the axis routes
+    both exchanges through the two-level hierarchy
+    (parallel/hier.py — intra-group union/sum, then one inter-group
+    exchange over the grid columns): mask-gather bytes drop from
+    ``S·n/8`` to ``(per_group + groups)·n/8`` per shard, bit-exact by
+    associativity.  None keeps the flat single-level exchange (the
+    differential oracle and the ``hier→flat`` cascade fallback).
+
     Returns ``(counts, n_union)``; ``n_union > cap`` means the
     compaction truncated and the result is UNUSABLE — callers must
     detect it and fall back to the dense reduction (they get the true
@@ -104,17 +113,27 @@ def local_sparse_psum(
     if valid is not None:
         promising = promising & valid.reshape(-1)
     packed = pack_bits_msb(promising)  # [n//8] uint8
-    gathered = lax.all_gather(packed, axis_name)  # [S, n//8]
-    union_packed = lax.reduce(
-        gathered, jnp.uint8(0), lax.bitwise_or, (0,)
-    )
+    if groups is not None:
+        from fastapriori_tpu.parallel.hier import hier_union_packed
+
+        union_packed = hier_union_packed(packed, axis_name, groups)
+    else:
+        gathered = lax.all_gather(packed, axis_name)  # [S, n//8]
+        union_packed = lax.reduce(
+            gathered, jnp.uint8(0), lax.bitwise_or, (0,)
+        )
     union = _unpack_bits_msb(union_packed)  # [n] bool, identical per shard
     nu = jnp.sum(union, dtype=jnp.int32)
     (upos,) = jnp.nonzero(union, size=cap, fill_value=0)
     upos = upos.astype(jnp.int32)
     slot_ok = jnp.arange(cap, dtype=jnp.int32) < nu
     comp = jnp.where(slot_ok, jnp.take(flat, upos), 0)
-    summed = lax.psum(comp, axis_name)
+    if groups is not None:
+        from fastapriori_tpu.parallel.hier import hier_psum
+
+        summed = hier_psum(comp, axis_name, groups)
+    else:
+        summed = lax.psum(comp, axis_name)
     # Scatter-ADD onto zeros: overflow fill slots point at position 0,
     # but their contribution is masked to 0, so a real union member at
     # position 0 still lands its exact sum.
@@ -126,13 +145,37 @@ def local_sparse_psum(
     return counts.reshape(local.shape), nu
 
 
-def sparse_psum_bytes(n_valid: int, cap: int, n_shards: int) -> tuple:
+def sparse_psum_bytes(
+    n_valid: int, cap: int, n_shards: int, groups: Optional[tuple] = None
+) -> tuple:
     """(gather_bytes, psum_bytes) payload model of one
     :func:`local_sparse_psum` call — the per-engine comms accounting
     bench records next to the dense ``4·n`` psum figure.  The mask
-    gather lands S·n/8 bytes per shard; the compact psum payload is
-    4·cap (+4 for the union census riding the survivor fetch)."""
+    gather lands S·n/8 bytes per shard — or ``(per_group + groups)·n/8``
+    under the hierarchical exchange (``groups``; parallel/hier.py) —
+    and the compact psum payload is 4·cap (+4 for the union census
+    riding the survivor fetch; its per-hop payload is
+    topology-independent — the hierarchy restages the reduction, it
+    does not grow the summed tensor)."""
+    if groups is not None:
+        g, per = groups
+        return (g + per) * (n_valid // 8), 4 * cap + 4
     return n_shards * (n_valid // 8), 4 * cap + 4
+
+
+def sparse_stage_bytes(
+    n_valid: int, cap: int, n_shards: int, groups: Optional[tuple] = None
+) -> tuple:
+    """Per-shard ``(intra_bytes, inter_bytes)`` attribution of the SAME
+    payload :func:`sparse_psum_bytes` totals — the per-stage fields the
+    scaling bench and the trace counter tracks record (flat: the whole
+    exchange is the single slow tier; hierarchical: the intra stage
+    moves ``per_group`` mask payloads over the fast tier, the inter
+    stage ``groups`` group aggregates plus the compact psum)."""
+    from fastapriori_tpu.parallel.hier import union_stage_bytes
+
+    intra, inter = union_stage_bytes(n_valid // 8, n_shards, groups)
+    return intra, inter + 4 * cap + 4
 
 
 # Item-axis bound for the in-kernel level-3 candidate census: the extra
@@ -445,6 +488,7 @@ def local_pair_gather(
     fast_f32: bool = False,
     sparse_thr: Optional[jnp.ndarray] = None,  # () int32 per-shard prune
     sparse_cap: Optional[int] = None,  # static union slot budget
+    groups: Optional[tuple] = None,  # two-level exchange grid (hier.py)
 ) -> tuple:
     """C6, transfer-minimal form: the pair Gram matmul PLUS the threshold,
     on device.  Only surviving pairs leave the chip: returns
@@ -492,7 +536,8 @@ def local_pair_gather(
         iu = jnp.arange(f)
         cand = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
         counts, nu = local_sparse_psum(
-            counts, sparse_thr, sparse_cap, axis_name, valid=cand
+            counts, sparse_thr, sparse_cap, axis_name, valid=cand,
+            groups=groups,
         )
     else:
         counts = _psum_if(counts, axis_name)
@@ -540,6 +585,7 @@ def local_level_gather(
     wide_member: bool = False,
     sparse_thr: Optional[jnp.ndarray] = None,  # () int32 per-shard prune
     sparse_cap: Optional[int] = None,  # static union slot budget
+    groups: Optional[tuple] = None,  # two-level exchange grid (hier.py)
 ) -> jnp.ndarray:
     """C8, transfer-minimal form: one compilation serves EVERY level.
 
@@ -626,7 +672,7 @@ def local_level_gather(
         local = jnp.take(counts.reshape(-1), cand_idx)
         if sparse_cap is not None:
             return local_sparse_psum(
-                local, sparse_thr, sparse_cap, axis_name
+                local, sparse_thr, sparse_cap, axis_name, groups=groups
             )
         return _psum_if(local, axis_name)
 
@@ -700,7 +746,9 @@ def local_level_gather(
         )
     local = jnp.take(counts.reshape(-1), cand_idx)
     if sparse_cap is not None:
-        return local_sparse_psum(local, sparse_thr, sparse_cap, axis_name)
+        return local_sparse_psum(
+            local, sparse_thr, sparse_cap, axis_name, groups=groups
+        )
     return _psum_if(local, axis_name)
 
 
@@ -721,6 +769,7 @@ def local_level_gather_batch(
     wide_member: bool = False,
     sparse_thr: Optional[jnp.ndarray] = None,
     sparse_cap: Optional[int] = None,
+    groups: Optional[tuple] = None,
 ) -> jnp.ndarray:
     """A whole level's prefix blocks in ONE launch: ``lax.scan`` over the
     stacked blocks, each step = :func:`local_level_gather`.  Kernel
@@ -750,6 +799,7 @@ def local_level_gather_batch(
             wide_member=wide_member,
             sparse_thr=sparse_thr,
             sparse_cap=sparse_cap,
+            groups=groups,
         )
         return carry, out
 

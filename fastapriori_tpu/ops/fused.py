@@ -154,6 +154,7 @@ def _fused_mine_local(
     axis_name: Optional[str],
     packed_input: bool = True,
     sparse_caps: Optional[Tuple[int, int]] = None,  # (pair, level) budgets
+    groups: Optional[Tuple[int, int]] = None,  # two-level exchange grid
 ):
     f = packed.shape[1] * 8 if packed_input else packed.shape[1]
     t_local = packed.shape[0]
@@ -189,7 +190,7 @@ def _fused_mine_local(
 
         thr = sparse_thr[lax.axis_index(axis_name)]
         out, nu = local_sparse_psum(
-            counts, thr, cap, axis_name, valid=cand_mask
+            counts, thr, cap, axis_name, valid=cand_mask, groups=groups
         )
         return out, nu
 
@@ -411,6 +412,7 @@ def make_fused_miner(
     fast_f32: bool = False,
     packed_input: bool = True,
     sparse_caps: Optional[Tuple[int, int]] = None,
+    groups: Optional[Tuple[int, int]] = None,
 ):
     """Build the jitted fused mining program.  With a mesh, the bitmap and
     weights are sharded over the txn axis inside shard_map (psum
@@ -433,6 +435,7 @@ def make_fused_miner(
         axis_name=AXIS if mesh is not None else None,
         packed_input=packed_input,
         sparse_caps=sparse_caps if mesh is not None else None,
+        groups=groups if mesh is not None else None,
     )
     if mesh is None:
         return jax.jit(kernel)
@@ -469,6 +472,7 @@ def _tail_mine_local(
     slot_caps: Tuple[int, ...],  # per-tail-level row caps (static)
     cand_row_chunks: int = 1,
     sparse_cap: Optional[int] = None,  # [p_cap, F] union slot budget
+    groups: Optional[Tuple[int, int]] = None,  # two-level exchange grid
 ):
     """Shallow-tail fold (VERDICT r3 task 4): once the level engine's
     survivor count drops under the fold threshold, the REMAINING level
@@ -603,7 +607,7 @@ def _tail_mine_local(
             thr_s = sparse_thr[lax.axis_index(axis_name)]
             counts_p, lvl_nu = local_sparse_psum(
                 counts_p, thr_s, sparse_cap, axis_name,
-                valid=cand[pr] & valid_p,
+                valid=cand[pr] & valid_p, groups=groups,
             )
         else:
             counts_p = psum(counts_p)
@@ -708,6 +712,7 @@ def make_tail_miner(
     has_heavy: bool,
     sparse_cap: Optional[int] = None,
     flat_caps: bool = False,
+    groups: Optional[Tuple[int, int]] = None,
 ):
     """Build the jitted shallow-tail program (see _tail_mine_local).
     Sharded over the txn mesh axis like the level kernels; the seed
@@ -732,6 +737,7 @@ def make_tail_miner(
         slot_caps=tail_slot_caps(m_cap, l_max, flat=flat_caps),
         cand_row_chunks=tail_cand_row_chunks(m_cap),
         sparse_cap=sparse_cap,
+        groups=groups if mesh is not None else None,
     )
 
     def wrapped(bitmap, w_digits, seed_cols, n0, min_count, *rest):

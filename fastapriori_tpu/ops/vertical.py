@@ -71,7 +71,7 @@ ONES_WORD = np.uint32(0xFFFFFFFF)
 
 
 def weight_bit_planes(
-    weights: np.ndarray, t_pad: int
+    weights: np.ndarray, t_pad: int, min_planes: int = 1
 ) -> Tuple[np.ndarray, List[int]]:
     """Base-2 bit-planes of the multiplicity weights, packed along the
     tid axis into uint32 lanes (LSB-first within each lane — the same
@@ -81,11 +81,15 @@ def weight_bit_planes(
     Returns ``(planes uint32[B, t_pad//32], scales)`` with
     ``weights == Σ_b scales[b] · bit_b`` and ``scales[b] = 2**b``; B is
     data-dependent but static per compilation (1 for fully-deduplicated
-    or weightless corpora, where plane 0 is the row-validity mask)."""
+    or weightless corpora, where plane 0 is the row-validity mask).
+    ``min_planes`` forces a floor on B — multi-process lane sharding
+    needs a GLOBALLY uniform plane count (SPMD static shapes), derived
+    from the ingest-exchanged global max weight (ShardInfo.max_weight);
+    the extra planes are all-zero and contribute 0 to every count."""
     assert t_pad % 32 == 0, t_pad
     w = np.zeros(t_pad, dtype=np.int64)
     w[: len(weights)] = weights
-    b_planes = max(int(w.max()).bit_length(), 1)
+    b_planes = max(int(w.max()).bit_length(), 1, int(min_planes))
     shifts = np.arange(32, dtype=np.uint32)
     planes = np.zeros((b_planes, t_pad // 32), dtype=np.uint32)
     for b in range(b_planes):
@@ -343,6 +347,7 @@ def vertical_pair_local(
     fast_f32: bool = False,
     sparse_thr: Optional[jnp.ndarray] = None,  # () int32 per-shard prune
     sparse_cap: Optional[int] = None,
+    groups: Optional[tuple] = None,  # two-level exchange grid (hier.py)
 ) -> tuple:
     """C6, vertical-arena form.  At k=2 EVERY pair is a candidate, so
     per-candidate lane intersections degenerate to ``F²/2`` redundant
@@ -429,7 +434,8 @@ def vertical_pair_local(
         iu = jnp.arange(f_pad)
         cand = (iu[None, :] > iu[:, None]) & (iu[None, :] < num_items)
         counts_mat, nu = local_sparse_psum(
-            local, sparse_thr, sparse_cap, axis_name, valid=cand
+            local, sparse_thr, sparse_cap, axis_name, valid=cand,
+            groups=groups,
         )
     elif axis_name is not None:
         counts_mat = lax.psum(local, axis_name)
@@ -453,6 +459,7 @@ def vertical_level_local(
     axis_name: Optional[str] = None,
     sparse_thr: Optional[jnp.ndarray] = None,
     sparse_cap: Optional[int] = None,
+    groups: Optional[tuple] = None,
 ):
     """C8, vertical form: one AND-reduction per prefix row, then per-
     candidate lane intersections with the extension items — only the
@@ -469,7 +476,9 @@ def vertical_level_local(
         pref, arena, w_planes, scales, cand_idx, cand_chunk
     )
     if sparse_cap is not None and axis_name is not None:
-        return local_sparse_psum(local, sparse_thr, sparse_cap, axis_name)
+        return local_sparse_psum(
+            local, sparse_thr, sparse_cap, axis_name, groups=groups
+        )
     if axis_name is not None:
         return lax.psum(local, axis_name)
     return local
@@ -485,6 +494,7 @@ def vertical_level_batch(
     axis_name: Optional[str] = None,
     sparse_thr: Optional[jnp.ndarray] = None,
     sparse_cap: Optional[int] = None,
+    groups: Optional[tuple] = None,
 ):
     """A whole level's prefix blocks in ONE launch (the vertical twin of
     ``local_level_gather_batch``): ``lax.scan`` over the stacked blocks,
@@ -497,7 +507,7 @@ def vertical_level_batch(
         out = vertical_level_local(
             arena, w_planes, scales, pc, ci, cand_chunk,
             axis_name=axis_name, sparse_thr=sparse_thr,
-            sparse_cap=sparse_cap,
+            sparse_cap=sparse_cap, groups=groups,
         )
         return carry, out
 

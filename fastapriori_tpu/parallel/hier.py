@@ -1,0 +1,254 @@
+"""Hierarchical (two-level) mesh exchange primitives (ISSUE 15,
+ROADMAP direction 3).
+
+Every linear-in-S collective in the mining pipeline has the same shape:
+a per-shard payload crosses the FULL txn axis in one flat exchange — the
+packed survivor-mask union all_gather of the sparse count reduction
+(ops/count.py ``local_sparse_psum``: S·N/8 bytes per shard), the sharded
+rule join's next-level table reassembly (ops/contain.py
+``_tiled_all_gather``: S blocks per shard per level), and the compact
+segment psum.  Fine to ~4-8 shards; past that the exchange itself is the
+ceiling (PR 6 / PR 8 residue).
+
+This module is the scalable-allreduce construction of arxiv 1312.3020
+composed with the multi-stage reduction staging of arxiv 1710.07358,
+specialized to a 1-D ``shard_map`` axis: the S shards are viewed as a
+``(groups, per_group)`` grid via ``axis_index_groups`` — collectives
+first run WITHIN each group (intra: the fast tier — same host over ICI
+on a real pod, or a contiguous rank range on a virtual mesh), then ONE
+exchange runs ACROSS groups (inter: the slow tier — DCN), with every
+shard acting as its group's leader for its own grid column, so the
+"intra-group broadcast" of the classic construction is implicit (column
+c of every group already participated in column c's inter exchange).
+
+For REDUCTIONS (the mask-union OR, the segment psum) the staging is
+also a byte win: the intra stage folds ``per_group`` payloads into one
+group aggregate, so the inter stage moves ``groups`` aggregates instead
+of S raw payloads — per-shard union-gather bytes drop from ``S·N/8`` to
+``(per_group + groups)·N/8`` (≈ ``2·√S·N/8`` under √S grouping).  For
+CONCATENATIONS (the rule-table reassembly) the received total is
+invariant (every shard must end with all S blocks); the win is message
+structure — ``(per_group-1) + (groups-1)`` exchanges of large contiguous
+chunks instead of ``S-1`` small blocks, with the slow-tier stage moving
+whole group chunks.
+
+All three primitives are BIT-EXACT twins of their flat forms: the OR
+union and int32 sums are associative/commutative, and the tiled
+reassembly preserves shard-order layout because groups are contiguous
+rank ranges.  The flat exchange stays in ops/* as the differential
+oracle and the ``hier→flat`` cascade fallback
+(reliability/watchdog.py CHAINS["exchange"]).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+# A resolved exchange topology: (groups, per_group) with
+# groups * per_group == n_shards, both > 1.  None everywhere means the
+# flat single-level exchange.
+GroupSpec = Optional[Tuple[int, int]]
+
+
+def index_groups(spec: Tuple[int, int]):
+    """The two ``axis_index_groups`` partitions of a ``(groups,
+    per_group)`` grid over axis indices ``0..S-1``: ``intra`` —
+    contiguous rank ranges, one per group (stage 1 runs inside each) —
+    and ``inter`` — one column per intra-group position, taking rank
+    ``g·per + c`` of every group ``g`` (stage 2 runs across groups;
+    every shard sits in exactly one column, so no separate broadcast
+    stage is needed)."""
+    groups, per = spec
+    intra = [[g * per + i for i in range(per)] for g in range(groups)]
+    inter = [[g * per + i for g in range(groups)] for i in range(per)]
+    return intra, inter
+
+
+def auto_group_count(n_shards: int, n_procs: int = 1) -> int:
+    """The 0-knob topology (config.exchange_groups == 0): on a real
+    multi-host mesh the groups ARE the process boundaries (intra =
+    ICI within a host, inter = DCN across hosts) whenever they divide
+    the axis; on a single-process virtual mesh, the divisor of S
+    closest to √S from below — the byte-optimal split for the
+    reduction exchanges ((per+groups)·N/8 is minimized at per = groups
+    = √S).  Returns 1 (flat) whenever the hierarchy cannot strictly
+    beat the flat exchange (per + groups < S needs S >= 8 for √
+    grouping)."""
+    if n_shards < 8 and not (1 < n_procs < n_shards):
+        return 1
+    if 1 < n_procs < n_shards and n_shards % n_procs == 0:
+        return n_procs
+    best = 1
+    root = int(math.isqrt(n_shards))
+    for g in range(root, 1, -1):
+        if n_shards % g == 0:
+            best = g
+            break
+    # A composite S always has a divisor <= isqrt(S), so best == 1
+    # here means S is prime — no admissible split, stay flat; and a
+    # split whose per+groups does not strictly undercut S cannot win.
+    if best == 1 or best + n_shards // best >= n_shards:
+        return 1
+    return best
+
+
+def resolve_spec(n_shards: int, requested: int, n_procs: int = 1) -> GroupSpec:
+    """Validate/resolve the group-count knob against the mesh:
+    ``requested`` 0 = auto (:func:`auto_group_count`), 1 = flat; any
+    other value must divide ``n_shards`` (InputError otherwise — the
+    FA_NO_PALLAS strictness contract: a typo'd topology silently
+    running flat would be invisible in a record).  ``n_shards`` itself
+    resolves to flat (per_group 1 degenerates: the intra stage is the
+    identity and the inter stage IS the flat exchange).  Returns the
+    ``(groups, per_group)`` spec, or None for the flat exchange."""
+    if requested < 0:
+        from fastapriori_tpu.errors import InputError
+
+        raise InputError(
+            f"exchange_groups must be >= 0 (0 = auto, 1 = flat), got "
+            f"{requested}"
+        )
+    if requested == 0:
+        requested = auto_group_count(n_shards, n_procs)
+    if requested in (1, n_shards):
+        return None
+    if n_shards % requested != 0:
+        from fastapriori_tpu.errors import InputError
+
+        raise InputError(
+            f"exchange_groups={requested} does not divide the txn mesh "
+            f"axis ({n_shards} shards): use a divisor, 1 (flat), or 0 "
+            "(auto — process boundaries on multi-host, sqrt grouping "
+            "on virtual meshes)"
+        )
+    return (requested, n_shards // requested)
+
+
+def resolve_active_spec(
+    n_shards: int, config=None, *, unclamped: bool = False
+) -> GroupSpec:
+    """The full knob resolution (:func:`resolve_spec` over strict
+    ``FA_EXCHANGE_GROUPS`` / ``config.exchange_groups``), clamped at
+    the quorum consensus floor (a peer that walked hier→flat already
+    issues flat collectives).  Shared by the mining engine
+    (models/apriori.py ``_exchange_spec``, which adds the ledger
+    events) and the sharded rule join (rules/gen.py) so the two
+    resolutions can never drift.  ``unclamped`` skips the quorum
+    floor — the caller that wants to RECORD a quorum clamp needs the
+    pre-clamp resolution to tell "clamped" apart from "flat anyway"."""
+    import jax
+
+    from fastapriori_tpu.reliability import quorum
+    from fastapriori_tpu.utils.env import env_int
+
+    req = env_int("FA_EXCHANGE_GROUPS", -1, minimum=0)
+    if req < 0:
+        req = (
+            getattr(config, "exchange_groups", 0)
+            if config is not None
+            else 0
+        )
+    spec = resolve_spec(n_shards, req, jax.process_count())
+    if unclamped:
+        return spec
+    if spec is not None and not quorum.stage_allowed("exchange", "hier"):
+        spec = None
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# in-kernel primitives (called inside shard_map-traced code)
+
+
+def hier_union_packed(
+    packed: jnp.ndarray,  # uint8 [...]: bit-packed per-shard mask
+    axis_name: str,
+    spec: Tuple[int, int],
+) -> jnp.ndarray:
+    """Two-level OR-union of per-shard bit-packed masks — the
+    hierarchical twin of ``all_gather`` + OR-reduce in
+    ops/count.py ``local_sparse_psum`` (bit-exact: OR is associative).
+    Stage 1 unions within each group (per_group payloads over the fast
+    tier); stage 2 unions the group aggregates across groups (groups
+    payloads over the slow tier)."""
+    intra, inter = index_groups(spec)
+    g1 = lax.all_gather(packed, axis_name, axis_index_groups=intra)
+    u1 = lax.reduce(g1, jnp.uint8(0), lax.bitwise_or, (0,))
+    g2 = lax.all_gather(u1, axis_name, axis_index_groups=inter)
+    return lax.reduce(g2, jnp.uint8(0), lax.bitwise_or, (0,))
+
+
+def hier_psum(
+    x: jnp.ndarray, axis_name: str, spec: Tuple[int, int]
+) -> jnp.ndarray:
+    """Two-level psum (intra-group, then across group columns) —
+    bit-exact for the integer payloads every count reduction moves
+    (int32 addition is associative and commutative)."""
+    intra, inter = index_groups(spec)
+    s1 = lax.psum(x, axis_name, axis_index_groups=intra)
+    return lax.psum(s1, axis_name, axis_index_groups=inter)
+
+
+def hier_tiled_all_gather(
+    x: jnp.ndarray, axis_name: str, axis: int, spec: Tuple[int, int]
+) -> jnp.ndarray:
+    """Two-level tiled reassembly of per-shard blocks, concatenated
+    along ``axis`` in SHARD ORDER — the layout twin of ops/contain.py
+    ``_tiled_all_gather`` (groups are contiguous rank ranges, and
+    ``axis_index_groups`` rows land in group-tuple order, so
+    group-major concatenation IS rank order).  Stage 1 assembles each
+    group's contiguous chunk; stage 2 exchanges whole group chunks
+    across the grid columns."""
+    intra, inter = index_groups(spec)
+
+    def _concat(g, base_shape):
+        if axis == 0:
+            return g.reshape((-1,) + base_shape[1:])
+        assert axis == 1, axis
+        g = jnp.moveaxis(g, 0, 1)
+        return g.reshape(base_shape[0], -1, *base_shape[2:])
+
+    chunk = _concat(
+        lax.all_gather(x, axis_name, axis_index_groups=intra), x.shape
+    )
+    return _concat(
+        lax.all_gather(chunk, axis_name, axis_index_groups=inter),
+        chunk.shape,
+    )
+
+
+# ---------------------------------------------------------------------------
+# payload models (host-side accounting — bench/metrics cite these, the
+# same role ops/count.py sparse_psum_bytes plays for the flat exchange)
+
+
+def union_stage_bytes(
+    n_bytes: int, n_shards: int, spec: GroupSpec
+) -> Tuple[int, int]:
+    """Per-shard ``(intra, inter)`` received bytes of one mask-union
+    exchange with per-shard payload ``n_bytes``: flat = everything on
+    the single (slow) tier; hierarchical = ``per·b`` intra +
+    ``groups·b`` inter — the reduction's byte win."""
+    if spec is None:
+        return 0, n_shards * n_bytes
+    groups, per = spec
+    return per * n_bytes, groups * n_bytes
+
+
+def gather_stage_bytes(
+    n_bytes: int, n_shards: int, spec: GroupSpec
+) -> Tuple[int, int]:
+    """Per-shard ``(intra, inter)`` received bytes of one tiled
+    reassembly with per-shard payload ``n_bytes``: the received total
+    is invariant (every shard ends holding all S blocks — S·b), but
+    the hierarchy moves only whole group chunks on the slow tier and
+    in ``groups-1`` messages instead of ``S-per`` — the staging win
+    the per-level rule-join accounting records."""
+    if spec is None:
+        return 0, n_shards * n_bytes
+    groups, per = spec
+    return per * n_bytes, groups * per * n_bytes

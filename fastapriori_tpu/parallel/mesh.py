@@ -209,10 +209,30 @@ class DeviceContext:
             (AXIS, CAND),
         )
         self._fns: Dict[Tuple[int, ...], Tuple] = {}
+        # Hierarchical-exchange topology (parallel/hier.py GroupSpec):
+        # (groups, per_group) routes every sparse count reduction and
+        # the sharded rule join's reassembly through the two-level
+        # exchange; None = flat (the oracle).  Resolved once per mine
+        # by the engine layer (models/apriori.py _exchange_groups —
+        # config.exchange_groups / FA_EXCHANGE_GROUPS / quorum floor)
+        # and installed here because the kernel builders below are the
+        # one place every collective's compile is keyed; the spec is
+        # part of each cache key, so a mid-mine hier→flat re-clamp
+        # compiles (and issues) the flat collectives from the next
+        # dispatch on.
+        self.exchange_spec: Optional[Tuple[int, int]] = None
         self._fused_hints: Dict[Tuple, int] = {}
         self._fused_fails: set = set()
         self._auto_level: set = set()
         self._pair_caps: Dict[Tuple, int] = {}
+
+    def set_exchange_spec(
+        self, spec: Optional[Tuple[int, int]]
+    ) -> None:
+        """Install the resolved two-level exchange topology (or None
+        for flat).  Forward walks only come from the engine layer /
+        quorum consensus; the builders read it at call time."""
+        self.exchange_spec = spec
 
     # -- data placement ----------------------------------------------------
     def shard_bitmap(self, bitmap: np.ndarray) -> jax.Array:
@@ -423,9 +443,10 @@ class DeviceContext:
             ledger.record(
                 "int8_widen", once_key="fused", site="fused", l_max=l_max
             )
+        xspec = self.exchange_spec if sparse_caps is not None else None
         key = (
             "fused", m_cap, l_max, n_digits, n_chunks, fast_f32,
-            packed_input, sparse_caps,
+            packed_input, sparse_caps, xspec,
         )
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import make_fused_miner
@@ -433,6 +454,7 @@ class DeviceContext:
             self._fns[key] = make_fused_miner(
                 self.mesh, m_cap, l_max, n_digits, n_chunks, fast_f32,
                 packed_input=packed_input, sparse_caps=sparse_caps,
+                groups=xspec,
             )
         return self._fns[key]
 
@@ -462,9 +484,10 @@ class DeviceContext:
                 "int8_widen", once_key="tail", site="tail", k0=k0,
                 l_max=l_max,
             )
+        xspec = self.exchange_spec if sparse_cap is not None else None
         key = (
             "tail", tuple(scales), k0, m_cap, p_cap, l_max, n_chunks,
-            has_heavy, sparse_cap, flat_caps,
+            has_heavy, sparse_cap, flat_caps, xspec,
         )
         if key not in self._fns:
             from fastapriori_tpu.ops.fused import make_tail_miner
@@ -472,7 +495,7 @@ class DeviceContext:
             self._fns[key] = make_tail_miner(
                 self.mesh, tuple(scales), k0, m_cap, p_cap, l_max,
                 n_chunks, has_heavy, sparse_cap=sparse_cap,
-                flat_caps=flat_caps,
+                flat_caps=flat_caps, groups=xspec,
             )
         return self._fns[key]
 
@@ -596,9 +619,10 @@ class DeviceContext:
         re-dispatch (ledger event) — exact either way."""
         has_heavy = heavy_b is not None
         f_pad = bitmap.shape[1]
+        xspec = self.exchange_spec if sparse_cap is not None else None
         key = (
             "pair_gather", tuple(scales), cap, fast_f32, has_heavy,
-            sparse_cap,
+            sparse_cap, xspec,
         )
         if key not in self._fns:
             mesh = self.mesh
@@ -618,6 +642,7 @@ class DeviceContext:
                         else None
                     ),
                     sparse_cap=sparse_cap,
+                    groups=xspec,
                 )
 
             in_specs = (
@@ -660,7 +685,7 @@ class DeviceContext:
                 # mesh — account them on top of the dense redo's (the
                 # level path's overflow branch does the same).
                 g_b, p_b = count_ops.sparse_psum_bytes(
-                    f_pad * f_pad, sparse_cap, self.txn_shards
+                    f_pad * f_pad, sparse_cap, self.txn_shards, xspec
                 )
                 res[-1]["fallback"] = "sparse_overflow"
                 res[-1]["n_union"] = nu
@@ -668,14 +693,12 @@ class DeviceContext:
                 res[-1]["gather_bytes"] += g_b
                 return res
             gather_b, psum_b = count_ops.sparse_psum_bytes(
-                f_pad * f_pad, sparse_cap, self.txn_shards
+                f_pad * f_pad, sparse_cap, self.txn_shards, xspec
             )
-            info = {
-                "reduce": "sparse",
-                "psum_bytes": psum_b,
-                "gather_bytes": gather_b,
-                "n_union": nu,
-            }
+            info = self._reduce_info(
+                f_pad * f_pad, sparse_cap, xspec, psum_b, gather_b
+            )
+            info["n_union"] = nu
         else:
             # lint: fetch-site -- the pair phase's ONE audited fetch (packed 2cap+2 ints), retry-wrapped
             out = retry.fetch(lambda: np.asarray(packed), "pair")
@@ -692,6 +715,35 @@ class DeviceContext:
             counts_dev,
             info,
         )
+
+    def _reduce_info(
+        self,
+        n_valid: int,
+        sparse_cap: int,
+        xspec: Optional[Tuple[int, int]],
+        psum_b: int,
+        gather_b: int,
+    ) -> dict:
+        """The sparse reduce_info dict every sparse gather returns —
+        one constructor so the pair/vertical/level accounting can never
+        drift: engine + payload totals plus the two-level exchange's
+        per-stage (intra/inter) attribution (ops/count.py
+        sparse_stage_bytes), the fields bench's scaling series and the
+        trace counter tracks consume."""
+        intra_b, inter_b = count_ops.sparse_stage_bytes(
+            n_valid, sparse_cap, self.txn_shards, xspec
+        )
+        info = {
+            "reduce": "sparse",
+            "psum_bytes": psum_b,
+            "gather_bytes": gather_b,
+            "exchange": "hier" if xspec is not None else "flat",
+            "intra_bytes": intra_b,
+            "inter_bytes": inter_b,
+        }
+        if xspec is not None:
+            info["exchange_groups"] = xspec[0]
+        return info
 
     # -- vertical (Eclat) engine: tid-lane arena + AND/popcount kernels ----
     def upload_tid_arena(self, arena_np: np.ndarray, buckets=None):
@@ -747,6 +799,51 @@ class DeviceContext:
             planes_np, NamedSharding(self.mesh, P(None, AXIS))
         )
 
+    # -- multi-process vertical lanes (ISSUE 15: the PR-7 residue) -------
+    # Lane blocks shard over the txn axis exactly like bitmap ROWS —
+    # lane l holds transactions [32l, 32l+32), and each process's rows
+    # pad to the same local count — so process p's local lanes are
+    # precisely the lanes the P(None, AXIS) sharding assigns to p's
+    # devices: the global arena assembles with zero cross-host data
+    # movement, the lane twin of upload_packed_local.
+    def upload_tid_arena_local(self, arena_local: np.ndarray):
+        """Multi-process twin of :meth:`upload_tid_arena`:
+        ``arena_local`` is ``uint32[F_pad+1, NL_local]`` holding THIS
+        process's lanes (uniform lane count across processes — the
+        engine pads every shard to the same local row count).  The
+        bucket-compressed upload stays single-process (its scatter
+        dispatch would need a global index remap for marginal gain on
+        the already-local payload).  Returns ``(arena, upload_bytes)``."""
+        if jax.process_count() == 1:
+            return self.upload_tid_arena(arena_local)
+        global_shape = (
+            arena_local.shape[0],
+            arena_local.shape[1] * jax.process_count(),
+        )
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(None, AXIS)),
+            arena_local,
+            global_shape,
+        )
+        return arr, arena_local.nbytes
+
+    def upload_lane_planes_local(self, planes_local: np.ndarray):
+        """Multi-process twin of :meth:`upload_lane_planes` (``[B,
+        NL_local]`` per process; B must be globally uniform — the
+        engine derives it from the ingest-exchanged global max weight,
+        ops/vertical.py weight_bit_planes ``min_planes``)."""
+        if jax.process_count() == 1:
+            return self.upload_lane_planes(planes_local)
+        global_shape = (
+            planes_local.shape[0],
+            planes_local.shape[1] * jax.process_count(),
+        )
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(None, AXIS)),
+            planes_local,
+            global_shape,
+        )
+
     def vertical_pair_gather(
         self, arena, w_planes, scales, min_count: int, num_items: int,
         cap: int, txn_chunk: int, fast_f32: bool = False,
@@ -769,9 +866,10 @@ class DeviceContext:
         # chunk count works — size it purely from the [F, tc] bit
         # intermediate budget.
         n_chunks = max(1, -(-nl_local * 32 // max(txn_chunk, 32)))
+        xspec = self.exchange_spec if sparse_cap is not None else None
         key = (
             "vpair", tuple(scales), f_pad, cap, n_chunks, fast_f32,
-            sparse_cap,
+            sparse_cap, xspec,
         )
         if key not in self._fns:
             mesh = self.mesh
@@ -794,6 +892,7 @@ class DeviceContext:
                         else None
                     ),
                     sparse_cap=sparse_cap,
+                    groups=xspec,
                 )
 
             in_specs = (
@@ -832,7 +931,7 @@ class DeviceContext:
                     txn_chunk, fast_f32=fast_f32,
                 )
                 g_b, p_b = count_ops.sparse_psum_bytes(
-                    n_cand, sparse_cap, self.txn_shards
+                    n_cand, sparse_cap, self.txn_shards, xspec
                 )
                 res[-1]["fallback"] = "sparse_overflow"
                 res[-1]["n_union"] = nu
@@ -840,14 +939,12 @@ class DeviceContext:
                 res[-1]["gather_bytes"] += g_b
                 return res
             gather_b, psum_b = count_ops.sparse_psum_bytes(
-                n_cand, sparse_cap, self.txn_shards
+                n_cand, sparse_cap, self.txn_shards, xspec
             )
-            info = {
-                "reduce": "sparse",
-                "psum_bytes": psum_b,
-                "gather_bytes": gather_b,
-                "n_union": nu,
-            }
+            info = self._reduce_info(
+                n_cand, sparse_cap, xspec, psum_b, gather_b
+            )
+            info["n_union"] = nu
         else:
             # lint: fetch-site -- the vertical pair phase's ONE audited fetch (packed 2cap+2 ints), retry-wrapped
             out = retry.fetch(lambda: np.asarray(packed), "vpair")
@@ -885,8 +982,9 @@ class DeviceContext:
         sparse reduction.  No ``k1``/heavy/wide_member machinery: the
         AND identity handles prefix padding and popcounts are exact at
         any depth."""
+        xspec = self.exchange_spec if sparse_cap is not None else None
         key = (
-            "vlevel_batch", tuple(scales), cand_chunk, sparse_cap,
+            "vlevel_batch", tuple(scales), cand_chunk, sparse_cap, xspec,
         )
         if key not in self._fns:
             mesh = self.mesh
@@ -908,6 +1006,7 @@ class DeviceContext:
                         else None
                     ),
                     sparse_cap=s_cap,
+                    groups=xspec,
                 )
                 if s_cap is not None:
                     counts, nus = out
@@ -1138,9 +1237,10 @@ class DeviceContext:
                 )
                 if tt and mt:
                     pallas_tiles = (tt, mt)
+        xspec = self.exchange_spec if sparse_cap is not None else None
         key = (
             "level_gather_batch", tuple(scales), n_chunks, fast_f32,
-            has_heavy, pallas_tiles, wide_member, sparse_cap,
+            has_heavy, pallas_tiles, wide_member, sparse_cap, xspec,
         )
         if key not in self._fns:
             mesh = self.mesh
@@ -1166,6 +1266,7 @@ class DeviceContext:
                         else None
                     ),
                     sparse_cap=s_cap,
+                    groups=xspec,
                 )
                 if s_cap is not None:
                     counts, nus = out
@@ -1349,7 +1450,8 @@ class DeviceContext:
         parent state replicated, outputs replicated after the in-kernel
         mask/denominator/table exchanges.  Mesh-polymorphic: a 1-shard
         mesh reproduces the single-chip kernel bit for bit."""
-        key = ("rule_join_shard", k, bits, first)
+        xspec = self.exchange_spec
+        key = ("rule_join_shard", k, bits, first, xspec)
         if key not in self._fns:
             from fastapriori_tpu.ops.contain import rule_level_shard_kernel
 
@@ -1364,6 +1466,7 @@ class DeviceContext:
                 first=first,
                 axis_name=AXIS,
                 n_shards=self.txn_shards,
+                groups=xspec,
             )
             in_specs = (
                 P(AXIS, None),  # mat (query rows sharded)
@@ -1476,6 +1579,7 @@ class DeviceContext:
         key = (
             "tail_resolve", tuple(scales), k0, m_cap, p_cap, l_max,
             n_chunks, has_heavy, gather_shapes, u24, sparse_cap,
+            self.exchange_spec if sparse_cap is not None else None,
         )
         if key not in self._fns:
             tail_fn = self.tail_miner(

@@ -90,6 +90,12 @@ CONSENSUS_CHAINS: Tuple[str, ...] = (
     "mine_engine",
     "count_reduce",
     "rule_engine",
+    # ISSUE 15: the hierarchical exchange issues DIFFERENT collectives
+    # (two grouped stages) than the flat one, so a hier→flat walk is
+    # collective-shaping and must clamp the whole domain.  Appended at
+    # the END of the wire vector — existing position indices are
+    # unchanged (the order stays a pinned protocol).
+    "exchange",
 )
 
 FENCE_NAME = "FENCE"
@@ -319,16 +325,24 @@ class JaxTransport:
         self.rank = rank
         self.nprocs = nprocs
 
-    def exchange(self, vec, site: str):
+    def exchange(self, vec, site: str, dtype=None):
         import numpy as np
 
         from fastapriori_tpu.reliability import watchdog
 
         from jax.experimental import multihost_utils
 
+        if dtype is None:
+            dtype = np.int32
+
         def thunk():
+            # dtype follows the payload: position vectors are tiny
+            # int32, but the W_s weight-total exchange carries int64
+            # sums that a silent int32 cast would WRAP — corrupting
+            # every rank's sparse prune thresholds identically (the
+            # one corruption the divergence machinery cannot see).
             return multihost_utils.process_allgather(
-                np.asarray(vec, dtype=np.int32)
+                np.asarray(vec, dtype=dtype)
             )
 
         try:
@@ -368,6 +382,8 @@ class QuorumDomain:
         self.consensus = consensus
         self._lock = threading.Lock()
         self._seq = 0
+        # Per-site payload-exchange round counters (see exchange()).
+        self._xseq: Dict[str, int] = {}
         # Per-chain agreed position (index into watchdog.CHAINS[chain];
         # 0 = most capable).  Forward-only, like the cascade.
         self._pos: Dict[str, int] = {c: 0 for c in CONSENSUS_CHAINS}
@@ -658,6 +674,109 @@ class QuorumDomain:
         if self.consensus:
             self._adopt(peer_vecs, site)
 
+    # -- fixed-shape payload exchange -----------------------------------
+    def exchange(
+        self, site: str, payload: List[int]
+    ) -> Dict[int, List[int]]:
+        """Rendezvous exchange of one fixed-shape integer vector per
+        rank at ``site`` (ISSUE 15: the one-time W_s shard-weight-total
+        exchange at mine start rides this) — every rank posts its
+        payload and blocks (bounded) until every peer's arrives, under
+        the same liveness rules as :meth:`sync` ``wait=True``: a killed
+        peer surfaces as classified :class:`PeerLost` naming the rank
+        within the retry budget, never a hang.  Returns ``{rank:
+        payload}`` including this rank's own.  Payload shapes must be
+        uniform across ranks on the JAX transport (process_allgather —
+        SPMD static shapes); the file transport takes any JSON ints."""
+        if self.nprocs == 1:
+            return {self.rank: list(payload)}
+        from fastapriori_tpu.obs import flight
+        from fastapriori_tpu.reliability import retry
+
+        box: Dict[int, List[int]] = {}
+
+        # Each repeated exchange at a site gets its OWN marker round
+        # (a per-domain monotonic sequence): payloads are DATA, not
+        # monotonic positions, so a second mine under a persistent
+        # domain dir must never pair with a peer's stale round-1
+        # marker — with per-round sites a count mismatch surfaces as a
+        # bounded PeerLost instead of silently mixed payloads.  The
+        # JAX transport needs no round tag (process_allgather is
+        # ordered by collective-call discipline).
+        with self._lock:
+            self._xseq[site] = self._xseq.get(site, 0) + 1
+            round_site = f"{site}.r{self._xseq[site]}"
+
+        def attempt():
+            box.clear()
+            if isinstance(self.transport, JaxTransport):
+                import numpy as np
+
+                vec = np.asarray(
+                    [self.rank] + [int(v) for v in payload],
+                    dtype=np.int64,
+                )
+                gathered = self.transport.exchange(
+                    vec, _site_slug(site), dtype=np.int64
+                )
+                for row in np.asarray(gathered):
+                    box[int(row[0])] = [int(x) for x in row[1:]]
+            else:
+                box.update(self._exchange_file(round_site, payload))
+
+        try:
+            retry.call_with_retries(
+                attempt, f"quorum.{_site_slug(site)}"
+            )
+        except PeerLost as exc:
+            ledger.record(
+                "peer_lost", site=site, rank=exc.rank,
+                error=str(exc)[:200],
+            )
+            flight.auto_dump(
+                "PeerLost",
+                extra={
+                    "site": site,
+                    "rank": self.rank,
+                    "epoch_trail": self.epoch_trail(),
+                },
+            )
+            raise
+        return box
+
+    def _exchange_file(
+        self, site: str, payload: List[int]
+    ) -> Dict[int, List[int]]:
+        t = self.transport
+        bound = quorum_timeout_s()
+        t.post_marker(
+            site, {"payload": [int(v) for v in payload]}
+        )
+        out: Dict[int, List[int]] = {self.rank: list(payload)}
+        pending = [r for r in range(self.nprocs) if r != self.rank]
+        t0 = time.monotonic()
+        while pending:
+            still: List[int] = []
+            for r in pending:
+                doc = t.peer_marker(site, r)
+                if doc is None:
+                    still.append(r)
+                    continue
+                out[r] = [int(v) for v in doc.get("payload", [])]
+            waited = time.monotonic() - t0
+            for r in still:
+                self._check_peer_alive(r, site, waited, bound)
+            if still and waited > bound:
+                raise PeerLost(
+                    still[0], site,
+                    f"exchange incomplete after {bound}s (waiting on "
+                    f"ranks {still})",
+                )
+            pending = still
+            if pending:
+                time.sleep(min(0.005, bound / 10))
+        return out
+
     def epoch_trail(self) -> List[Dict[str, Any]]:
         """Every sync this domain ran (epoch, site, positions) — the
         consensus history a PeerLost/chaos-FAIL flight dump ships."""
@@ -804,6 +923,16 @@ def sync(site: str, wait: bool = False) -> None:
 def stage_allowed(chain: str, stage: str) -> bool:
     dom = active()
     return dom is None or dom.stage_allowed(chain, stage)
+
+
+def exchange(site: str, payload) -> Optional[Dict[int, List[int]]]:
+    """Domain-wide fixed-shape vector exchange (see
+    :meth:`QuorumDomain.exchange`); None without a domain — the caller
+    falls back to its single-process/jax-native path."""
+    dom = active()
+    if dom is None:
+        return None
+    return dom.exchange(site, list(payload))
 
 
 def floor_stage(chain: str) -> Optional[str]:

@@ -68,6 +68,14 @@ CHAINS: Dict[str, Tuple[str, ...]] = {
     "mine_engine": ("vertical", "bitmap"),
     # Mesh count reduction: threshold-sparse exchange -> dense psum.
     "count_reduce": ("sparse", "dense"),
+    # Exchange topology (parallel/hier.py): two-level hierarchical
+    # (intra-group ring, then inter-group) -> flat single-level.  A
+    # transient-exhausted sparse dispatch walks THIS chain before
+    # count_reduce (the flat exchange is the cheaper exact fallback;
+    # dense is the last resort), and a quorum peer's walk clamps the
+    # whole domain — the two-level collectives differ in shape/count
+    # from the flat ones, so divergence here hangs a real mesh.
+    "exchange": ("hier", "flat"),
     # Phase-2 rule generation: sharded device join -> device-0 join ->
     # host numpy oracle.
     "rule_engine": ("sharded", "device", "host"),

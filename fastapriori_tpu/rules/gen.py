@@ -426,6 +426,17 @@ def rule_arrays_from_tables(
     engine = _pick_rule_engine(mats, context, config)
     if engine == "device":
         shards = resolve_rule_shards(context, config)
+        if shards > 1:
+            # Exchange topology for the sharded join's mask/denominator
+            # merges and next-level table reassembly (ISSUE 15,
+            # parallel/hier.py): same knob resolution + quorum floor as
+            # the mining collectives, installed on the context so the
+            # join kernel compiles (and its cache keys) carry it.
+            from fastapriori_tpu.parallel.hier import resolve_active_spec
+
+            context.set_exchange_spec(
+                resolve_active_spec(shards, config)
+            )
         # The sharded kernel always splits rows over the FULL txn axis
         # (shard_map owns the placement), so the resident-scan state is
         # only kept when the resolved shard count covers the mesh — a
@@ -629,7 +640,11 @@ def _rule_arrays_device(
     import jax.numpy as jnp
 
     from fastapriori_tpu.ops.bitmap import pad_axis as _pad_axis
-    from fastapriori_tpu.ops.contain import rule_key_bits, rule_shard_bytes
+    from fastapriori_tpu.ops.contain import (
+        rule_key_bits,
+        rule_shard_bytes,
+        rule_shard_stage_bytes,
+    )
 
     sharded = shards > 1 or state is not None
     t0 = time.perf_counter()
@@ -721,9 +736,21 @@ def _rule_arrays_device(
             # levels dispatch.  Distinct site from the single-chip
             # engine so injection/coverage track the sharded path.
             fetch = retry.fetch_async(packed, "rule_mask_shard")
-            g_b, p_b = rule_shard_bytes(k, n_pad, shards)
+            xspec = ctx.exchange_spec
+            g_b, p_b = rule_shard_bytes(k, n_pad, shards, xspec)
+            i_b, e_b, msgs = rule_shard_stage_bytes(
+                k, n_pad, shards, xspec
+            )
             comms.append(
-                {"k": k, "gather_bytes": g_b, "psum_bytes": p_b}
+                {
+                    "k": k,
+                    "gather_bytes": g_b,
+                    "psum_bytes": p_b,
+                    "exchange": "hier" if xspec is not None else "flat",
+                    "intra_bytes": i_b,
+                    "inter_bytes": e_b,
+                    "inter_msgs": msgs,
+                }
             )
             gather_total += g_b
             psum_total += p_b
@@ -848,6 +875,9 @@ def _rule_arrays_device(
             # psum/gather-byte convention); empty on the 1-chip engine.
             gather_bytes=gather_total,
             psum_bytes=psum_total,
+            exchange=(
+                comms[0]["exchange"] if comms else "flat"
+            ),
             comms=comms,
         )
     return out

@@ -118,6 +118,17 @@ class MetricsLogger:
                         psum=holder.get("psum_bytes", 0),
                         gather=holder.get("gather_bytes", 0),
                     )
+                if "intra_bytes" in holder or "inter_bytes" in holder:
+                    # The hierarchical exchange's per-stage split
+                    # (ISSUE 15): a second track so a Perfetto view
+                    # shows fast-tier vs slow-tier traffic per level —
+                    # the trace artifact the direction-3 perf claims
+                    # cite (the PR 11 observability contract).
+                    trace.counter(
+                        "exchange_stage_bytes",
+                        intra=holder.get("intra_bytes", 0),
+                        inter=holder.get("inter_bytes", 0),
+                    )
                 self._record({"event": event, **fields, **holder})
 
 

@@ -33,6 +33,15 @@ from typing import List  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # Tier-1 runs with ``-m 'not slow'`` (ROADMAP); register the mark
+    # so slow-tagged cases (e.g. the 16/32-virtual-device subprocess
+    # differentials in test_hier_exchange.py) deselect cleanly.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')"
+    )
+
+
 def random_dataset(
     seed: int,
     n_items: int = 12,

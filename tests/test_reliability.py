@@ -1260,6 +1260,7 @@ def test_cascade_chain_ordering_pinned():
         "engine": ("fused", "tail", "level"),
         "mine_engine": ("vertical", "bitmap"),
         "count_reduce": ("sparse", "dense"),
+        "exchange": ("hier", "flat"),
         "rule_engine": ("sharded", "device", "host"),
         "rule_scan": ("device", "host"),
         "serving": ("accept", "shed"),
@@ -1833,6 +1834,10 @@ def test_quorum_wire_order_pinned():
     reordering is a wire-format change (pin it)."""
     assert quorum.CONSENSUS_CHAINS == (
         "engine", "mine_engine", "count_reduce", "rule_engine",
+        # ISSUE 15: appended at the END — pre-existing position
+        # indices are unchanged (appending extends the vector, it
+        # does not reorder it).
+        "exchange",
     )
     for chain in quorum.CONSENSUS_CHAINS:
         assert chain in watchdog.CHAINS

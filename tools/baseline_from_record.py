@@ -228,6 +228,23 @@ def render(parsed: dict) -> str:
                 f"{sp4['collective_vs_dense']}x dense at 4 devices "
                 f"(engine {sp4.get('count_reduce')})"
             )
+        # ISSUE 15: the hierarchical-exchange series — hier vs flat
+        # collective bytes per device count, with the per-stage
+        # (intra/inter) totals the two-level staging attributes.
+        hier_rows = []
+        for n in ("8", "16", "32"):
+            hr = ((sc.get("devices") or {}).get(n) or {}).get("hier") or {}
+            if hr.get("collective_vs_flat") is not None:
+                hier_rows.append(
+                    f"{n}dev {hr['collective_vs_flat']}x flat "
+                    f"(intra {hr.get('intra_bytes')} / inter "
+                    f"{hr.get('inter_bytes')} B)"
+                )
+        if hier_rows:
+            line += (
+                "; hierarchical exchange collective bytes: "
+                + ", ".join(hier_rows)
+            )
         for key, label in (
             ("two_process", "2-process"),
             ("four_process", "4-process"),

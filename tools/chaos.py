@@ -381,7 +381,7 @@ def run_scenario(
 # fail classified naming a rank/site; never a hang, never a mixed-epoch
 # artifact.
 
-MP_KINDS = ("kill", "divergence", "flap", "hb_delay")
+MP_KINDS = ("kill", "divergence", "flap", "hb_delay", "wstotals")
 
 # Divergence injections: a transient-exhaustion spec that walks ONE
 # consensus chain on the target rank only (oom*3 exhausts the default
@@ -389,10 +389,16 @@ MP_KINDS = ("kill", "divergence", "flap", "hb_delay")
 # entry pins the engine AND checkpointing the schedule must force so
 # the armed site is actually on the target's path: the whole-loop
 # fused program (fetch.fused) only runs WITHOUT a checkpoint prefix,
-# the segment fold (fetch.tail) only WITH one.
+# the segment fold (fetch.tail) only WITH one.  The pair-sparse entry
+# (ISSUE 15) exhausts the sparse PAIR fetch on the 8-device mesh,
+# walking the target down exchange hier→flat AND count_reduce
+# sparse→dense mid-mine — peers must adopt both at their next level
+# boundary or their two-level collectives would never match the
+# target's flat/dense ones.
 _DIVERGENCE_MENU: Tuple[Tuple[str, str, bool], ...] = (
     ("fetch.fused:oom*3", "fused", False),
     ("fetch.tail:oom*3", "fused", True),  # segment fold under ckpt
+    ("fetch.pair_sparse:oom*3", "level", True),  # ISSUE 15
 )
 
 
@@ -410,8 +416,14 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
     checkpoint = True
     failpoints_by_rank: Dict[int, str] = {}
     if kind == "kill":
-        level = rng.choice((2, 3))
-        failpoints_by_rank[target] = f"level.{level}:abort"
+        # Sites: a committed level boundary, or the mine.start W_s
+        # rendezvous itself (ISSUE 15) — a rank dying INSIDE the
+        # weight-total exchange must surface on every peer as a
+        # classified PeerLost naming it, never a rendezvous hang.
+        site = rng.choice(
+            ("level.2", "level.3", "quorum.mine.wstotals")
+        )
+        failpoints_by_rank[target] = f"{site}:abort"
     elif kind == "divergence":
         spec, engine, checkpoint = rng.choice(_DIVERGENCE_MENU)
         failpoints_by_rank[target] = spec
@@ -423,12 +435,20 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
         # stall) and the run must complete identically.
         target = 0
         failpoints_by_rank[0] = f"level.2:delay@{rng.randint(800, 1500)}"
-    else:  # hb_delay
+    elif kind == "hb_delay":
         # Heartbeat jitter on the target: each beat sleeps; liveness
         # judgment must tolerate it (interval << timeout), so the run
         # completes identically — a laggy heartbeat is not a death.
         failpoints_by_rank[target] = (
             f"quorum.heartbeat:delay@{rng.randint(100, 300)}"
+        )
+    else:  # wstotals (ISSUE 15)
+        # A slow rank INSIDE the W_s rendezvous: the delay is well
+        # under the quorum timeout, so peers must wait it out (the
+        # heartbeat keeps beating through it) and the run completes
+        # identically — a laggy exchange is not a death.
+        failpoints_by_rank[target] = (
+            f"quorum.mine.wstotals:delay@{rng.randint(500, 1500)}"
         )
     return {
         "seed": seed,

@@ -47,6 +47,14 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_vertical.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_shard.py -q \
     -p no:cacheprovider
 
+# Hierarchical-exchange differential suite (ISSUE 15): the two-level
+# (groups, per_group) staging of the sparse count reduction and the
+# sharded rule-join reassembly must stay bit-exact against the flat
+# exchange on every counting path and group shape, the topology knob
+# strict, and the hier→flat cascade consensus-registered.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_hier_exchange.py -q \
+    -m 'not slow' -p no:cacheprovider
+
 env JAX_PLATFORMS=cpu python tools/failpoint_smoke.py
 
 # Serving-tier smoke (ISSUE 10): resident server on the CI corpus —
