@@ -453,10 +453,15 @@ def _run_serve(args) -> int:
     ]
     if args.output:
         from fastapriori_tpu.io.writer import write_manifest
+        from fastapriori_tpu.reliability import quorum
 
         manifest = {}
         save_recommends(args.output, recommends, manifest=manifest)
-        write_manifest(args.output, manifest)
+        # Fence discipline (G020): None without an active quorum domain
+        # or on a non-writer rank; the domain writer stamps its epoch,
+        # and a superseded one raises StaleFenceError instead.
+        write_manifest(args.output, manifest,
+                       fence=quorum.writer_fence())
     else:
         for _, item in recommends:
             print(item)
@@ -737,7 +742,8 @@ def _run(args) -> int:
                     args.output, freq_items, item_to_rank,
                     manifest=manifest,
                 )
-            write_manifest(args.output, manifest)
+            write_manifest(args.output, manifest,
+                           fence=quorum.writer_fence())
     phase.__exit__(None, None, None)
     print(
         "==== Total time for get freqItemsets "
@@ -768,7 +774,8 @@ def _run(args) -> int:
 
         manifest = {}
         save_recommends(args.output, recommends, manifest=manifest)
-        write_manifest(args.output, manifest)
+        write_manifest(args.output, manifest,
+                       fence=quorum.writer_fence())
     phase.__exit__(None, None, None)
     print(
         "==== Total time for get recommends "

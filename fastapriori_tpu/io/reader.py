@@ -14,6 +14,8 @@ from __future__ import annotations
 import re
 from typing import List, Tuple
 
+from fastapriori_tpu.errors import InputError
+
 # Java semantics, NOT Python's: String.trim() removes chars <= 0x20 (so
 # control bytes like \x01 are trimmed, but \xa0 — which Python's
 # str.strip() would eat — is kept), and regex \s is ASCII-only
@@ -26,7 +28,7 @@ _TRIM = "".join(chr(i) for i in range(0x21))
 
 
 def _require_fsspec(path: str):
-    """The fsspec module, or a RuntimeError naming the remote path —
+    """The fsspec module, or an InputError naming the remote path —
     shared by every remote-capable opener so the policy (scheme
     detection, error text) lives in one place."""
     try:
@@ -34,7 +36,7 @@ def _require_fsspec(path: str):
 
         return fsspec
     except ImportError as e:  # pragma: no cover - environment dependent
-        raise RuntimeError(
+        raise InputError(
             f"remote path {path!r} requires fsspec, which is not "
             "installed; copy the file locally instead"
         ) from e

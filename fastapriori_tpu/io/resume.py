@@ -53,7 +53,10 @@ def save_phase1(
         prefix, freq_itemsets, freq_items, manifest=manifest
     )
     save_phase1_aux(prefix, freq_items, item_to_rank, manifest=manifest)
-    write_manifest(prefix, manifest)
+    from fastapriori_tpu.reliability import quorum
+
+    write_manifest(prefix, manifest,
+                   fence=quorum.writer_fence())
 
 
 def save_phase1_aux(
@@ -129,6 +132,7 @@ def validate_artifact_bytes(
     """Check ``raw`` (the full content of ``<prefix><name>``) against the
     run manifest; InputError naming the file on any mismatch.  No-op when
     no manifest exists or the manifest has no entry for ``name``."""
+    # lint: waive G020 -- per-artifact integrity primitive, not a resume entry point: the fence is validated once per manifest by the callers that seed a resume (load_phase1, checkpoint.load_checkpoint)
     artifacts = load_manifest(prefix) if manifest is None else manifest
     entry = (artifacts or {}).get(name)
     if entry is None:
@@ -191,6 +195,14 @@ def load_phase1(
     Malformed lines raise :class:`InputError` naming the file and line —
     the reference's parser (hardcoded paths, blind splits) would throw a
     bare NumberFormatException/MatchError instead."""
+    # Fenced-resume validation (mirrors io/checkpoint.py load_checkpoint):
+    # on an active multi-process domain a phase-1 artifact set stamped by
+    # a superseded coordinator must never seed a resume; without a domain
+    # the fence stays informational and no extra manifest read happens.
+    from fastapriori_tpu.reliability import quorum
+
+    if quorum.active() is not None:
+        quorum.validate_resume_fence(manifest_fence(prefix))
     item_to_rank: Dict[str, int] = {}
     for lineno, line in enumerate(_read_artifact(prefix, "ItemsToRank"), 1):
         if not line:

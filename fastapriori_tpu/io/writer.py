@@ -32,6 +32,7 @@ import json
 import os
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
+from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.reliability import failpoints
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -57,7 +58,7 @@ def open_write(path: str):
             # lint: waive G009 -- the raw remote text handle write_artifact builds on
             return fsspec.open(path, "w").open()
         except ImportError as e:  # pragma: no cover - environment dependent
-            raise RuntimeError(
+            raise InputError(
                 f"remote output path {path!r} requires fsspec, which is "
                 "not installed; write to a local path instead"
             ) from e
@@ -73,7 +74,7 @@ def _open_write_bytes(path: str):
             # lint: waive G009 -- write_artifact internals (atomic helper itself)
             return fsspec.open(path, "wb").open()
         except ImportError as e:  # pragma: no cover - environment dependent
-            raise RuntimeError(
+            raise InputError(
                 f"remote output path {path!r} requires fsspec, which is "
                 "not installed; write to a local path instead"
             ) from e

@@ -1429,11 +1429,13 @@ class FastApriori:
         bitmap build) must fail loudly on a CompressedData produced with
         ``retain_csr=False`` — silently mining an empty CSR would return
         an empty lattice."""
+        from fastapriori_tpu.errors import InputError
+
         if (
             data.total_count > 0
             and len(data.basket_offsets) != data.total_count + 1
         ):
-            raise ValueError(
+            raise InputError(
                 "CompressedData carries no basket CSR (produced by the "
                 "pipelined capture ingest with retain_csr=False); "
                 "re-ingest with retain_csr=True to mine it through "

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fastapriori_tpu import compat
+from fastapriori_tpu.errors import InputError
 
 from fastapriori_tpu.ops import count as count_ops
 from fastapriori_tpu.ops.bitmap import next_pow2 as _next_pow2
@@ -195,7 +196,7 @@ class DeviceContext:
         if num_devices is not None:
             devs = devs[:num_devices]
         if cand_devices < 1 or len(devs) % cand_devices != 0:
-            raise ValueError(
+            raise InputError(
                 f"cand_devices={cand_devices} must be >= 1 and divide the "
                 f"device count ({len(devs)}); with --platform cpu, pass "
                 "--num-devices to provision that many virtual devices"

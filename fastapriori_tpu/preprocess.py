@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.io.reader import JAVA_WS
 from fastapriori_tpu.utils.order import item_sort_key
 
@@ -95,7 +96,7 @@ class CompressedData:
         if self.total_count > 0 and len(self.basket_offsets) != (
             self.total_count + 1
         ):
-            raise ValueError(
+            raise InputError(
                 "CompressedData carries no basket CSR (produced by the "
                 "pipelined capture ingest with retain_csr=False); "
                 "re-ingest with retain_csr=True to read baskets"
@@ -202,7 +203,7 @@ def _use_native(native: Optional[bool], size_hint: int) -> bool:
     available = native_available()
     if native is True:
         if not available:
-            raise RuntimeError(
+            raise InputError(
                 "native preprocessing requested but the extension is not "
                 "built; run `make -C fastapriori_tpu/native`"
             )

@@ -953,6 +953,19 @@ def checkpoint_fence() -> int:
     return 0 if dom is None else dom.checkpoint_fence()
 
 
+def writer_fence() -> Optional[int]:
+    """The fence epoch to stamp into an artifact manifest, or None on
+    every path that must not touch the domain fence: no active domain
+    (single-process, unfenced), or a non-writer rank — acquiring from
+    rank != 0 would advance the shared epoch and fence out the real
+    coordinator mid-run.  The writer's own acquire/validate semantics
+    (StaleFenceError on a superseded coordinator) are unchanged."""
+    dom = active()
+    if dom is None or not dom.is_writer():
+        return None
+    return dom.checkpoint_fence() or None
+
+
 def validate_resume_fence(fence: Optional[int]) -> None:
     dom = active()
     if dom is not None:

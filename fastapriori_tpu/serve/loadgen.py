@@ -24,6 +24,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from fastapriori_tpu.errors import InputError
+
 
 def arrival_offsets(
     n_requests: int, rate_rps: float, seed: int
@@ -32,7 +34,7 @@ def arrival_offsets(
     (seconds from t0) with exponential inter-arrivals at ``rate_rps``.
     Same (n, rate, seed) -> byte-identical schedule (test-pinned)."""
     if rate_rps <= 0:
-        raise ValueError(f"rate_rps must be positive, got {rate_rps}")
+        raise InputError(f"rate_rps must be positive, got {rate_rps}")
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
     return np.cumsum(gaps)
@@ -69,7 +71,7 @@ def run_open_loop(
     SERVED requests (sheds answer immediately and are counted
     separately), queue/shed counters, and the model's scan facts."""
     if not baskets:
-        raise ValueError("run_open_loop needs a non-empty basket pool")
+        raise InputError("run_open_loop needs a non-empty basket pool")
     offsets = arrival_offsets(n_requests, rate_rps, seed)
     # Each scenario reports ITS OWN queue peak (`batches` below is
     # differenced the same way).

@@ -193,7 +193,10 @@ class ServingState:
         path = write_artifact_bytes(
             prefix + SERVING_NAME, [buf.getvalue()], SERVING_NAME, manifest
         )
-        write_manifest(prefix, manifest)
+        from fastapriori_tpu.reliability import quorum
+
+        write_manifest(prefix, manifest,
+                       fence=quorum.writer_fence())
         return path
 
     @classmethod

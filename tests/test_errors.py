@@ -149,3 +149,67 @@ def test_gen_rules_not_downward_closed():
                 (frozenset({2}), 9),
             ]
         )
+
+
+# ---------------------------------------------------------------------------
+# G018 (graftlint v4): boundary failures the user can correct are
+# InputError — one friendly line + exit 2, never a raw-builtin traceback.
+
+
+def test_loadgen_bad_inputs_are_classified():
+    from fastapriori_tpu.serve.loadgen import arrival_offsets, run_open_loop
+
+    with pytest.raises(InputError, match="rate_rps"):
+        arrival_offsets(10, 0.0, seed=1)
+    with pytest.raises(InputError, match="basket pool"):
+        run_open_loop(None, [], rate_rps=1.0, n_requests=1, seed=1)
+
+
+def test_csrless_baskets_view_is_classified():
+    import numpy as np
+
+    from fastapriori_tpu.preprocess import CompressedData
+
+    d = CompressedData(
+        n_raw=2, min_count=1, freq_items=["7"], item_to_rank={"7": 0},
+        item_counts=np.array([2], dtype=np.int64),
+        basket_indices=np.zeros(0, dtype=np.int32),
+        basket_offsets=np.zeros(1, dtype=np.int64),
+        weights=np.ones(2, dtype=np.int32),
+    )
+    with pytest.raises(InputError, match="retain_csr"):
+        d.baskets
+
+
+def test_native_request_without_extension_is_classified():
+    from fastapriori_tpu.native import native_available
+    from fastapriori_tpu.preprocess import _use_native
+
+    if native_available():
+        pytest.skip("native extension built in this environment")
+    with pytest.raises(InputError, match="native"):
+        _use_native(True, 0)
+
+
+def test_remote_path_without_fsspec_is_classified(monkeypatch):
+    import sys
+
+    from fastapriori_tpu.io import writer
+    from fastapriori_tpu.io.reader import _require_fsspec
+
+    # A None entry makes `import fsspec` raise ImportError even when the
+    # package is installed — forces the missing-dependency path.
+    monkeypatch.setitem(sys.modules, "fsspec", None)
+    with pytest.raises(InputError, match="fsspec"):
+        _require_fsspec("gs://bucket/D.dat")
+    with pytest.raises(InputError, match="fsspec"):
+        writer.open_write("gs://bucket/out")
+    with pytest.raises(InputError, match="fsspec"):
+        writer._open_write_bytes("gs://bucket/out")
+
+
+def test_mesh_bad_cand_devices_is_classified():
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    with pytest.raises(InputError, match="cand_devices"):
+        DeviceContext(cand_devices=0)

@@ -603,6 +603,176 @@ CASES = [
      "            x = x + 1\n"
      "        x = lax.psum(x, 'txn')\n"
      "    return x\n"),
+    # -- v4 path-sensitivity: branch-local taint states (ISSUE 16) -----
+    ("G015", "flag", "pkg/mod.py",
+     "import os\n"
+     "from jax import lax\n"
+     "def decide(mode):\n"
+     "    flaky = os.environ.get('FA_FAST', '') == '1'\n"
+     "    if mode:\n"
+     "        flaky = False\n"
+     "    return flaky\n"
+     "def count(x, mode):\n"
+     "    if decide(mode):\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),
+    # ^ cleared in ONE arm only: the divergent fall-through arm must
+    #   survive the join (v3's suite-shared env let the body assignment
+    #   overwrite the taint — a false negative, now caught)
+    ("G015", "pass", "pkg/mod.py",
+     "import os\n"
+     "from jax import lax\n"
+     "def decide(mode):\n"
+     "    flaky = os.environ.get('FA_FAST', '') == '1'\n"
+     "    if mode:\n"
+     "        flaky = True\n"
+     "    else:\n"
+     "        flaky = False\n"
+     "    return flaky\n"
+     "def count(x, mode):\n"
+     "    if decide(mode):\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),  # BOTH arms overwrite: uniform after the join
+    ("G015", "pass", "pkg/mod.py",
+     "from jax import lax\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def count(x, mode):\n"
+     "    if mode:\n"
+     "        ok = quorum.stage_allowed('count_reduce', 'sparse')\n"
+     "    else:\n"
+     "        ok = quorum.stage_allowed('count_reduce', 'exact')\n"
+     "    if ok:\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),  # sanitized in BOTH arms: uniform after the join
+    ("G015", "pass", "pkg/mod.py",
+     "import os\n"
+     "from jax import lax\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def count(x):\n"
+     "    fast = os.environ.get('FA_FAST', '') == '1'\n"
+     "    if fast and quorum.current_fence() == 0:\n"
+     "        return lax.psum(x, 'txn')\n"
+     "    return x\n"),  # epoch-guard compare sanitizes (v4 lattice)
+    # -- G018: boundary raises must be classified ----------------------
+    ("G018", "flag", "pkg/parallel/m.py",
+     "def shard(n, k):\n"
+     "    if n % k:\n"
+     "        raise ValueError('devices must divide rows')\n"
+     "    return n // k\n"),
+    ("G018", "flag", "pkg/parallel/m.py",
+     "class LocalOops(Exception):\n"
+     "    pass\n"
+     "def run(args):\n"
+     "    raise LocalOops('unclassified local type')\n"),
+    ("G018", "pass", "pkg/obs/mod.py",
+     "def load(path):\n"
+     "    raise ValueError('not a boundary surface')\n"),
+    ("G018", "pass", "pkg/io/errors.py",
+     "class DataError(Exception):\n"
+     "    pass\n"
+     "def load(path):\n"
+     "    raise DataError('classified: defined by the errors module')\n"),
+    ("G018", "pass", "pkg/io/mod.py",
+     "from fastapriori_tpu.errors import InputError\n"
+     "def load(path):\n"
+     "    try:\n"
+     "        raise ValueError('probe')\n"
+     "    except ValueError:\n"
+     "        raise InputError('wrapped locally: ' + path) from None\n"),
+    ("G018", "pass", "pkg/serve/mod.py",
+     "from fastapriori_tpu.reliability import ledger\n"
+     "def answer(q):\n"
+     "    ledger.record('serve.degraded', q=q)\n"
+     "    raise RuntimeError('after the recorded degrade')\n"),
+    ("G018", "waived", "pkg/io/mod.py",
+     "def load(path):\n"
+     "    # lint: waive G018 -- test waiver\n"
+     "    raise ValueError('bad input')\n"),
+    ("G018", "waived", "pkg/io/mod.py",
+     "def load(path):\n"
+     "    raise ValueError('bad')  # lint: raise-ok -- test alias\n"),
+    # -- G019: downgrade walks vs the live CHAINS literal --------------
+    ("G019", "flag", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def trip():\n"
+     "    downgrade('ghost', 'fast', 'exact')\n"),  # unregistered chain
+    ("G019", "flag", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def trip():\n"
+     "    downgrade('eng', 'fast', 'slow')\n"),  # stage drifted
+    ("G019", "flag", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def trip():\n"
+     "    downgrade('eng', 'exact', 'fast')\n"),  # backward walk
+    ("G019", "flag", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'mid', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def step():\n"
+     "    downgrade('eng', 'fast', 'mid')\n"),  # terminus unreachable
+    ("G019", "pass", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'mid', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def step():\n"
+     "    downgrade('eng', 'fast', 'mid')\n"
+     "def fall():\n"
+     "    downgrade('eng', 'mid', 'exact')\n"),  # full literal path
+    ("G019", "pass", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'mid', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def adopt(pos):\n"
+     "    downgrade('eng', pos, 'exact')\n"),  # dynamic frm: from-anywhere
+    ("G019", "waived", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def trip():\n"
+     "    # lint: waive G019 -- test waiver\n"
+     "    downgrade('ghost', 'fast', 'exact')\n"),
+    ("G019", "waived", "pkg/mod.py",
+     "CHAINS = {'eng': ('fast', 'exact')}\n"
+     "def downgrade(chain, frm, to):\n"
+     "    pass\n"
+     "def trip():\n"
+     "    downgrade('eng', 'exact', 'fast')  # lint: cascade-ok -- alias\n"),
+    # -- G020: fenced checkpoints, checked not trusted -----------------
+    ("G020", "flag", "pkg/io/mod.py",
+     "from fastapriori_tpu.io.writer import write_manifest\n"
+     "def save(prefix, manifest):\n"
+     "    write_manifest(prefix, manifest)\n"),  # fence-less commit
+    ("G020", "flag", "pkg/io/mod.py",
+     "from fastapriori_tpu.io.resume import load_manifest\n"
+     "def resume(prefix):\n"
+     "    return load_manifest(prefix)\n"),  # validate-less resume read
+    ("G020", "pass", "pkg/io/mod.py",
+     "from fastapriori_tpu.io.writer import write_manifest\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def save(prefix, manifest):\n"
+     "    write_manifest(prefix, manifest,\n"
+     "                   fence=quorum.checkpoint_fence() or None)\n"),
+    ("G020", "pass", "pkg/io/mod.py",
+     "from fastapriori_tpu.io.resume import load_manifest, manifest_fence\n"
+     "from fastapriori_tpu.reliability import quorum\n"
+     "def resume(prefix):\n"
+     "    quorum.validate_resume_fence(manifest_fence(prefix))\n"
+     "    return load_manifest(prefix)\n"),
+    ("G020", "waived", "pkg/io/mod.py",
+     "from fastapriori_tpu.io.writer import write_manifest\n"
+     "def dump(prefix, manifest):\n"
+     "    # lint: waive G020 -- test waiver (crash-path dump)\n"
+     "    write_manifest(prefix, manifest)\n"),
+    ("G020", "waived", "pkg/io/mod.py",
+     "from fastapriori_tpu.io.resume import load_manifest\n"
+     "def probe(prefix):\n"
+     "    return load_manifest(prefix)  # lint: fence-ok -- test alias\n"),
     # -- waiver-grammar edge cases (engine, pinned by ISSUE 5) ---------
     # (a) a waiver above a decorator attaches to the decorated line
     ("G003", "waived", "pkg/mod.py",
@@ -684,7 +854,7 @@ def test_every_rule_has_all_three_case_kinds():
 
 def test_all_rules_registered_and_distinct():
     ids = [r.id for r in ALL_RULES]
-    assert len(ids) == len(set(ids)) == 17
+    assert len(ids) == len(set(ids)) == 20
     assert all(hasattr(r, "name") and r.name for r in ALL_RULES)
 
 
@@ -1578,3 +1748,230 @@ def test_stacked_waiver_segments_parse_independently():
     )
     assert [t for t, _ in segs] == [{"fetch-site"}, {"G013"}]
     assert [j for _, j in segs] == ["why one", "why two"]
+
+
+# ---------------------------------------------------------------------------
+# v4: path-sensitive taint environments (ISSUE 16 tentpole a)
+
+
+def test_join_worst_takes_the_worst_state_per_variable():
+    from tools.lint import flow
+
+    env = {"keep": flow.RANK_DIVERGENT}
+    flow.join_worst(env, [
+        {"a": flow.RANK_DIVERGENT, "b": flow.RANK_UNIFORM},
+        {"a": flow.RANK_UNIFORM, "c": flow.RANK_DIVERGENT},
+    ])
+    assert env["a"] == flow.RANK_DIVERGENT
+    assert env["b"] == flow.RANK_UNIFORM
+    assert env["c"] == flow.RANK_DIVERGENT  # introduced in one branch
+    assert env["keep"] == flow.RANK_DIVERGENT  # untouched by the join
+
+
+def test_rank_flow_branch_environments_are_isolated():
+    """A sanitizing assignment inside one arm must not clear the taint
+    on the fall-through path (v3's suite-shared env let the body
+    assignment overwrite it — a false negative, fixed by the per-suite
+    copies + worst-state join)."""
+    from tools.lint import flow
+
+    ctx = FileContext(
+        "pkg/mod.py",
+        "import os\n"
+        "from fastapriori_tpu.reliability import quorum\n"
+        "def f(x, mode):\n"
+        "    flaky = os.environ.get('FA_X', '') == '1'\n"
+        "    if mode:\n"
+        "        flaky = quorum.stage_allowed('engine', 'fused')\n"
+        "    return flaky\n",
+    )
+    rf = flow.RankFlow(ctx)
+    env = {}
+    rf.run(ctx.tree.body[2].body, env)
+    assert env["flaky"] == flow.RANK_DIVERGENT
+
+
+def test_rank_flow_both_arms_sanitized_joins_uniform():
+    from tools.lint import flow
+
+    ctx = FileContext(
+        "pkg/mod.py",
+        "import os\n"
+        "from fastapriori_tpu.reliability import quorum\n"
+        "def f(x, mode):\n"
+        "    flaky = os.environ.get('FA_X', '') == '1'\n"
+        "    if mode:\n"
+        "        flaky = quorum.stage_allowed('engine', 'fused')\n"
+        "    else:\n"
+        "        flaky = quorum.stage_allowed('engine', 'level')\n"
+        "    return flaky\n",
+    )
+    rf = flow.RankFlow(ctx)
+    env = {}
+    rf.run(ctx.tree.body[2].body, env)
+    assert env["flaky"] == flow.RANK_UNIFORM
+
+
+def test_epoch_guard_sanitizer_clears_rank_taint():
+    """The v4 lattice addition: quorum epoch reads (checkpoint_fence /
+    current_fence / validate_resume_fence) answer from the domain's
+    authoritative FENCE, so they evaluate uniform and consensus-clamp
+    the function that consults them — exactly like stage_allowed."""
+    import ast as ast_mod
+
+    from tools.lint import flow
+
+    call = ast_mod.parse("quorum.current_fence()").body[0].value
+    assert flow._rank_call_kind(call) == "sanitizer"
+    ctx = FileContext(
+        "pkg/mod.py",
+        "from fastapriori_tpu.reliability import quorum\n"
+        "def f():\n"
+        "    fence = quorum.checkpoint_fence()\n"
+        "    return fence\n",
+    )
+    rf = flow.RankFlow(ctx)
+    env = {}
+    fn = ctx.tree.body[1]
+    rf.run(fn.body, env)
+    assert env["fence"] == flow.RANK_UNIFORM
+    assert rf.contains_sanitizer(fn)  # rank_summaries clamps f
+
+
+def test_g016_chain_walk_in_non_bearing_helper_is_clean():
+    """v4 function-granular attribution (the watchdog rule_scan shape,
+    waived under v3's module-granularity fallback): a chain walked only
+    by a non-collective helper in a module that ALSO has collective-
+    bearing functions must not flag — the serving-tier walk never
+    shapes the mesh's collective sequence."""
+    src = (
+        "from jax import lax\n"
+        "CHAINS = {'local': ('device', 'host'),\n"
+        "          'global': ('hier', 'flat')}\n"
+        "CONSENSUS_CHAINS = ('global',)\n"
+        "def downgrade(chain, frm, to):\n"
+        "    pass\n"
+        "def exchange(x):\n"
+        "    downgrade('global', 'hier', 'flat')\n"
+        "    return lax.psum(x, 'txn')\n"
+        "def scan(rows):\n"
+        "    downgrade('local', 'device', 'host')\n"
+        "    return rows\n"
+    )
+    result = engine.lint_sources([MESH_DECL, ("pkg/mod.py", src)])
+    assert not [f for f in result.findings if f.rule == "G016"], (
+        "non-bearing helper walk must not be attributed to the "
+        "collective path"
+    )
+
+
+# ---------------------------------------------------------------------------
+# v4: protocol censuses (ISSUE 16 tentpole b)
+
+
+def test_raise_and_ledger_censuses_are_deterministic():
+    src = (
+        "from fastapriori_tpu.reliability import ledger\n"
+        "KIND = 'mesh.degraded'\n"
+        "def f(path):\n"
+        "    ledger.record(KIND, path=path)\n"
+        "    raise ValueError('boom')\n"
+        "def g():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        raise\n"
+    )
+    r1 = engine.lint_sources([("pkg/obs/mod.py", src)])
+    r2 = engine.lint_sources([("pkg/obs/mod.py", src)])
+    assert r1.inventory == r2.inventory
+    inv = r1.inventory
+    assert {"exception": "ValueError", "path": "pkg/obs/mod.py",
+            "count": 1} in inv["raise_sites"]
+    assert {"exception": "<reraise>", "path": "pkg/obs/mod.py",
+            "count": 1} in inv["raise_sites"]
+    assert {"kind": "mesh.degraded", "path": "pkg/obs/mod.py",
+            "count": 1} in inv["ledger_events"]
+
+
+def test_chain_walk_census_is_function_granular():
+    src = (
+        "CHAINS = {'eng': ('fast', 'exact')}\n"
+        "def downgrade(chain, frm, to):\n"
+        "    pass\n"
+        "def helper():\n"
+        "    downgrade('eng', 'fast', 'exact')\n"
+        "downgrade('eng', 'fast', 'exact')\n"
+    )
+    inv = engine.lint_sources([("pkg/mod.py", src)]).inventory
+    walkers = {(w["chain"], w["walker"]) for w in inv["chain_walks"]}
+    assert ("eng", "pkg.mod.helper") in walkers
+    assert ("eng", "<module>") in walkers
+
+
+def test_protocol_censuses_exclude_test_files():
+    src = (
+        "from fastapriori_tpu.reliability import ledger\n"
+        "def f():\n"
+        "    ledger.record('x.y')\n"
+        "    raise ValueError('x')\n"
+    )
+    inv = engine.lint_sources([("tests/test_x.py", src)]).inventory
+    assert inv["raise_sites"] == []
+    assert inv["ledger_events"] == []
+
+
+def test_raise_census_drift_trips_check_inventory(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (tmp_path / "tools" / "lint").mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        "def f(path):\n"
+        "    raise ValueError('bad: ' + path)\n"
+    )
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--write-inventory"]) == 0
+    capsys.readouterr()
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--check-inventory"]) == 0
+    # Reclassifying the raise is census churn: the drift gate trips
+    # until the inventory is regenerated.
+    (pkg / "mod.py").write_text(
+        "def f(path):\n"
+        "    raise RuntimeError('bad: ' + path)\n"
+    )
+    capsys.readouterr()
+    assert cli.main(["pkg", "--root", str(tmp_path),
+                     "--check-inventory"]) == 1
+    assert "drift" in capsys.readouterr().err
+
+
+def test_analysis_cache_carries_protocol_facts(tmp_path):
+    """The v4 fragment fields: per-file raise/ledger facts round-trip
+    through the cache with bit-identical censuses."""
+    from tools.lint import cache
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (tmp_path / "tools" / "lint").mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        "from fastapriori_tpu.reliability import ledger\n"
+        "def f(path):\n"
+        "    ledger.record('io.fallback', path=path)\n"
+        "    raise ValueError('bad: ' + path)\n"
+    )
+    r_cold = engine.lint_paths(["pkg"], root=str(tmp_path))
+    frag = cache.load(str(tmp_path))["pkg/mod.py"]
+    assert frag["raises"] == [["ValueError", 4]]
+    assert frag["ledger"] == [["io.fallback", 3]]
+    r_warm = engine.lint_paths(["pkg"], root=str(tmp_path))
+    assert (
+        r_cold.inventory["raise_sites"] == r_warm.inventory["raise_sites"]
+    )
+    assert (
+        r_cold.inventory["ledger_events"]
+        == r_warm.inventory["ledger_events"]
+    )
+    assert {"kind": "io.fallback", "path": "pkg/mod.py", "count": 1} in (
+        r_warm.inventory["ledger_events"]
+    )

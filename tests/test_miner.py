@@ -5,6 +5,7 @@ import pytest
 from conftest import random_dataset, tokenized
 from fastapriori_tpu import oracle
 from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.models.apriori import FastApriori
 
 
@@ -267,7 +268,7 @@ def test_capture_ingest_without_csr_matches_plain(tmp_path):
 
     # CSR-consuming paths refuse the CSR-less data instead of silently
     # mining an empty lattice.
-    with pytest.raises(ValueError, match="retain_csr"):
+    with pytest.raises(InputError, match="retain_csr"):
         miner._mine_levels(d)
 
 

@@ -2037,6 +2037,31 @@ def test_fence_monotonic_and_stale_writer_rejected(qroot):
     new.close()
 
 
+def test_writer_fence_none_off_writer_and_epoch_untouched(qroot):
+    """quorum.writer_fence(): the manifest-stamp helper every artifact
+    writer uses (CLI outputs, serving state, phase-1 resume).  A
+    non-writer rank gets None AND must not advance the shared fence —
+    on file-transport domains jax.process_index() is 0 on every rank,
+    so a rank-gated acquire here once fenced out the real coordinator
+    mid-run (the mp divergence scenarios caught it).  The writer rank
+    stamps its acquired epoch; no domain stamps None."""
+    assert quorum.writer_fence() is None  # no domain: unfenced
+    r1 = quorum.QuorumDomain(quorum.FileTransport(qroot, 1, 2), 1, 2)
+    quorum.set_domain(r1)
+    try:
+        assert quorum.writer_fence() is None
+        assert r1.transport.current_fence() == 0  # epoch untouched
+        r0 = quorum.QuorumDomain(quorum.FileTransport(qroot, 0, 2), 0, 2)
+        quorum.set_domain(r0)
+        fence = quorum.writer_fence()
+        assert fence == 1  # the writer acquires and stamps
+        assert quorum.writer_fence() == fence  # acquired ONCE per run
+        r0.close()
+    finally:
+        quorum.set_domain(None)
+        r1.close()
+
+
 def test_checkpoint_fence_roundtrip_and_stale_resume_rejected(
     tmp_path, qroot
 ):

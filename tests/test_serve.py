@@ -455,7 +455,7 @@ def test_arrival_offsets_deterministic_and_rate_shaped():
     assert np.all(np.diff(a) >= 0)
     # Mean inter-arrival ~ 1/rate (law of large numbers at n=5000).
     assert a[-1] / 5000 == pytest.approx(1e-3, rel=0.1)
-    with pytest.raises(ValueError, match="rate_rps"):
+    with pytest.raises(InputError, match="rate_rps"):
         arrival_offsets(10, 0.0, seed=1)
 
 

@@ -10,11 +10,13 @@ resolution) read the cached fragments instead.
 Two deliberate scope limits keep it correct:
 
 - Only facts derivable from the file's OWN bytes are cached (comments,
-  waiver segments, module-level string/int constants).  Anything
+  waiver segments, module-level string/int constants, and — v4 — the
+  protocol pass's per-file raise/ledger-event facts, which feed the
+  ``raise_sites``/``ledger_events`` inventory censuses).  Anything
   resolved across files (fetch labels through cross-file constants,
-  the collective census's axis resolution) is recomputed every run —
-  an ``(mtime, size)`` key on one file cannot witness another file's
-  edit.
+  the collective census's axis resolution, the chain-walk census) is
+  recomputed every run — an ``(mtime, size)`` key on one file cannot
+  witness another file's edit.
 - The cache key includes a fingerprint of ``tools/lint/*.py`` itself
   (name + mtime + size), so editing the linter invalidates everything:
   a stale analyzer must never answer for a new rule.
@@ -30,7 +32,7 @@ import json
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-SCHEMA = 1
+SCHEMA = 2  # v4: fragments carry the protocol pass's per-file facts
 
 CACHE_PATH = os.path.join("tools", "lint", ".cache.json")
 
@@ -125,6 +127,8 @@ def lookup(
 
 def to_fragment(ctx, full_path: str) -> Optional[dict]:
     """Serialize a FileContext's own-bytes-only facts."""
+    from tools.lint import protocol as _protocol
+
     key = fragment_key(full_path)
     if key is None or ctx.tree is None:
         return None
@@ -138,6 +142,12 @@ def to_fragment(ctx, full_path: str) -> Optional[dict]:
         },
         "str_consts": dict(ctx.str_consts),
         "int_consts": dict(ctx.int_consts),
+        "raises": [
+            [s, ln] for s, ln in _protocol.file_raises(ctx)
+        ],
+        "ledger": [
+            [k, ln] for k, ln in _protocol.file_ledger_events(ctx)
+        ],
     }
 
 
@@ -158,3 +168,14 @@ def apply_fragment(ctx, fragment: dict) -> None:
     ctx.int_consts = {
         k: int(v) for k, v in fragment["int_consts"].items()
     }
+    # v4 protocol facts: pre-installing them lets the raise/ledger
+    # censuses skip their AST scans on warm runs (protocol.file_raises
+    # / file_ledger_events consult these attributes first).
+    if "raises" in fragment:
+        ctx._protocol_raises = [
+            (s, int(ln)) for s, ln in fragment["raises"]
+        ]
+    if "ledger" in fragment:
+        ctx._protocol_ledger = [
+            (k, int(ln)) for k, ln in fragment["ledger"]
+        ]

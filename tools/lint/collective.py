@@ -482,3 +482,50 @@ def chain_walk_calls(pkg) -> List[Tuple[str, object, ast.Call, str]]:
             out.append((chain, ctx, node, qual))
     pkg._chain_walk_calls = out
     return out
+
+
+def downgrade_sites(pkg) -> List[Tuple[str, object, object, object, ast.Call]]:
+    """Every ``downgrade(chain, frm, to, ...)`` call with a resolvable
+    chain-name first argument, as ``(chain, frm, to, ctx, call)`` over
+    NON-TEST files (cached per run).  ``frm``/``to`` are None when the
+    stage argument is dynamic — the quorum adoption loop forwards the
+    exchanged positions through variables; G019 holds only LITERAL
+    walks to the declaration (a dynamic stage is validated at runtime
+    by watchdog.downgrade itself)."""
+    cached = getattr(pkg, "_downgrade_sites", None)
+    if cached is not None:
+        return cached
+    from tools.lint.engine import is_test_path, resolve_str, terminal_name
+
+    out: List[Tuple[str, object, object, object, ast.Call]] = []
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        if "downgrade" not in ctx.source:
+            continue
+        for node in ctx.nodes(ast.Call):
+            if terminal_name(node.func) != "downgrade":
+                continue
+            if not node.args:
+                continue
+            chain = resolve_str(node.args[0], ctx, pkg)
+            if chain is None:
+                continue
+            frm = (
+                resolve_str(node.args[1], ctx, pkg)
+                if len(node.args) > 1
+                else None
+            )
+            to = (
+                resolve_str(node.args[2], ctx, pkg)
+                if len(node.args) > 2
+                else None
+            )
+            for kw in node.keywords:
+                if kw.arg == "frm":
+                    frm = resolve_str(kw.value, ctx, pkg)
+                elif kw.arg == "to":
+                    to = resolve_str(kw.value, ctx, pkg)
+            out.append((chain, frm, to, ctx, node))
+    pkg._downgrade_sites = out
+    return out

@@ -951,6 +951,12 @@ def build_inventory(pkg: "PackageContext") -> dict:
     from tools.lint import collective as coll
 
     collectives = [s.to_entry() for s in coll.census(pkg)]
+    # The v4 protocol censuses (tools/lint/protocol.py): every raise
+    # site, ledger-event emission, and CHAINS walk — the artifacts
+    # G018-G020 prove the error-classification / cascade / fence
+    # contracts against, drift-checked like everything above.
+    from tools.lint import protocol as proto
+
     return {
         "version": 1,
         "comment": (
@@ -963,6 +969,9 @@ def build_inventory(pkg: "PackageContext") -> dict:
         "span_sites": _counted(spans),
         "env_reads": _counted(envs),
         "collective_sites": _counted(collectives),
+        "raise_sites": _counted(proto.raise_census(pkg)),
+        "ledger_events": _counted(proto.ledger_census(pkg)),
+        "chain_walks": _counted(proto.chain_walk_census(pkg)),
         "waivers": _counted(waivers),
     }
 

@@ -1,9 +1,19 @@
 """graftlint v2 dataflow: intra-function def-use/taint walks.
 
-Two analyses, both statement-ordered approximations (branches are walked
-with a shared environment, the worse state wins; runtime re-ordering
-inside loops is out of scope — a lint that guesses wrong asks for a
-waiver, it does not stay silent):
+Two analyses, both statement-ordered and PATH-SENSITIVE (v4): each
+branch suite of an ``if``/``try``/loop walks its OWN copy of the taint
+environment, and the copies worst-state merge only at the join point
+(:func:`join_worst`).  A sanitize inside one arm therefore no longer
+bleeds into its sibling arm (the v3 shared-environment approximation
+that forced the G015 branch-suite waiver family), and a name the arms
+bind to different states joins to the WORSE one — the old sequential
+walk let the last suite win, which could hide a dynamic path behind a
+clean sibling.  Bounds of the enumeration: suites are walked once (no
+loop fixpoint — the body's join covers zero-or-more iterations), and a
+``try`` handler joins from the pre-body and post-body states, not from
+every intermediate statement.  Runtime re-ordering beyond that is out
+of scope — a lint that guesses wrong asks for a waiver, it does not
+stay silent:
 
 - **Shape taint** (G011): a *dynamic int* — ``len()``, ``.shape[...]``,
   ``.size``, and arithmetic thereon — is DYNAMIC until it flows through
@@ -34,6 +44,22 @@ import ast
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from tools.lint.graph import PackageGraph
+
+
+def join_worst(
+    env: Dict[str, int], branches: Sequence[Dict[str, int]]
+) -> None:
+    """Worst-state merge at a control-flow join: each branch walked its
+    own copy of ``env``, so for every name any branch touched the joined
+    state is the max across branches (an absent key is the lattice
+    bottom, 0 — both taint lattices use 0 for their clean state).  The
+    merge writes back into ``env`` in place."""
+    keys: Set[str] = set()
+    for b in branches:
+        keys.update(b)
+    for k in keys:
+        env[k] = max(b.get(k, 0) for b in branches)
+
 
 # -- shape taint ------------------------------------------------------------
 
@@ -196,19 +222,45 @@ class ShapeFlow:
                 self._assign(stmt.target, state, env)
             elif isinstance(stmt, ast.For):
                 self._assign(stmt.target, self.eval(stmt.iter, env), env)
-                yield from self.walk(stmt.body + stmt.orelse, env)
-            elif isinstance(stmt, ast.While):
-                yield from self.walk(stmt.body + stmt.orelse, env)
-            elif isinstance(stmt, ast.If):
-                yield from self.walk(stmt.body, env)
+                # The body may run zero times: walk it on a copy, join
+                # with the fall-through state before the orelse (which
+                # runs either way, sans break).
+                body_env = dict(env)
+                yield from self.walk(stmt.body, body_env)
+                join_worst(env, [env, body_env])
                 yield from self.walk(stmt.orelse, env)
+            elif isinstance(stmt, ast.While):
+                body_env = dict(env)
+                yield from self.walk(stmt.body, body_env)
+                join_worst(env, [env, body_env])
+                yield from self.walk(stmt.orelse, env)
+            elif isinstance(stmt, ast.If):
+                # Per-suite environments: a sanitize in one arm must not
+                # clean its sibling.  An absent orelse walks an empty
+                # suite, so its copy IS the fall-through path.
+                body_env = dict(env)
+                orelse_env = dict(env)
+                yield from self.walk(stmt.body, body_env)
+                yield from self.walk(stmt.orelse, orelse_env)
+                join_worst(env, [body_env, orelse_env])
             elif isinstance(stmt, (ast.With, ast.AsyncWith)):
                 yield from self.walk(stmt.body, env)
             elif isinstance(stmt, ast.Try):
-                yield from self.walk(stmt.body, env)
+                # Handlers see the worst of the pre-body and post-body
+                # states (the exception may fire on any statement); the
+                # orelse continues the success path only.
+                body_env = dict(env)
+                yield from self.walk(stmt.body, body_env)
+                handler_env = dict(env)
+                join_worst(handler_env, [env, body_env])
+                handler_envs: List[Dict[str, int]] = []
                 for h in stmt.handlers:
-                    yield from self.walk(h.body, env)
-                yield from self.walk(stmt.orelse + stmt.finalbody, env)
+                    h_env = dict(handler_env)
+                    yield from self.walk(h.body, h_env)
+                    handler_envs.append(h_env)
+                yield from self.walk(stmt.orelse, body_env)
+                join_worst(env, [body_env] + handler_envs)
+                yield from self.walk(stmt.finalbody, env)
 
     # Shape-forming sinks: terminal name -> selector of the shape
     # argument expressions in the call.  Only DEVICE shape-formers
@@ -399,6 +451,22 @@ RANK_UNIFORM, RANK_DIVERGENT = 0, 1
 # `downgrade` is conditional on the chain's registration.
 RANK_SANITIZER_NAMES = ("stage_allowed", "floor_stage", "propose")
 
+# Epoch-guard sanitizers (v4, the direction-5 enabler): the fenced
+# checkpoint primitives answer from the domain's authoritative FENCE —
+# `checkpoint_fence` validates the writer's acquired epoch against it
+# at every commit, `validate_resume_fence` rejects a stale stamp on the
+# resume side, and `acquire_fence`/`current_fence` are the transport
+# reads both build on.  A value compared against (or stamped with) the
+# fence epoch is domain-agreed by construction, so these clamp exactly
+# like the consensus primitives: deliberate, epoch-guarded divergence
+# is expressible in the lattice instead of waivable around it.
+EPOCH_GUARD_SANITIZER_NAMES = (
+    "checkpoint_fence",
+    "validate_resume_fence",
+    "acquire_fence",
+    "current_fence",
+)
+
 # Call terminals that read a per-rank source.  env helper names are the
 # strict parsers of utils/env.py; ledger snapshot/summary expose this
 # rank's cascade history; process_index/heartbeat_age are rank identity.
@@ -439,7 +507,7 @@ def _rank_call_kind(call: ast.Call) -> Optional[str]:
     t = terminal_name(call.func)
     if t == "downgrade":
         return "downgrade"
-    if t in RANK_SANITIZER_NAMES:
+    if t in RANK_SANITIZER_NAMES or t in EPOCH_GUARD_SANITIZER_NAMES:
         return "sanitizer"
     if t in _RANK_DIVERGENT_TERMINALS:
         return "divergent"
@@ -455,9 +523,9 @@ def _rank_call_kind(call: ast.Call) -> Optional[str]:
 
 
 class RankFlow:
-    """Per-function rank-divergence walk (statement-ordered, same
-    approximation contract as ShapeFlow: branches share an environment,
-    the worse state wins).
+    """Per-function rank-divergence walk (statement-ordered and
+    path-sensitive, same contract as ShapeFlow: per-suite environment
+    copies, worst-state merge at the join).
 
     ``summaries`` maps fully-qualified function names to the rank state
     of their return value; ``consensus_chains`` is the statically
@@ -628,12 +696,21 @@ class RankFlow:
             self._assign(stmt.target, state, env)
         elif isinstance(stmt, ast.For):
             self._assign(stmt.target, self.eval(stmt.iter, env), env)
-            self.run(stmt.body + stmt.orelse, env)
-        elif isinstance(stmt, ast.While):
-            self.run(stmt.body + stmt.orelse, env)
-        elif isinstance(stmt, ast.If):
-            self.run(stmt.body, env)
+            body_env = dict(env)
+            self.run(stmt.body, body_env)
+            join_worst(env, [env, body_env])
             self.run(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            body_env = dict(env)
+            self.run(stmt.body, body_env)
+            join_worst(env, [env, body_env])
+            self.run(stmt.orelse, env)
+        elif isinstance(stmt, ast.If):
+            body_env = dict(env)
+            orelse_env = dict(env)
+            self.run(stmt.body, body_env)
+            self.run(stmt.orelse, orelse_env)
+            join_worst(env, [body_env, orelse_env])
         elif isinstance(stmt, (ast.With, ast.AsyncWith)):
             for item in stmt.items:
                 if item.optional_vars is not None:
@@ -644,12 +721,22 @@ class RankFlow:
                     )
             self.run(stmt.body, env)
         elif isinstance(stmt, ast.Try):
-            self.run(stmt.body, env)
+            body_env = dict(env)
+            self.run(stmt.body, body_env)
+            handler_base = dict(env)
+            join_worst(handler_base, [env, body_env])
+            handler_envs: List[Dict[str, int]] = []
             for h in stmt.handlers:
+                h_env = dict(handler_base)
                 if h.name:
-                    env[h.name] = RANK_DIVERGENT
-                self.run(h.body, env)
-            self.run(stmt.orelse + stmt.finalbody, env)
+                    # Only the failing rank enters the handler: the
+                    # caught exception is per-rank state.
+                    h_env[h.name] = RANK_DIVERGENT
+                self.run(h.body, h_env)
+                handler_envs.append(h_env)
+            self.run(stmt.orelse, body_env)
+            join_worst(env, [body_env] + handler_envs)
+            self.run(stmt.finalbody, env)
 
 
 def rank_summaries(

@@ -1,0 +1,465 @@
+"""graftlint v4: the reliability-protocol verifier (ISSUE 16).
+
+The chaos harness (PR 9/12) SAMPLES the repo's reliability invariant at
+runtime — "byte-identical OR classified-naming-the-site OR
+ledger-degraded, never a hang or silent corruption".  This layer PROVES
+the protocol's static half: three drift-checked censuses (every
+``raise`` site, every ledger-event emission, every ``CHAINS`` walk)
+ship in ``tools/lint/inventory.json``, and three rules check the
+contracts the censuses witness:
+
+- **G018 unclassified-raise** — an exception escaping an engine/CLI
+  boundary surface (``cli.py``/``preprocess.py``, ``models/``,
+  ``serve/``, ``rules/``, ``io/``, ``parallel/``) must be CLASSIFIED:
+  an ``InputError`` (or any class defined by the classification layer —
+  ``errors.py`` / ``reliability/`` — or a subclass thereof), a bare
+  re-raise, a raise the enclosing ``try`` wraps locally into a
+  classified type, a raise built by a classified-constructing helper
+  (the ``_closure_error`` pattern), or a path that records a ledger
+  event.  Everything else surfaces to the operator as an unclassified
+  traceback — exactly what the chaos invariant forbids.
+
+- **G019 cascade-exhaustiveness** — every literal ``downgrade(chain,
+  frm, to)`` walk must name a ``CHAINS``-registered chain and move
+  FORWARD along its declared stage order, and every chain somebody
+  downgrades must have a literal-edge path to its exact-fallback
+  terminus (a dynamic ``frm`` counts as a from-anywhere edge: the
+  quorum adoption walk starts wherever the peer's position says).  A
+  ``downgrade`` whose stages don't match the live ``CHAINS`` literal
+  flags both ways — site against declaration and declaration against
+  sites.
+
+- **G020 fence-discipline** — the split-brain contract from PR 12,
+  checked instead of trusted: every ``write_manifest`` call stamps the
+  fence epoch (third positional or ``fence=``), and every function
+  reading a manifest (``load_manifest``/``manifest_fence``) outside
+  the test/tools harness validates it (``validate_resume_fence``,
+  directly or through one resolvable callee — ``load_checkpoint``'s
+  shape).
+
+Like graph/flow/collective, this is pure stdlib over the parsed
+sources: the linter never imports the package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint.engine import (
+    dotted_name,
+    is_test_path,
+    resolve_label,
+    terminal_name,
+)
+
+# Path components that form the engine/CLI boundary: an exception
+# escaping THESE surfaces reaches the operator (or a serving client)
+# and must be classified.  reliability/ and obs/ ARE the
+# classification/observation layers; ops/, utils/ and native/ surface
+# only through the boundary modules above them.
+_BOUNDARY_DIRS = {"io", "serve", "rules", "parallel", "models"}
+_BOUNDARY_FILES = {"cli.py", "preprocess.py"}
+
+# Builtin exception names an unclassified raise typically spells.
+_BUILTIN_EXCEPTIONS = {
+    "ArithmeticError",
+    "AssertionError",
+    "AttributeError",
+    "BaseException",
+    "BufferError",
+    "EOFError",
+    "Exception",
+    "FileExistsError",
+    "FileNotFoundError",
+    "IOError",
+    "IndexError",
+    "KeyError",
+    "LookupError",
+    "MemoryError",
+    "NotImplementedError",
+    "OSError",
+    "OverflowError",
+    "PermissionError",
+    "RuntimeError",
+    "StopIteration",
+    "TimeoutError",
+    "TypeError",
+    "UnicodeDecodeError",
+    "ValueError",
+    "ZeroDivisionError",
+}
+
+
+def is_boundary_path(path: str) -> bool:
+    parts = path.split("/")
+    if is_test_path(path) or "tools" in parts:
+        return False
+    return bool(_BOUNDARY_DIRS.intersection(parts[:-1])) or (
+        parts[-1] in _BOUNDARY_FILES
+    )
+
+
+def classified_classes(pkg) -> Set[str]:
+    """Names of CLASSIFIED exception classes: everything defined by the
+    classification layer (``errors.py`` or any ``reliability/`` module)
+    plus package classes that subclass one (terminal-name bases, to a
+    fixpoint) — ``InputError``, ``StaleFenceError``, ``PeerLost``,
+    ``MeshDivergence``, the watchdog timeouts, ``InjectedAbort`` in the
+    real tree.  Cached per run."""
+    cached = getattr(pkg, "_classified_classes", None)
+    if cached is not None:
+        return cached
+    seed: Set[str] = set()
+    bases: Dict[str, Set[str]] = {}
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        parts = ctx.path.split("/")
+        classifying = "reliability" in parts or parts[-1] == "errors.py"
+        for node in ctx.nodes(ast.ClassDef):
+            names = {terminal_name(b) for b in node.bases}
+            names.discard(None)
+            bases.setdefault(node.name, set()).update(names)
+            if classifying:
+                seed.add(node.name)
+    changed = True
+    while changed:
+        changed = False
+        for name, base_names in bases.items():
+            if name not in seed and base_names & seed:
+                seed.add(name)
+                changed = True
+    pkg._classified_classes = seed
+    return seed
+
+
+def package_class_names(pkg) -> Set[str]:
+    """Every class name defined in a non-test package file (an
+    UNCLASSIFIED local exception type is as much a G018 finding as a
+    builtin)."""
+    cached = getattr(pkg, "_package_class_names", None)
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        for node in ctx.nodes(ast.ClassDef):
+            out.add(node.name)
+    pkg._package_class_names = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-file protocol facts (own-bytes only — cacheable, tools/lint/cache.py)
+
+
+def _is_ledger_record(call: ast.Call) -> bool:
+    """The dotted ``ledger.record`` spelling (``LEDGER.record`` inside
+    the ledger module itself) — the repo's one way to emit a
+    degradation event.  Own-bytes decidable: the census must stay
+    cacheable per file."""
+    d = dotted_name(call.func)
+    return d is not None and d.lower().endswith("ledger.record")
+
+
+def raise_spelling(node: ast.Raise) -> str:
+    """The censused spelling of a raise site: the raised class's
+    terminal name, ``<reraise>`` for a bare ``raise``, ``<value>`` for
+    a raised non-call expression (a captured exception variable)."""
+    exc = node.exc
+    if exc is None:
+        return "<reraise>"
+    if isinstance(exc, ast.Call):
+        t = terminal_name(exc.func)
+        return t if t is not None else "<dynamic>"
+    t = terminal_name(exc)
+    return f"<value:{t}>" if t is not None else "<value>"
+
+
+def file_raises(ctx) -> List[Tuple[str, int]]:
+    """``[(spelling, lineno)]`` for every raise statement in this file,
+    derived from its own bytes only.  A cached fragment pre-installs
+    the list (``ctx._protocol_raises``); results are bit-identical
+    either way (pinned by tests)."""
+    cached = getattr(ctx, "_protocol_raises", None)
+    if cached is not None:
+        return cached
+    out = [
+        (raise_spelling(node), node.lineno)
+        for node in ctx.nodes(ast.Raise)
+    ]
+    ctx._protocol_raises = out
+    return out
+
+
+def file_ledger_events(ctx) -> List[Tuple[str, int]]:
+    """``[(kind, lineno)]`` for every ``ledger.record`` emission in this
+    file; the kind is the compile-time-resolved first argument (file
+    scope only, so the fact stays own-bytes cacheable) or
+    ``<dynamic>``.  A cached fragment pre-installs the list
+    (``ctx._protocol_ledger``)."""
+    cached = getattr(ctx, "_protocol_ledger", None)
+    if cached is not None:
+        return cached
+    out: List[Tuple[str, int]] = []
+    for node in ctx.nodes(ast.Call):
+        if not _is_ledger_record(node):
+            continue
+        kind: Optional[str] = None
+        if node.args:
+            kind = resolve_label(node.args[0], ctx, None)
+        out.append((kind if kind is not None else "<dynamic>", node.lineno))
+    ctx._protocol_ledger = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# package censuses (inventory artifacts — drift-checked like the
+# fetch/failpoint/collective censuses)
+
+
+def raise_census(pkg) -> List[dict]:
+    """``raise_sites`` inventory entries over every non-test file."""
+    out = []
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        for spelling, _line in file_raises(ctx):
+            out.append({"exception": spelling, "path": ctx.path})
+    return out
+
+
+def ledger_census(pkg) -> List[dict]:
+    """``ledger_events`` inventory entries over every non-test file."""
+    out = []
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        for kind, _line in file_ledger_events(ctx):
+            out.append({"kind": kind, "path": ctx.path})
+    return out
+
+
+def chain_walk_census(pkg) -> List[dict]:
+    """``chain_walks`` inventory entries: every resolvable
+    ``stage_allowed``/``floor_stage``/``propose``/``downgrade`` walk
+    with its walker (function-granular — the v4 attribution G016 flags
+    on)."""
+    from tools.lint import collective as coll
+
+    out = []
+    for chain, wctx, _node, qual in coll.chain_walk_calls(pkg):
+        out.append(
+            {
+                "chain": chain,
+                "walker": qual or "<module>",
+                "path": wctx.path,
+            }
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G018 support: local-wrap and helper-classification predicates
+
+
+def _handler_catch_names(try_node: ast.Try) -> Set[str]:
+    """Terminal names the handlers of this try catch; ``<bare>`` for a
+    typeless handler."""
+    out: Set[str] = set()
+    for h in try_node.handlers:
+        if h.type is None:
+            out.add("<bare>")
+            continue
+        types = (
+            h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        )
+        for t in types:
+            name = terminal_name(t)
+            if name is not None:
+                out.add(name)
+    return out
+
+
+def locally_wrapped_raises(ctx) -> Dict[int, Set[str]]:
+    """``id(raise-node) -> union of catch names`` for every raise
+    sitting in the BODY of a try whose handlers could catch it (the
+    wrap idiom: ``raise ValueError`` inside ``try: ... except
+    (ValueError, KeyError): raise InputError(...)``).  Handler and
+    orelse raises are NOT wrapped — Python only routes body exceptions
+    to the handlers."""
+    out: Dict[int, Set[str]] = {}
+    for try_node in ctx.nodes(ast.Try):
+        catches = _handler_catch_names(try_node)
+        if not catches:
+            continue
+        for stmt in try_node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    out.setdefault(id(sub), set()).update(catches)
+    return out
+
+
+def _fn_constructs_classified(fn: ast.AST, classified: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and terminal_name(
+            node.func
+        ) in classified:
+            return True
+    return False
+
+
+def _fn_records_ledger(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _is_ledger_record(node):
+            return True
+    return False
+
+
+def unclassified_raises(ctx, pkg) -> List[Tuple[ast.Raise, str]]:
+    """``[(raise-node, spelling)]`` for this boundary file's raises of
+    unclassified types with no sanctioned escape: not locally wrapped,
+    not built by a classified-constructing helper, no ledger event on
+    the enclosing function's paths."""
+    classified = classified_classes(pkg)
+    pkg_classes = package_class_names(pkg)
+    wrapped = None
+    enclosing = None
+    out: List[Tuple[ast.Raise, str]] = []
+    for node in ctx.nodes(ast.Raise):
+        exc = node.exc
+        if exc is None:
+            continue  # bare re-raise: the original classification holds
+        spelling = terminal_name(
+            exc.func if isinstance(exc, ast.Call) else exc
+        )
+        if spelling is None or spelling in classified:
+            continue
+        if isinstance(exc, ast.Call):
+            if (
+                spelling not in _BUILTIN_EXCEPTIONS
+                and spelling not in pkg_classes
+            ):
+                # An unresolvable constructor: maybe a classified-
+                # constructing helper (`raise _closure_error(...)`).
+                hit = pkg.graph.resolve_call(ctx, exc)
+                if hit is not None and _fn_constructs_classified(
+                    hit[1], classified
+                ):
+                    continue
+                if hit is None:
+                    continue  # external/unknown callable: not provable
+        else:
+            # `raise exc` of a captured variable re-raises whatever was
+            # classified upstream; only a NAMED exception class counts.
+            if spelling not in _BUILTIN_EXCEPTIONS and (
+                spelling not in pkg_classes
+            ):
+                continue
+        if wrapped is None:
+            wrapped = locally_wrapped_raises(ctx)
+        catches = wrapped.get(id(node), set())
+        if (
+            spelling in catches
+            or "Exception" in catches
+            or "BaseException" in catches
+            or "<bare>" in catches
+        ):
+            continue
+        if enclosing is None:
+            enclosing = ctx.enclosing_functions()
+        fn = enclosing.get(id(node))
+        if fn is not None and _fn_records_ledger(fn):
+            continue
+        out.append((node, spelling))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# G020 support
+
+
+def _call_has_fence(call: ast.Call) -> bool:
+    if len(call.args) >= 3:
+        return True
+    return any(kw.arg == "fence" for kw in call.keywords)
+
+
+def _fn_validates_fence(fn: ast.AST, ctx, pkg) -> bool:
+    """``validate_resume_fence`` reached from ``fn`` directly or
+    through one graph-resolvable callee (``load_checkpoint`` funnels
+    the check through ``quorum.validate_resume_fence`` directly; a
+    wrapper one hop up still counts)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) == "validate_resume_fence":
+            return True
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = pkg.graph.resolve_call(ctx, node)
+        if hit is None:
+            continue
+        for sub in ast.walk(hit[1]):
+            if isinstance(sub, ast.Call) and terminal_name(
+                sub.func
+            ) == "validate_resume_fence":
+                return True
+    return False
+
+
+_MANIFEST_READERS = ("load_manifest", "manifest_fence")
+
+
+def fence_findings(ctx, pkg):
+    """``[(node, message)]`` for this file's fence-discipline breaks:
+    fence-less ``write_manifest`` calls, and manifest-reading functions
+    that never validate the resume fence.  Test files and the tools/
+    harness are out of scope (chaos READS manifests to check this very
+    invariant from outside the protocol)."""
+    parts = ctx.path.split("/")
+    if is_test_path(ctx.path) or "tools" in parts:
+        return []
+    out = []
+    enclosing = None
+    checked_fns: Dict[int, bool] = {}
+    for node in ctx.nodes(ast.Call):
+        t = terminal_name(node.func)
+        if t == "write_manifest":
+            if not _call_has_fence(node):
+                out.append(
+                    (
+                        node,
+                        "manifest write does not stamp the fence epoch: "
+                        "pass fence=quorum.checkpoint_fence() or None "
+                        "(the split-brain contract: a superseded writer "
+                        "must be rejected at commit, not trusted)",
+                    )
+                )
+        elif t in _MANIFEST_READERS:
+            if enclosing is None:
+                enclosing = ctx.enclosing_functions()
+            fn = enclosing.get(id(node))
+            if fn is None:
+                continue  # module-level read: no resume path to hold
+            if fn.name in _MANIFEST_READERS:
+                continue  # the primitive itself (or a fixture twin)
+            ok = checked_fns.get(id(fn))
+            if ok is None:
+                ok = _fn_validates_fence(fn, ctx, pkg)
+                checked_fns[id(fn)] = ok
+            if not ok:
+                out.append(
+                    (
+                        node,
+                        f"resume path `{fn.name}` reads the manifest "
+                        "but never validates the fence epoch: call "
+                        "quorum.validate_resume_fence (directly or via "
+                        "a callee) so a split-brain checkpoint cannot "
+                        "seed a resume",
+                    )
+                )
+    return out

@@ -1310,6 +1310,11 @@ class DivergentCollectiveRule(Rule):
             yield from self._walk(body, rf, {}, ctx, pkg, bearing, seen)
 
     def _walk(self, body, rf, env, ctx, pkg, bearing, seen):
+        # Path-sensitive (v4): each suite walks its own copy of the
+        # taint env, worst-state merged at the join (flow.join_worst) —
+        # a stage_allowed consult or consensus downgrade in one arm no
+        # longer launders its sibling arm's divergent reads, and a
+        # divergent read in one arm no longer taints its sibling.
         from tools.lint import flow
 
         for stmt in body:
@@ -1337,17 +1342,35 @@ class DivergentCollectiveRule(Rule):
                             "a rendezvous point first, or waive with "
                             "the lockstep argument",
                         )
-                yield from self._walk(
-                    stmt.body, rf, env, ctx, pkg, bearing, seen
-                )
-                yield from self._walk(
-                    stmt.orelse, rf, env, ctx, pkg, bearing, seen
-                )
+                if isinstance(stmt, ast.If):
+                    body_env = dict(env)
+                    orelse_env = dict(env)
+                    yield from self._walk(
+                        stmt.body, rf, body_env, ctx, pkg, bearing, seen
+                    )
+                    yield from self._walk(
+                        stmt.orelse, rf, orelse_env, ctx, pkg, bearing,
+                        seen,
+                    )
+                    flow.join_worst(env, [body_env, orelse_env])
+                else:  # While: body may run zero times
+                    body_env = dict(env)
+                    yield from self._walk(
+                        stmt.body, rf, body_env, ctx, pkg, bearing, seen
+                    )
+                    flow.join_worst(env, [env, body_env])
+                    yield from self._walk(
+                        stmt.orelse, rf, env, ctx, pkg, bearing, seen
+                    )
             elif isinstance(stmt, ast.For):
                 rf._assign(stmt.target, rf.eval(stmt.iter, env), env)
+                body_env = dict(env)
                 yield from self._walk(
-                    stmt.body + stmt.orelse, rf, env, ctx, pkg, bearing,
-                    seen,
+                    stmt.body, rf, body_env, ctx, pkg, bearing, seen
+                )
+                flow.join_worst(env, [env, body_env])
+                yield from self._walk(
+                    stmt.orelse, rf, env, ctx, pkg, bearing, seen
                 )
             elif isinstance(stmt, (ast.With, ast.AsyncWith)):
                 for item in stmt.items:
@@ -1361,12 +1384,17 @@ class DivergentCollectiveRule(Rule):
                     stmt.body, rf, env, ctx, pkg, bearing, seen
                 )
             elif isinstance(stmt, ast.Try):
+                body_env = dict(env)
                 yield from self._walk(
-                    stmt.body, rf, env, ctx, pkg, bearing, seen
+                    stmt.body, rf, body_env, ctx, pkg, bearing, seen
                 )
+                handler_base = dict(env)
+                flow.join_worst(handler_base, [env, body_env])
+                handler_envs = []
                 for h in stmt.handlers:
+                    h_env = dict(handler_base)
                     if h.name:
-                        env[h.name] = flow.RANK_DIVERGENT
+                        h_env[h.name] = flow.RANK_DIVERGENT
                     raises = any(
                         isinstance(s, ast.Raise) for s in ast.walk(h)
                     )
@@ -1390,11 +1418,15 @@ class DivergentCollectiveRule(Rule):
                                 "or waive with the lockstep argument",
                             )
                     yield from self._walk(
-                        h.body, rf, env, ctx, pkg, bearing, seen
+                        h.body, rf, h_env, ctx, pkg, bearing, seen
                     )
+                    handler_envs.append(h_env)
                 yield from self._walk(
-                    stmt.orelse + stmt.finalbody, rf, env, ctx, pkg,
-                    bearing, seen,
+                    stmt.orelse, rf, body_env, ctx, pkg, bearing, seen
+                )
+                flow.join_worst(env, [body_env] + handler_envs)
+                yield from self._walk(
+                    stmt.finalbody, rf, env, ctx, pkg, bearing, seen
                 )
             else:
                 rf.step(stmt, env)
@@ -1409,13 +1441,18 @@ class ChainConsensusRule(Rule):
     because ``quorum.CONSENSUS_CHAINS`` carries it in the exchanged
     position vector.  This rule re-derives "collective-shaping" from
     the census: a chain walked (``stage_allowed``/``floor_stage``/
-    ``propose``/``downgrade``) from a collective-bearing function — or
-    from a module that dispatches collectives — must appear in
-    ``CONSENSUS_CHAINS``; registered chains must exist in ``CHAINS``
-    and still be walked somewhere.  Both artifacts are parsed from the
-    linted sources (never imported), so the check drift-locks the live
-    modules both ways.  Trees declaring no ``CONSENSUS_CHAINS`` are
-    exempt (pre-quorum fixtures have no registry to check).
+    ``propose``/``downgrade``) from a collective-bearing FUNCTION — or
+    at module level of a file whose module-level code dispatches a
+    collective — must appear in ``CONSENSUS_CHAINS``; registered
+    chains must exist in ``CHAINS`` and still be walked somewhere.
+    Attribution is function-granular (v4): v3 fell back to "any walk
+    in a module that dispatches collectives anywhere", which tainted
+    host-local helpers for sharing a file with device code and forced
+    the module-granularity waiver family the ROADMAP names.  Both
+    artifacts are parsed from the linted sources (never imported), so
+    the check drift-locks the live modules both ways.  Trees declaring
+    no ``CONSENSUS_CHAINS`` are exempt (pre-quorum fixtures have no
+    registry to check).
     """
 
     id = "G016"
@@ -1433,13 +1470,13 @@ class ChainConsensusRule(Rule):
         if not chains or not consensus:
             return
         bearing = coll.bearing_any(pkg)
-        # Module names derived from the tables (never by string surgery
-        # on the fq — a nested-module cut and a Class.method cut are
-        # indistinguishable in the joined string).
-        bearing_modules = {
-            mod.name
-            for mod in pkg.graph.modules.values()
-            if any(fq in bearing for fq in mod.fq_by_id.values())
+        # Files whose MODULE-LEVEL code dispatches a collective (census
+        # engine `module:<module>`): the only case where a walk outside
+        # any function can sit on a collective path.
+        module_level_bearing = {
+            s.ctx.path
+            for s in coll.census(pkg)
+            if s.engine.endswith(":<module>")
         }
         walked: Dict[str, Tuple] = {}
         shaping: Dict[str, str] = {}
@@ -1447,17 +1484,16 @@ class ChainConsensusRule(Rule):
             walked.setdefault(chain, (wctx, node))
             if chain in shaping:
                 continue
-            if qual and qual in bearing:
-                shaping[chain] = f"walked from collective-bearing `{qual}`"
-            else:
-                from tools.lint.graph import module_name
-
-                mod = module_name(wctx.path)
-                if mod in bearing_modules:
+            if qual:
+                if qual in bearing:
                     shaping[chain] = (
-                        f"walked in collective-dispatching module {mod} "
-                        f"({wctx.path}:{node.lineno})"
+                        f"walked from collective-bearing `{qual}`"
                     )
+            elif wctx.path in module_level_bearing:
+                shaping[chain] = (
+                    "walked at module level of collective-dispatching "
+                    f"{wctx.path} (line {node.lineno})"
+                )
         for chain, (stages, cctx, key) in sorted(chains.items()):
             if chain in consensus or chain not in shaping:
                 continue
@@ -1567,6 +1603,189 @@ class SyncCoverageRule(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# v4 protocol rules (tools/lint/protocol.py): the chaos invariant —
+# "byte-identical OR classified OR ledger-degraded, never a hang or
+# silent corruption" — checked statically instead of sampled at runtime.
+
+
+class UnclassifiedRaiseRule(Rule):
+    """G018 — exceptions escaping the engine/CLI boundary must be
+    classified.
+
+    The reliability contract routes every failure through the
+    classification layer: user-correctable problems become
+    ``InputError`` (CLI exit 2, named cause), infrastructure failures
+    become the reliability types the retry/cascade machinery
+    understands, and everything else is a bug.  A raw builtin raise in
+    ``cli.py``/``preprocess.py``/``models/``/``serve/``/``rules/``/
+    ``io/``/``parallel/`` surfaces to the operator as an unclassified
+    traceback — the chaos harness would count that run as FAIL, so the
+    lint does too.  Sanctioned shapes (see protocol.unclassified_raises):
+    classified types and their subclasses, bare re-raises, captured-
+    variable re-raises, raises the enclosing ``try`` wraps locally into
+    a classified type, classified-constructing helpers, and paths that
+    record a ledger event.
+    """
+
+    id = "G018"
+    name = "unclassified-raise"
+    aliases = ("raise-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import protocol as proto
+
+        if ctx.tree is None or not proto.is_boundary_path(ctx.path):
+            return
+        if "raise" not in ctx.source:
+            return
+        for node, spelling in proto.unclassified_raises(ctx, pkg):
+            yield self.finding(
+                ctx,
+                node,
+                f"unclassified `{spelling}` escapes the engine/CLI "
+                "boundary: raise InputError (or a reliability-"
+                "classified type), wrap it locally into one, or emit "
+                "a ledger event on this path — an unclassified "
+                "traceback is a chaos-invariant FAIL",
+            )
+
+
+class CascadeExhaustivenessRule(Rule):
+    """G019 — downgrade walks must match the live ``CHAINS`` literal,
+    forward-only, and reach the exact-fallback terminus.
+
+    ``watchdog.downgrade`` validates chain and direction at runtime —
+    on the degraded path, where a typo'd stage name surfaces as a
+    SECOND failure stacked on whatever triggered the cascade.  This
+    rule moves the check to lint time and adds the exhaustiveness half
+    the runtime cannot see: each chain somebody downgrades must have a
+    literal-edge path from some walked stage to its declared terminus
+    (a dynamic ``frm`` is a from-anywhere edge — the quorum adoption
+    walk starts wherever the peer's position vector says).  Chains
+    declaring no stages or never downgraded are G016's department
+    (registration/liveness), not this rule's.
+    """
+
+    id = "G019"
+    name = "cascade-exhaustiveness"
+    aliases = ("cascade-ok",)
+
+    def check(self, ctx, pkg):
+        return iter(())
+
+    def check_package(self, pkg):
+        from tools.lint import collective as coll
+
+        chains = coll.chains_decl(pkg)
+        if not chains:
+            return
+        edges: Dict[str, Set[Tuple[str, str]]] = {}
+        wild_tos: Dict[str, Set[str]] = {}
+        for chain, frm, to, wctx, node in coll.downgrade_sites(pkg):
+            if chain not in chains:
+                yield self.finding(
+                    wctx,
+                    node,
+                    f"downgrade walks unregistered chain {chain!r}: "
+                    "no such key in watchdog.CHAINS — at runtime this "
+                    "raises on the degraded path; register the chain "
+                    "or fix the name",
+                )
+                continue
+            stages = chains[chain][0]
+            bad = False
+            for stage in (frm, to):
+                if stage is not None and stage not in stages:
+                    yield self.finding(
+                        wctx,
+                        node,
+                        f"downgrade stage {stage!r} does not exist in "
+                        f"chain {chain!r} (declared order: "
+                        f"{' -> '.join(stages)}); the walk and the "
+                        "CHAINS literal drifted",
+                    )
+                    bad = True
+            if bad:
+                continue
+            if frm is not None and to is not None:
+                if stages.index(to) <= stages.index(frm):
+                    yield self.finding(
+                        wctx,
+                        node,
+                        f"downgrade {frm!r} -> {to!r} walks chain "
+                        f"{chain!r} backward (declared order: "
+                        f"{' -> '.join(stages)}); cascades are "
+                        "forward-only — a backward walk re-arms a "
+                        "stage the watchdog already burned",
+                    )
+                    continue
+                edges.setdefault(chain, set()).add((frm, to))
+            elif to is not None:
+                wild_tos.setdefault(chain, set()).add(to)
+            elif frm is not None:
+                # Literal frm, dynamic to: treat as a step to the next
+                # stage — the weakest edge the site can mean.
+                idx = stages.index(frm)
+                if idx + 1 < len(stages):
+                    edges.setdefault(chain, set()).add(
+                        (frm, stages[idx + 1])
+                    )
+        for chain in sorted(set(edges) | set(wild_tos)):
+            stages, cctx, key = chains[chain]
+            if len(stages) < 2:
+                continue
+            reach = {stages[0]} | wild_tos.get(chain, set())
+            changed = True
+            while changed:
+                changed = False
+                for frm, to in edges.get(chain, ()):
+                    if frm in reach and to not in reach:
+                        reach.add(to)
+                        changed = True
+            if stages[-1] not in reach:
+                yield self.finding(
+                    cctx,
+                    key,
+                    f"chain {chain!r} cannot reach its exact-fallback "
+                    f"terminus {stages[-1]!r} through the registered "
+                    "downgrade sites: a failure mid-cascade strands "
+                    "the engine on a degraded-but-not-exact stage — "
+                    "add the missing downgrade edge or shrink the "
+                    "declared stage order",
+                )
+
+
+class FenceDisciplineRule(Rule):
+    """G020 — fenced checkpoints, checked instead of trusted.
+
+    PR 12's split-brain contract: a checkpoint writer acquires the
+    domain fence ONCE and stamps every manifest commit with it
+    (``write_manifest(..., fence=...)`` keeps it monotone); every
+    resume path validates the stamp against the authoritative FENCE
+    before seeding state (``quorum.validate_resume_fence``).  The
+    contract only existed where checkpoint.py remembered to follow it
+    — this rule makes both halves structural: fence-less manifest
+    writes and validate-less manifest reads flag (protocol.
+    fence_findings; tools/ and tests are out of scope — chaos reads
+    manifests to check this invariant from outside it).
+    """
+
+    id = "G020"
+    name = "fence-discipline"
+    aliases = ("fence-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import protocol as proto
+
+        if ctx.tree is None:
+            return
+        if not any(n in ctx.source for n in ("write_manifest",) + proto._MANIFEST_READERS):
+            return
+        for node, message in proto.fence_findings(ctx, pkg):
+            yield self.finding(ctx, node, message)
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncRule(),
     CollectiveAxisRule(),
@@ -1585,6 +1804,9 @@ ALL_RULES: Sequence[Rule] = (
     DivergentCollectiveRule(),
     ChainConsensusRule(),
     SyncCoverageRule(),
+    UnclassifiedRaiseRule(),
+    CascadeExhaustivenessRule(),
+    FenceDisciplineRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
