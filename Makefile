@@ -40,10 +40,13 @@ chaos:
 # over the file-transport quorum — seeded kill-mid-level / divergence
 # injection / coordinator-flap / heartbeat-delay schedules under the
 # extended invariant (survivors byte-identical or classified naming
-# the rank; never a hang or a mixed-epoch artifact).
+# the rank; never a hang or a mixed-epoch artifact).  The seed set
+# pins all three elastic-mesh kinds (ISSUE 17: continuation after a
+# kill mid-level, a kill at the W_s rendezvous, and retry-budget
+# exhaustion) alongside kill/divergence/flap/wstotals.
 chaos-mp:
 	env JAX_PLATFORMS=cpu python tools/chaos.py --procs 2 \
-	    --seeds 0,3,7 --scenarios 3 --budget-s 120
+	    --seeds 0,2,5 --scenarios 3 --budget-s 120
 
 ci: lint test smoke serve-smoke obs-smoke chaos chaos-mp
 

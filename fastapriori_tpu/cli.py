@@ -513,7 +513,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fastapriori_tpu.reliability import quorum
 
         if not isinstance(
-            e, (quorum.PeerLost, quorum.MeshDivergence)
+            e,
+            (
+                quorum.PeerLost,
+                quorum.MeshDivergence,
+                # Defensive: an elastic abort that escapes every rejoin
+                # arm (it should not) is still a classified fault-domain
+                # exit, never a traceback.
+                quorum.MeshEpochAbort,
+            ),
         ):
             raise
         print(f"error: {e}", file=sys.stderr)
@@ -644,8 +652,12 @@ def _run(args) -> int:
         flight.set_dump_prefix(args.output)
     # Fault-domain rendezvous (ISSUE 12): all ranks up before any work
     # — a peer that never starts surfaces here as a classified
-    # PeerLost, bounded by attempts x FA_QUORUM_TIMEOUT_S.
-    quorum.sync("run.start", wait=True)
+    # PeerLost, bounded by attempts x FA_QUORUM_TIMEOUT_S.  The
+    # sync_or_rejoin form (ISSUE 17) lets a rank blocked here while a
+    # peer elastically aborts the mesh rejoin under the new epoch
+    # instead of misclassifying the alive peer as lost; with elastic
+    # continuation off (the default) it is exactly sync.
+    quorum.sync_or_rejoin("run.start", wait=True)
 
     u_lines = read_dat(args.input + "U.dat")
 
@@ -712,6 +724,7 @@ def _run(args) -> int:
             miner.set_resume_levels(
                 ck_levels, ck_meta, label=args.resume_from
             )
+        # lint: waive G015 -- lockstep: n_proc is jax.process_count(), identical on every rank of the mesh, so all peers take the same branch and issue the same collectives
         if n_proc > 1:
             # Multi-host: each process preprocesses only its own byte
             # range of D.dat (sharded ingest); results are replicated.
@@ -753,8 +766,10 @@ def _run(args) -> int:
     # End-of-mine rendezvous: fused and per-level ranks take different
     # numbers of level boundaries, but every rank arrives HERE — a rank
     # killed mid-mine is detected by its survivors within the bound,
-    # never waited on forever.
-    quorum.sync("mine.end", wait=True)
+    # never waited on forever.  Rejoin-armed (ISSUE 17): a rank already
+    # done mining must pair with survivors that aborted to a newer
+    # mesh epoch mid-mine.
+    quorum.sync_or_rejoin("mine.end", wait=True)
 
     phase = phase_timer("get recommends", enabled=False)
     phase.__enter__()
@@ -784,8 +799,9 @@ def _run(args) -> int:
     )
     run_span.__exit__(None, None, None)
     # Final rendezvous: no rank exits while a peer still needs its
-    # heartbeats — the survivors' last bounded wait.
-    quorum.sync("run.end", wait=True)
+    # heartbeats — the survivors' last bounded wait (rejoin-armed, so
+    # an elastic abort between mine.end and here still pairs).
+    quorum.sync_or_rejoin("run.end", wait=True)
     if args.trace and (multi_rank or proc_id == 0):
         # Multi-rank runs export per-rank traces (rank suffix before
         # the extension — no clobbering; ISSUE 12 satellite).

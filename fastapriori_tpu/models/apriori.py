@@ -55,6 +55,25 @@ _PROBE_ERRORS: Tuple[type, ...] = (
 ) + retry.xla_runtime_error_types()
 
 
+class _MineEngineClamp(RuntimeError):
+    """Control-flow signal for the mid-mine ``mine_engine`` consensus
+    clamp (ISSUE 17 satellite): a level-boundary adoption walked
+    vertical→bitmap while THIS rank was mid-lattice in the vertical
+    loop.  Carries the completed levels so :meth:`FastApriori.
+    _mine_vertical_safe` re-seeds the bitmap loop from the boundary
+    instead of re-mining from scratch.  The leading ``ABORTED`` keeps
+    it transient-classified — the safety arm's walk-the-chain contract
+    (``watchdog.transient``) holds unchanged."""
+
+    def __init__(self, levels: list, k: int):
+        self.levels = levels
+        self.k = k
+        super().__init__(
+            f"ABORTED: mine_engine clamped vertical->bitmap at level "
+            f"{k} by quorum consensus"
+        )
+
+
 def _fused_m_cap_memory_limit(
     cfg: MinerConfig,
     ctx: DeviceContext,
@@ -159,6 +178,10 @@ class FastApriori:
         self._resume_levels: Optional[list] = None
         self._resume_meta: Optional[Dict[str, int]] = None
         self._resume_label = "checkpoint"
+        # Last-committed-levels stash (ISSUE 17): kept on EVERY rank so
+        # whichever rank holds writership after an elastic rejoin can
+        # re-commit the checkpoint under the re-derived fence.
+        self._ckpt_stash: Optional[Tuple[list, Dict[str, int]]] = None
 
     # Fluent setters (FastApriori.scala:21-29).
     def set_min_support(self, min_support: float) -> "FastApriori":
@@ -221,6 +244,21 @@ class FastApriori:
             return
         prefix = self.config.checkpoint_prefix
         k = int(levels[-1][0].shape[1])
+        if prefix:
+            # Stash on every rank (not just the writer): writership can
+            # move to THIS rank at an elastic rejoin, and the new writer
+            # must be able to re-commit under the re-derived fence.
+            self._ckpt_stash = (
+                list(levels),
+                {
+                    "n_raw": data.n_raw,
+                    "min_count": data.min_count,
+                    "num_items": data.num_items,
+                },
+            )
+            dom = quorum.active()
+            if dom is not None:
+                dom.add_rejoin_hook(self._recommit_checkpoint)
         if prefix and jax.process_index() == 0 and quorum.is_writer():
             from fastapriori_tpu.io.checkpoint import save_checkpoint
 
@@ -250,6 +288,27 @@ class FastApriori:
         # peer (stale heartbeat) as a classified PeerLost instead of a
         # collective hang.  Non-blocking; no-op without a domain.
         quorum.sync(f"level.{k}")
+
+    def _recommit_checkpoint(self) -> None:
+        """Elastic-rejoin hook (ISSUE 17): re-commit the last committed
+        levels under the re-derived fence.  Runs after EVERY completed
+        rejoin — including ones absorbed outside the level loop (the
+        post-mine ``mine.end``/``run.end`` rendezvous) where no further
+        per-level commit would otherwise refresh the npz, leaving it
+        stranded at the pre-abort fence while the end-of-run manifest
+        advances.  Pure local file I/O: no failpoint, no quorum sync."""
+        stash = self._ckpt_stash
+        prefix = self.config.checkpoint_prefix
+        if stash is None or not prefix:
+            return
+        if jax.process_index() != 0 or not quorum.is_writer():
+            return
+        from fastapriori_tpu.io.checkpoint import save_checkpoint
+
+        levels, meta = stash
+        save_checkpoint(
+            prefix, levels, dict(meta, fence=quorum.checkpoint_fence())
+        )
 
     # -- count-reduction engine (ROADMAP item 2: sparse allreduce) -----
     _COUNT_REDUCE = ("auto", "dense", "sparse")
@@ -2412,6 +2471,21 @@ class FastApriori:
         )
         try:
             return self._mine_vertical(data)
+        except _MineEngineClamp as exc:
+            # Mid-mine consensus clamp (ISSUE 17 satellite): a peer
+            # walked mine_engine vertical→bitmap and the level-boundary
+            # adoption clamped this rank at level k.  The adoption
+            # already recorded the cascade walk (reason="quorum"); here
+            # the completed levels seed the bitmap loop so nothing is
+            # recounted (bit-exact by the differential contract).
+            self.set_resume_levels(exc.levels, None, "engine_clamp")
+            ledger.record(
+                "mine_engine_fallback",
+                once_key="quorum",
+                reason="quorum",
+                k=exc.k,
+            )
+            return self._mine_levels(data)
         except Exception as exc:
             if not watchdog.transient(exc):
                 raise
@@ -2615,6 +2689,79 @@ class FastApriori:
         pair_pre: Optional[dict] = None,
         vertical: bool = False,
     ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Elastic arm around :meth:`_level_loop_impl` (ISSUE 17): on
+        ``PeerLost``/``MeshEpochAbort`` the survivors abort the
+        in-flight level, re-rendezvous under an incremented mesh epoch
+        (:func:`quorum.elastic_rejoin` — which re-raises classified
+        when elastic continuation is disabled or the strict
+        ``FA_EPOCH_RETRY_MAX`` budget exhausts), then re-enter the loop
+        seeded from the last completed level boundary: the consensus
+        sync at ``mine.start`` re-adopts floors for the shrunk member
+        set, the engines re-resolve through those floors, and the
+        ``exchange_spec`` + W_s shard-weight totals re-derive for the
+        survivor topology (the wstotals cache/latch reset below) —
+        bit-exact per level by the same associativity argument that
+        proved the hierarchical exchange correct."""
+        progress: list = []
+        attempt_resume = resume
+        while True:
+            try:
+                return self._level_loop_impl(
+                    data, attempt_resume, bitmap, w_digits, scales,
+                    n_chunks, fast_f32, t_pad, heavy,
+                    try_fused=try_fused, pair_pre=pair_pre,
+                    vertical=vertical, progress=progress,
+                )
+            except (quorum.PeerLost, quorum.MeshEpochAbort) as exc:
+                quorum.elastic_rejoin(exc)
+                from fastapriori_tpu.obs import flight
+
+                # Survivor continuation: everything derived from the
+                # OLD member set is re-derived on re-entry — the
+                # exchanged W_s totals (cache + one-shot verify latch
+                # reset here), the exchange_spec, the engine floors.
+                self._wstotals_cache.clear()
+                self._wstotals_verified = False
+                done = [lv for lv in progress if lv[1] is not None]
+                if done:
+                    attempt_resume = done
+                # The fused offer and the ingest-overlapped pair
+                # program belong to the aborted epoch's dispatch
+                # stream; the re-entered loop re-counts from the
+                # boundary with the plain engines.
+                try_fused = False
+                pair_pre = None
+                progress = []
+                flight.note(
+                    "mesh_epoch_reseed",
+                    mesh_epoch=quorum.mesh_epoch(),
+                    members=quorum.mesh_members(),
+                    resume_from_k=(
+                        int(done[-1][0].shape[1]) if done else None
+                    ),
+                    levels_kept=len(done),
+                    # The survivor topology this epoch re-mines under
+                    # (exchange_spec re-derives at the mine.start
+                    # re-entry — this stamps the local mesh shape).
+                    respec=self.context.respec_summary(),
+                )
+
+    def _level_loop_impl(
+        self,
+        data: CompressedData,
+        resume: Optional[list],
+        bitmap,
+        w_digits,
+        scales,
+        n_chunks: int,
+        fast_f32: bool,
+        t_pad: int,
+        heavy: Optional[tuple] = None,
+        try_fused: bool = False,
+        pair_pre: Optional[dict] = None,
+        vertical: bool = False,
+        progress: Optional[list] = None,
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """The level-synchronous loop over a device-resident bitmap
         (levels 2..k; reference C6+C7+C8+C9).  ``try_fused``: the
         pipelined-ingest caller — offer the whole lattice to the fused
@@ -2631,7 +2778,12 @@ class FastApriori:
         generation, deferred counts, mid-mine drains, checkpointing and
         resume stay engine-independent; the fused offer, the
         heavy-weight split and the shallow-tail fold are bitmap-engine
-        machinery and stay off."""
+        machinery and stay off.
+
+        ``progress``: the elastic wrapper's live view of completed
+        levels — the SAME list object the loop mutates in place, so an
+        abort mid-level still leaves every completed boundary visible
+        to the re-seed."""
         cfg = self.config
         ctx = self.context
         f = data.num_items
@@ -2666,7 +2818,9 @@ class FastApriori:
         # Frequent k-sets live as a lex-sorted int32 [M, k] matrix between
         # levels; frozensets are materialized ONCE at the end (the per-set
         # Python objects were the dominant cost on dense data).
-        levels: List[Tuple[np.ndarray, np.ndarray]] = []
+        levels: List[Tuple[np.ndarray, np.ndarray]] = (
+            [] if progress is None else progress
+        )
 
         def pair_fetch():
             """Host values from the overlapped pair program (memoized —
@@ -2974,7 +3128,15 @@ class FastApriori:
         pending_map: Dict[int, list] = {}
         drained: list = []  # [(per-level segment sizes, PendingCounts)]
         pending_bytes = [0]
-        defer = jax.process_count() == 1 and not cfg.checkpoint_prefix
+        # Elastic domains force eager counts: a level whose counts are
+        # still device-pending is not a boundary the survivors can
+        # re-seed from (the pending tensors die with the aborted
+        # dispatch stream).
+        defer = (
+            jax.process_count() == 1
+            and not cfg.checkpoint_prefix
+            and not quorum.elastic_enabled()
+        )
 
         def note_pending(nxt_counts):
             pending_bytes[0] += sum(
@@ -3042,6 +3204,18 @@ class FastApriori:
             # position since the last iteration — re-clamp the local
             # choices BEFORE this level's dispatch, so the very next
             # collective already matches the domain's agreed shape.
+            if vertical and not quorum.stage_allowed(
+                "mine_engine", "vertical"
+            ):
+                # PR-12 residue fix (ISSUE 17 satellite): mine_engine
+                # adoption used to land at mine start only — a peer's
+                # mid-lattice vertical→bitmap walk must clamp THIS
+                # rank at the level boundary too, like count_reduce /
+                # exchange below.  Control-flow raise: the vertical
+                # loop cannot swap its arena for a bitmap in place, so
+                # the completed levels ride up to _mine_vertical_safe,
+                # which re-seeds the bitmap loop from this boundary.
+                raise _MineEngineClamp(finish(levels), int(k))
             if count_reduce == "sparse" and not quorum.stage_allowed(
                 "count_reduce", "sparse"
             ):

@@ -160,6 +160,17 @@ def resolve_active_spec(
     return spec
 
 
+def describe_spec(spec: GroupSpec) -> str:
+    """Human/obs-facing one-token summary of a resolved topology:
+    ``"hier(GxP)"`` or ``"flat"``.  Used by the elastic-mesh respec
+    note (ISSUE 17) and post-mortem tooling — the survivor set's
+    re-derived exchange shape must be readable off the flight timeline
+    without reconstructing the knob resolution."""
+    if spec is None:
+        return "flat"
+    return f"hier({spec[0]}x{spec[1]})"
+
+
 # ---------------------------------------------------------------------------
 # in-kernel primitives (called inside shard_map-traced code)
 

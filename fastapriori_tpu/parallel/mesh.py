@@ -235,6 +235,20 @@ class DeviceContext:
         quorum consensus; the builders read it at call time."""
         self.exchange_spec = spec
 
+    def respec_summary(self) -> Dict[str, object]:
+        """The collective-shaping state of this mesh as a small dict —
+        the elastic-mesh rejoin (ISSUE 17) stamps it into the
+        ``mesh_epoch_reseed`` flight note so a continued run's
+        post-mortem shows exactly which topology each epoch mined
+        under."""
+        from fastapriori_tpu.parallel import hier
+
+        return {
+            "txn_shards": self.txn_shards,
+            "cand_shards": self.cand_shards,
+            "exchange": hier.describe_spec(self.exchange_spec),
+        }
+
     # -- data placement ----------------------------------------------------
     def shard_bitmap(self, bitmap: np.ndarray) -> jax.Array:
         """Place B with rows sharded over the txn axis."""

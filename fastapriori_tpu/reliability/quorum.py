@@ -96,6 +96,12 @@ CONSENSUS_CHAINS: Tuple[str, ...] = (
     # the END of the wire vector — existing position indices are
     # unchanged (the order stays a pinned protocol).
     "exchange",
+    # ISSUE 17: whether a PeerLost aborts the in-flight level and
+    # re-rendezvouses the survivors (continue) or classifies the run
+    # dead (abort).  Consensus-registered so one rank's retry-budget
+    # exhaustion clamps every survivor's next rejoin decision
+    # identically.  Appended at the END (pinned wire order).
+    "elastic",
 )
 
 FENCE_NAME = "FENCE"
@@ -125,6 +131,24 @@ class MeshDivergence(RuntimeError):
     classified error naming both sides instead of a hang."""
 
 
+class MeshEpochAbort(RuntimeError):
+    """A peer advanced the mesh epoch past this rank's (an elastic
+    abort is in progress): the in-flight level must abort and
+    re-rendezvous under the advertised epoch.  Deliberately carries NO
+    transient status word — retry.classify sees "fatal", so the abort
+    ESCAPES the bounded retry immediately (it is a control-flow signal
+    for the elastic rejoin arm, not a failure to be retried)."""
+
+    def __init__(self, target_epoch: int, dead, site: str, detail: str):
+        self.target_epoch = int(target_epoch)
+        self.dead = sorted(int(d) for d in dead)
+        self.site = site
+        super().__init__(
+            f"mesh epoch superseded at {site!r}: {detail} — abort the "
+            f"in-flight level and re-rendezvous at epoch {target_epoch}"
+        )
+
+
 class StaleFenceError(InputError):
     """A checkpoint commit or resume with a superseded fence epoch
     (split-brain writer).  InputError: the run cannot proceed against a
@@ -148,6 +172,19 @@ def heartbeat_ms() -> float:
     from fastapriori_tpu.utils.env import env_float
 
     return env_float("FA_HEARTBEAT_MS", 200.0, minimum=1.0)
+
+
+def epoch_retry_max() -> int:
+    """``FA_EPOCH_RETRY_MAX``: elastic-mesh retry budget — the highest
+    mesh epoch a run may reach by aborting in-flight levels and
+    re-rendezvousing the survivors around lost peers (strict; default
+    0 = elastic continuation DISABLED, a peer death stays a classified
+    PeerLost).  Each survivor-set shrink consumes one epoch; exhaustion
+    re-classifies as PeerLost — the bound is strict, never
+    best-effort."""
+    from fastapriori_tpu.utils.env import env_int
+
+    return env_int("FA_EPOCH_RETRY_MAX", 0, minimum=0)
 
 
 # ---------------------------------------------------------------------------
@@ -380,6 +417,12 @@ class QuorumDomain:
         self.rank = rank
         self.nprocs = nprocs
         self.consensus = consensus
+        # Elastic mesh (ISSUE 17): the monotonic mesh epoch every
+        # quorum round is stamped with, and the CURRENT member set
+        # (initially all ranks; shrinks at each elastic rejoin — dead
+        # ranks are never waited on again).
+        self.mesh_epoch = 0
+        self.members: List[int] = list(range(nprocs))
         self._lock = threading.Lock()
         self._seq = 0
         # Per-site payload-exchange round counters (see exchange()).
@@ -389,6 +432,13 @@ class QuorumDomain:
         self._pos: Dict[str, int] = {c: 0 for c in CONSENSUS_CHAINS}
         self._fence: Optional[int] = None
         self._epoch_trail: List[Dict[str, Any]] = []
+        # Post-rejoin hooks (ISSUE 17): artifact owners (the miner's
+        # checkpoint writer) register a callback that re-commits their
+        # last durable state under the re-derived fence, so a rejoin
+        # absorbed OUTSIDE the level loop (e.g. at the post-mine
+        # rendezvous) cannot strand an on-disk artifact at the old
+        # fence while the end-of-run manifest advances.
+        self._rejoin_hooks: List[Any] = []
         if isinstance(transport, FileTransport):
             transport.start_heartbeat()
             self._publish("init")
@@ -440,9 +490,44 @@ class QuorumDomain:
             self._seq += 1
             seq = self._seq
             vec = [self._pos[c] for c in CONSENSUS_CHAINS]
+            epoch = self.mesh_epoch
         self.transport.publish_state(
-            {"seq": seq, "site": site, "pos": vec, "t": time.time()}
+            {
+                "seq": seq,
+                "site": site,
+                "pos": vec,
+                "t": time.time(),
+                # The elastic abort broadcast: peers polling this state
+                # see the advanced epoch and abort their own in-flight
+                # level (MeshEpochAbort) instead of waiting out the
+                # bound against markers that will never pair.
+                "mesh_epoch": epoch,
+            }
         )
+
+    def _esite(self, site: str) -> str:
+        """Epoch-namespaced marker site: every rendezvous / exchange
+        marker is scoped to the CURRENT mesh epoch, so a post-abort
+        re-rendezvous can never pair with a pre-abort round's payload
+        (the satellite fix — per-round counters alone only separate
+        rounds within one epoch's lifetime)."""
+        return f"e{self.mesh_epoch}.{site}"
+
+    def _peer_epoch_check(self, rank: int, site: str) -> None:
+        """Raise :class:`MeshEpochAbort` when ``rank``'s published
+        state advertises a mesh epoch beyond ours — the peer aborted
+        and is re-rendezvousing; waiting for its markers at OUR epoch
+        would only time out."""
+        st = self.transport.peer_state(rank)
+        if st is None:
+            return
+        pe = int(st.get("mesh_epoch", 0))
+        if pe > self.mesh_epoch:
+            raise MeshEpochAbort(
+                pe, (), site,
+                f"peer rank {rank} published mesh epoch {pe} while "
+                f"this rank is at {self.mesh_epoch}",
+            )
 
     def _adopt(self, peer_vecs: Dict[int, List[int]], site: str) -> None:
         """Elementwise most-degraded-wins merge; each adoption that a
@@ -530,7 +615,9 @@ class QuorumDomain:
         (site ``quorum.<site>``), so a transient flap — including an
         armed failpoint — is absorbed and exhaustion is classified;
         worst case stall is attempts × FA_QUORUM_TIMEOUT_S."""
-        if self.nprocs == 1:
+        if self.nprocs == 1 or len(self.members) == 1:
+            # A mesh elastically shrunk to one survivor keeps mining
+            # alone: nothing left to rendezvous with.
             return
         if isinstance(self.transport, JaxTransport) and not wait:
             # The real-mesh exchange is itself a collective: every rank
@@ -601,11 +688,12 @@ class QuorumDomain:
         t = self.transport
         bound = quorum_timeout_s()
         my_vec = self._vector()
-        digest = f"{_site_slug(site)}|" + ",".join(map(str, my_vec))
+        esite = self._esite(site)
+        digest = f"{_site_slug(esite)}|" + ",".join(map(str, my_vec))
         self._publish(f"sync:{site}")
         if wait or not self.consensus:
-            t.post_marker(site, {"pos": my_vec, "digest": digest})
-        peers = [r for r in range(self.nprocs) if r != self.rank]
+            t.post_marker(esite, {"pos": my_vec, "digest": digest})
+        peers = [r for r in self.members if r != self.rank]
         peer_vecs: Dict[int, List[int]] = {}
         t0 = time.monotonic()
         pending = list(peers)
@@ -613,10 +701,25 @@ class QuorumDomain:
             still: List[int] = []
             for r in pending:
                 if wait or not self.consensus:
-                    doc = t.peer_marker(site, r)
+                    doc = t.peer_marker(esite, r)
                 else:
                     doc = t.peer_state(r)
+                    if doc is not None and (
+                        int(doc.get("mesh_epoch", 0)) > self.mesh_epoch
+                    ):
+                        raise MeshEpochAbort(
+                            int(doc["mesh_epoch"]), (), site,
+                            f"peer rank {r} published mesh epoch "
+                            f"{int(doc['mesh_epoch'])} while this rank "
+                            f"is at {self.mesh_epoch}",
+                        )
                 if doc is None:
+                    # A marker missing at OUR epoch may mean the peer
+                    # already aborted to a newer one — its markers
+                    # live under a different namespace and will never
+                    # pair with ours.
+                    if wait or not self.consensus:
+                        self._peer_epoch_check(r, site)
                     still.append(r)
                     continue
                 peer_vecs[r] = list(doc.get("pos", []))
@@ -688,7 +791,7 @@ class QuorumDomain:
         payload}`` including this rank's own.  Payload shapes must be
         uniform across ranks on the JAX transport (process_allgather —
         SPMD static shapes); the file transport takes any JSON ints."""
-        if self.nprocs == 1:
+        if self.nprocs == 1 or len(self.members) == 1:
             return {self.rank: list(payload)}
         from fastapriori_tpu.obs import flight
         from fastapriori_tpu.reliability import retry
@@ -700,12 +803,16 @@ class QuorumDomain:
         # monotonic positions, so a second mine under a persistent
         # domain dir must never pair with a peer's stale round-1
         # marker — with per-round sites a count mismatch surfaces as a
-        # bounded PeerLost instead of silently mixed payloads.  The
-        # JAX transport needs no round tag (process_allgather is
-        # ordered by collective-call discipline).
+        # bounded PeerLost instead of silently mixed payloads.  Rounds
+        # are ADDITIONALLY namespaced by mesh epoch (and restart at r1
+        # per epoch): a post-abort re-exchange among the survivors
+        # must never pair with a round a now-dead peer posted before
+        # the abort.  The JAX transport needs no round tag
+        # (process_allgather is ordered by collective-call discipline).
         with self._lock:
-            self._xseq[site] = self._xseq.get(site, 0) + 1
-            round_site = f"{site}.r{self._xseq[site]}"
+            ekey = f"e{self.mesh_epoch}.{site}"
+            self._xseq[ekey] = self._xseq.get(ekey, 0) + 1
+            round_site = f"{ekey}.r{self._xseq[ekey]}"
 
         def attempt():
             box.clear()
@@ -753,13 +860,14 @@ class QuorumDomain:
             site, {"payload": [int(v) for v in payload]}
         )
         out: Dict[int, List[int]] = {self.rank: list(payload)}
-        pending = [r for r in range(self.nprocs) if r != self.rank]
+        pending = [r for r in self.members if r != self.rank]
         t0 = time.monotonic()
         while pending:
             still: List[int] = []
             for r in pending:
                 doc = t.peer_marker(site, r)
                 if doc is None:
+                    self._peer_epoch_check(r, site)
                     still.append(r)
                     continue
                 out[r] = [int(v) for v in doc.get("payload", [])]
@@ -783,9 +891,239 @@ class QuorumDomain:
         with self._lock:
             return [dict(e) for e in self._epoch_trail]
 
+    # -- elastic mesh (ISSUE 17) ----------------------------------------
+    def elastic_rejoin(self, exc: BaseException) -> None:
+        """The elastic abort/retry arm: absorb a :class:`PeerLost` /
+        :class:`MeshEpochAbort` by re-rendezvousing the survivors under
+        an incremented mesh epoch, or re-raise classified when elastic
+        continuation is disabled (``FA_EPOCH_RETRY_MAX=0``, the
+        default), the budget is exhausted, or the consensus ``elastic``
+        chain has been clamped to ``abort``.  On return the member set
+        has shrunk, the fence is re-derived (the surviving writer
+        eagerly re-acquires, fencing out every pre-abort artifact and
+        any superseded straggler-writer), and the caller re-seeds its
+        level loop from the last checkpoint boundary."""
+        from fastapriori_tpu.reliability import watchdog
+
+        if not isinstance(exc, (PeerLost, MeshEpochAbort)):
+            raise exc
+        if not isinstance(self.transport, FileTransport) or (
+            self.nprocs == 1
+        ):
+            # The JAX transport cannot shrink its mesh in-process: a
+            # real-mesh PeerLost stays classified.
+            raise exc
+        budget = epoch_retry_max()
+        dead: set = set()
+        original = exc
+        while True:
+            if isinstance(exc, MeshEpochAbort):
+                target = max(self.mesh_epoch + 1, exc.target_epoch)
+                dead.update(exc.dead)
+            else:
+                target = self.mesh_epoch + 1
+                r = getattr(exc, "rank", -1)
+                if isinstance(r, int) and r >= 0:
+                    dead.add(r)
+            if (
+                budget <= 0
+                or target > budget
+                # lint: waive G017 -- lockstep: exhaustion is decided by budget (env, identical on all ranks) and target (converges to the same epoch via the rendezvous); this clamp read only keeps a SECOND exhaustion local — an exhausted rank raises and issues no further collectives, and the downgrade itself is a consensus proposal peers adopt at their next exchange
+                or not self.stage_allowed("elastic", "continue")
+            ):
+                # lint: waive G017 -- lockstep: guard against re-walking the already-clamped elastic chain (forward-only cascade); no collective is issued on either side of this branch
+                if budget > 0 and self.stage_allowed(
+                    "elastic", "continue"
+                ):
+                    # The registered cascade walk: exhaustion clamps
+                    # continue → abort for the whole domain (consensus
+                    # chain — peers adopt at their next exchange, so
+                    # no survivor keeps retrying a dead quorum; the
+                    # stage guard keeps a SECOND exhaustion from
+                    # re-walking the already-clamped chain).
+                    watchdog.downgrade(
+                        "elastic",
+                        "continue",
+                        "abort",
+                        reason="epoch_retry_exhausted",
+                        once_key="elastic:abort",
+                        epoch=target,
+                    )
+                if isinstance(original, PeerLost):
+                    raise original
+                raise PeerLost(
+                    min(dead) if dead else -1,
+                    getattr(original, "site", "elastic.join"),
+                    "mesh-epoch retry budget exhausted "
+                    f"(FA_EPOCH_RETRY_MAX={budget}, target epoch "
+                    f"{target})",
+                ) from original
+            try:
+                self._abort_and_rendezvous(
+                    target, dead, type(exc).__name__
+                )
+                return
+            except (PeerLost, MeshEpochAbort) as nxt:
+                # Another death (or a further abort) during the
+                # rejoin: loop — each iteration raises the target
+                # epoch, so the strict budget still bounds the total.
+                exc = nxt
+
+    def _abort_and_rendezvous(
+        self, target: int, dead: set, reason: str
+    ) -> None:
+        """Abort the in-flight level and re-rendezvous the survivors
+        at mesh epoch ``target``.  ``dead`` (mutated in place) is the
+        union of every joiner's view of the lost ranks: joiners post
+        their dead-set in the join marker and fold in every peer's —
+        a rank whose death only ONE survivor observed is excluded by
+        all, and a rank observed dying DURING the rejoin is folded in
+        rather than failing the rendezvous (an epoch bump here would
+        skew survivors across epochs and burn the retry budget on a
+        single death)."""
+        from fastapriori_tpu.obs import flight
+
+        t = self.transport
+        bound = quorum_timeout_s()
+        with self._lock:
+            from_epoch = self.mesh_epoch
+            self.mesh_epoch = target
+            members = list(self.members)
+        # Publishing the advanced epoch IS the abort broadcast: every
+        # peer's pending-rank poll checks published epochs and aborts
+        # its own in-flight level (MeshEpochAbort escapes the bounded
+        # retry) the moment it sees this.
+        self._publish(f"elastic.abort:{target}")
+        site = "elastic.join"
+        esite = self._esite(site)
+
+        def post_join() -> None:
+            t.post_marker(
+                esite,
+                {"dead": sorted(dead), "from_epoch": from_epoch},
+            )
+
+        post_join()
+        collected: set = set()
+        t0 = time.monotonic()
+        while True:
+            grew = False
+            still: List[int] = []
+            for r in members:
+                if r == self.rank or r in dead or r in collected:
+                    continue
+                doc = t.peer_marker(esite, r)
+                if doc is None:
+                    st = t.peer_state(r)
+                    pe = int(st.get("mesh_epoch", 0)) if st else 0
+                    if pe > target:
+                        raise MeshEpochAbort(
+                            pe, sorted(dead), site,
+                            f"peer rank {r} aborted again past epoch "
+                            f"{target} mid-rejoin",
+                        )
+                    still.append(r)
+                    continue
+                collected.add(r)
+                for d in doc.get("dead", ()):
+                    if int(d) not in dead:
+                        dead.add(int(d))
+                        grew = True
+            if self.rank in dead:
+                raise StaleFenceError(
+                    f"mesh epoch {target} fenced this rank out: the "
+                    "survivors re-rendezvoused declaring rank "
+                    f"{self.rank} dead — this straggler's view of the "
+                    "domain is superseded; refusing to rejoin or "
+                    "commit"
+                )
+            waited = time.monotonic() - t0
+            if grew:
+                post_join()
+                continue
+            if not still:
+                break
+            for r in still:
+                try:
+                    self._check_peer_alive(r, site, waited, bound)
+                except PeerLost:
+                    dead.add(r)
+                    grew = True
+            if grew:
+                post_join()
+                continue
+            if waited > bound:
+                raise PeerLost(
+                    still[0], site,
+                    f"elastic re-rendezvous at epoch {target} "
+                    f"incomplete after {bound}s (waiting on ranks "
+                    f"{still})",
+                )
+            time.sleep(min(0.005, bound / 10))
+        survivors = [r for r in members if r not in dead]
+        removed = sorted(dead)
+        with self._lock:
+            self.members = survivors
+            # Fence re-derivation for the survivor set: writership may
+            # have moved (lowest surviving rank), and the OLD writer
+            # must never be able to commit a pre-abort artifact.
+            self._fence = None
+        ledger.record(
+            "mesh_epoch",
+            once_key=f"epoch:{target}",
+            epoch=target,
+            from_epoch=from_epoch,
+            dead=removed,
+            members=survivors,
+            reason=reason,
+        )
+        self._publish(f"elastic.join:{target}")
+        with self._lock:
+            self._epoch_trail.append(
+                {
+                    "epoch": self._seq,
+                    "site": site,
+                    "pos": [self._pos[c] for c in CONSENSUS_CHAINS],
+                    "mesh_epoch": target,
+                    "dead": removed,
+                }
+            )
+        flight.note(
+            "mesh_epoch",
+            mesh_epoch=target,
+            from_epoch=from_epoch,
+            dead=removed,
+            members=survivors,
+            reason=reason,
+        )
+        if self.is_writer():
+            # EAGER fence re-acquire: advancing the domain FENCE here
+            # is what turns every pre-abort checkpoint stale (resume
+            # validation) and makes a superseded straggler-writer's
+            # next commit raise StaleFenceError.
+            self.checkpoint_fence()
+        for fn in list(self._rejoin_hooks):
+            fn()
+
+    def add_rejoin_hook(self, fn: Any) -> None:
+        """Register a callback to run after every completed elastic
+        rejoin, once the survivor set and fence are re-derived.  Used
+        by checkpoint writers to re-commit their last durable levels
+        under the NEW fence — without it, a rejoin absorbed after the
+        mine finished would leave the npz at the old fence and the
+        final manifest at the new one (exactly the mixed-epoch
+        artifact the chaos invariant forbids)."""
+        with self._lock:
+            if fn not in self._rejoin_hooks:
+                self._rejoin_hooks.append(fn)
+
     # -- fenced checkpoints ---------------------------------------------
     def is_writer(self) -> bool:
-        return self.rank == 0
+        # The lowest SURVIVING rank: identical to "rank 0" until an
+        # elastic rejoin removes rank 0, at which point writership
+        # moves (and the new writer eagerly re-acquires the fence,
+        # turning every pre-abort artifact stale).
+        return self.rank == min(self.members)
 
     def checkpoint_fence(self) -> int:
         """The fence epoch this process's checkpoint commits carry:
@@ -923,6 +1261,61 @@ def sync(site: str, wait: bool = False) -> None:
 def stage_allowed(chain: str, stage: str) -> bool:
     dom = active()
     return dom is None or dom.stage_allowed(chain, stage)
+
+
+def elastic_enabled() -> bool:
+    """True when the active domain can absorb a peer death by elastic
+    re-rendezvous: a multi-process FILE domain with a positive
+    ``FA_EPOCH_RETRY_MAX``.  (The JAX transport cannot shrink its mesh
+    in-process — a real-mesh PeerLost stays classified.)"""
+    dom = active()
+    return (
+        dom is not None
+        and isinstance(dom.transport, FileTransport)
+        and dom.nprocs > 1
+        and epoch_retry_max() > 0
+    )
+
+
+def elastic_rejoin(exc: BaseException) -> None:
+    """Absorb a PeerLost/MeshEpochAbort via the active domain's
+    elastic rejoin (see :meth:`QuorumDomain.elastic_rejoin`), or
+    re-raise ``exc`` when no domain is active or continuation is
+    disabled/exhausted — the caller's except-arm stays a single
+    call either way."""
+    dom = active()
+    if dom is None:
+        raise exc
+    dom.elastic_rejoin(exc)
+
+
+def sync_or_rejoin(site: str, wait: bool = False) -> None:
+    """:func:`sync` wrapped in the elastic rejoin arm, for the phase
+    rendezvous sites OUTSIDE the level loop (run.start / mine.end /
+    rules.start / run.end): a rank blocked here while a peer aborts
+    the mesh must rejoin under the new epoch rather than misclassify
+    the (alive, but epoch-advanced) peer as lost.  With elastic
+    continuation disabled this is exactly ``sync`` — the rejoin arm
+    re-raises."""
+    while True:
+        try:
+            sync(site, wait=wait)
+            return
+        except (PeerLost, MeshEpochAbort) as exc:
+            elastic_rejoin(exc)
+
+
+def mesh_epoch() -> int:
+    """The active domain's current mesh epoch (0 without a domain —
+    also the epoch of every run that never aborts)."""
+    dom = active()
+    return 0 if dom is None else dom.mesh_epoch
+
+
+def mesh_members() -> Optional[List[int]]:
+    """The surviving member ranks, or None without a domain."""
+    dom = active()
+    return None if dom is None else list(dom.members)
 
 
 def exchange(site: str, payload) -> Optional[Dict[int, List[int]]]:

@@ -91,6 +91,13 @@ CHAINS: Dict[str, Tuple[str, ...]] = {
     # records one forward walk; recovery is internal server state, not a
     # (forbidden) backward cascade event.
     "serving": ("accept", "shed"),
+    # Elastic mesh (ISSUE 17): whether a PeerLost mid-mine aborts the
+    # in-flight level and re-rendezvouses the survivors under a new
+    # mesh epoch ("continue") or classifies the run dead ("abort").
+    # Walked forward when the FA_EPOCH_RETRY_MAX budget exhausts —
+    # consensus-registered so one rank's exhaustion clamps every
+    # survivor's next rejoin decision identically.
+    "elastic": ("continue", "abort"),
 }
 
 

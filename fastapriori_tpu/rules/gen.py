@@ -422,7 +422,10 @@ def rule_arrays_from_tables(
     # a poll: every rank enters phase 2 exactly once, and the real-mesh
     # (JaxTransport) exchange only runs at rendezvous points — its
     # allgather must be called collectively.  No-op without a domain.
-    quorum.sync("rules.start", wait=True)
+    # Rejoin-armed (ISSUE 17): survivors of an elastic abort pair here
+    # under the advanced mesh epoch with any rank that finished mining
+    # before the abort.
+    quorum.sync_or_rejoin("rules.start", wait=True)
     engine = _pick_rule_engine(mats, context, config)
     if engine == "device":
         shards = resolve_rule_shards(context, config)

@@ -1264,6 +1264,7 @@ def test_cascade_chain_ordering_pinned():
         "rule_engine": ("sharded", "device", "host"),
         "rule_scan": ("device", "host"),
         "serving": ("accept", "shed"),
+        "elastic": ("continue", "abort"),
     }
     assert watchdog.chain_rank("engine", "fused") == 0
     assert watchdog.chain_rank("engine", "level") == 2
@@ -1834,10 +1835,11 @@ def test_quorum_wire_order_pinned():
     reordering is a wire-format change (pin it)."""
     assert quorum.CONSENSUS_CHAINS == (
         "engine", "mine_engine", "count_reduce", "rule_engine",
-        # ISSUE 15: appended at the END — pre-existing position
-        # indices are unchanged (appending extends the vector, it
-        # does not reorder it).
+        # ISSUE 15 / ISSUE 17: appended at the END — pre-existing
+        # position indices are unchanged (appending extends the
+        # vector, it does not reorder it).
         "exchange",
+        "elastic",
     )
     for chain in quorum.CONSENSUS_CHAINS:
         assert chain in watchdog.CHAINS
@@ -2169,6 +2171,215 @@ def test_flight_merge_orders_across_ranks(tmp_path):
     assert {e["src"] for e in merged["events"]} == {"rank0", "rank1"}
 
 
+# -- elastic mesh: collective-epoch abort/retry (ISSUE 17) -------------
+
+
+def _domain_trio(root):
+    return tuple(
+        quorum.QuorumDomain(
+            quorum.FileTransport(root, r, 3), r, 3
+        )
+        for r in range(3)
+    )
+
+
+def _survive(dom, site):
+    """One elastic level boundary: rendezvous, absorbing a peer death
+    through the rejoin arm (the models/apriori.py except-arm shape)."""
+    while True:
+        try:
+            dom.sync(site, wait=True)
+            return
+        except (quorum.PeerLost, quorum.MeshEpochAbort) as exc:
+            dom.elastic_rejoin(exc)
+
+
+def test_elastic_rejoin_survivors_continue(qroot, monkeypatch):
+    """The tentpole pin: a dead rank's loss is ABSORBED — survivors
+    abort, re-rendezvous under mesh epoch 1 with the shrunk member
+    set, writership stays with the lowest survivor, and the next
+    level boundary completes between the two of them.  Post-abort
+    markers are epoch-namespaced so they can never pair with a
+    pre-abort payload."""
+    monkeypatch.setenv("FA_EPOCH_RETRY_MAX", "2")
+    d0, d1, d2 = _domain_trio(qroot)
+    d2.close("crash")  # exit marker: rank 2 is demonstrably dead
+    t = _threading.Thread(target=lambda: _survive(d1, "level.2"))
+    t.start()
+    _survive(d0, "level.2")
+    t.join()
+    assert (d0.mesh_epoch, d1.mesh_epoch) == (1, 1)
+    assert d0.members == [0, 1] and d1.members == [0, 1]
+    assert d0.is_writer() and not d1.is_writer()
+    # The shrunk mesh keeps rendezvousing — and under the NEW epoch's
+    # marker namespace (satellite: e1.* filenames, never bare site
+    # names a pre-abort straggler could still be holding).
+    t2 = _threading.Thread(target=lambda: d1.sync("level.3", wait=True))
+    t2.start()
+    d0.sync("level.3", wait=True)
+    t2.join()
+    names = os.listdir(qroot)
+    assert any("e1.level.3" in n for n in names), names
+    assert not any("e0.level.3" in n for n in names), names
+    # The transition is ledger-recorded with the survivor set (the
+    # chaos soak and the merged flight timeline both key off this).
+    ev = [e for e in ledger.snapshot() if e["kind"] == "mesh_epoch"]
+    assert ev and ev[0]["epoch"] == 1 and ev[0]["dead"] == [2]
+    assert ev[0]["members"] == [0, 1]
+    trail = d0.epoch_trail()
+    assert any(e.get("mesh_epoch") == 1 for e in trail)
+    d0.close()
+    d1.close()
+
+
+def test_elastic_disabled_budget_zero_reraises(qroot):
+    """FA_EPOCH_RETRY_MAX=0 (the default) keeps the protocol inert:
+    the SAME PeerLost re-raises, no elastic cascade event fires, and
+    elastic_enabled() is False (the level loop's defer gate)."""
+    d0 = quorum.QuorumDomain(quorum.FileTransport(qroot, 0, 2), 0, 2)
+    quorum.set_domain(d0)
+    try:
+        assert not quorum.elastic_enabled()
+        exc = quorum.PeerLost(1, "level.2", "peer exited")
+        with pytest.raises(quorum.PeerLost) as ei:
+            d0.elastic_rejoin(exc)
+        assert ei.value is exc
+        assert d0.mesh_epoch == 0 and d0.members == [0, 1]
+        assert not any(
+            e["kind"] == "cascade" and e.get("chain") == "elastic"
+            for e in ledger.snapshot()
+        )
+    finally:
+        quorum.set_domain(None)
+        d0.close()
+
+
+def test_elastic_exhaustion_classifies_and_clamps(qroot, monkeypatch):
+    """Deaths past the budget: the rejoin arm walks the consensus
+    elastic chain continue→abort (peers adopt at their next exchange)
+    and re-raises the ORIGINAL classified PeerLost; a MeshEpochAbort
+    original is converted to a classified PeerLost naming the budget.
+    The clamped chain makes every later rejoin abort immediately."""
+    monkeypatch.setenv("FA_EPOCH_RETRY_MAX", "1")
+    d0 = quorum.QuorumDomain(quorum.FileTransport(qroot, 0, 2), 0, 2)
+    quorum.set_domain(d0)
+    try:
+        d0.mesh_epoch = 1  # one retry already consumed
+        exc = quorum.PeerLost(1, "level.4", "no heartbeat")
+        with pytest.raises(quorum.PeerLost) as ei:
+            d0.elastic_rejoin(exc)
+        assert ei.value is exc
+        casc = [
+            e for e in ledger.snapshot()
+            if e["kind"] == "cascade" and e.get("chain") == "elastic"
+        ]
+        assert casc and casc[0]["frm"] == "continue"
+        assert casc[0]["to"] == "abort"
+        assert not d0.stage_allowed("elastic", "continue")
+        # Clamped chain: even a budget-respecting abort now re-raises.
+        d0.mesh_epoch = 0
+        with pytest.raises(quorum.PeerLost):
+            d0.elastic_rejoin(
+                quorum.PeerLost(1, "level.5", "peer exited")
+            )
+    finally:
+        quorum.set_domain(None)
+        d0.close()
+    # A MeshEpochAbort original past the budget becomes a classified
+    # PeerLost (retry-exhaustion always surfaces under ONE type).
+    d = quorum.QuorumDomain(
+        quorum.FileTransport(qroot + ".x", 0, 2), 0, 2
+    )
+    d.mesh_epoch = 1
+    with pytest.raises(quorum.PeerLost, match="retry budget exhausted"):
+        d.elastic_rejoin(
+            quorum.MeshEpochAbort(2, [1], "level.3", "peer at epoch 2")
+        )
+    d.close()
+
+
+def test_elastic_writer_handoff_fences_preabort_artifacts(
+    tmp_path, qroot, monkeypatch
+):
+    """Satellite pin: after a rejoin that removes the writer, the new
+    writer's EAGER fence re-acquire turns every pre-abort artifact
+    stale — load_checkpoint AND load_phase1 reject them on the
+    post-abort domain, and the superseded straggler-writer's next
+    commit raises StaleFenceError instead of publishing."""
+    monkeypatch.setenv("FA_EPOCH_RETRY_MAX", "1")
+    prefix = str(tmp_path / "out") + "/"
+    levels = [(np.array([[0, 1]], np.int32), np.array([9], np.int64))]
+    d0, d1 = _domain_pair(qroot)
+    quorum.set_domain(d0)
+    fence = d0.checkpoint_fence()
+    ckpt.save_checkpoint(prefix, levels, dict(_meta(), fence=fence))
+    resume_io.save_phase1(
+        prefix, [(frozenset([0]), 3)], ["a"], {"a": 0}
+    )
+    assert resume_io.manifest_fence(prefix) == fence
+    d0.close("crash")  # the pre-abort coordinator dies
+    quorum.set_domain(d1)
+    d1.elastic_rejoin(quorum.PeerLost(0, "level.3", "peer exited"))
+    assert d1.members == [1] and d1.is_writer()
+    assert d1.transport.current_fence() == fence + 1  # eager re-acquire
+    with pytest.raises(quorum.StaleFenceError, match="stale checkpoint"):
+        ckpt.load_checkpoint(prefix)
+    with pytest.raises(quorum.StaleFenceError, match="stale checkpoint"):
+        resume_io.load_phase1(prefix)
+    # The superseded straggler-writer's commit path is fenced too.
+    with pytest.raises(quorum.StaleFenceError, match="checkpoint fence"):
+        d0.checkpoint_fence()
+    quorum.set_domain(None)
+    d1.close()
+
+
+def test_elastic_straggler_fenced_out(qroot, monkeypatch):
+    """A rank the survivors declared dead but that is still RUNNING:
+    its next rendezvous sees the advanced epoch, and its rejoin is
+    refused with a classified StaleFenceError — it must never mine on
+    (or commit into) a domain that has moved on without it."""
+    monkeypatch.setenv("FA_EPOCH_RETRY_MAX", "1")
+    d0, d1 = _domain_pair(qroot)
+    # Rank 0 judged rank 1 dead (a stall, not a death) and moved on.
+    d0.elastic_rejoin(quorum.PeerLost(1, "level.2", "no heartbeat"))
+    assert d0.members == [0] and d0.mesh_epoch == 1
+    with pytest.raises(
+        quorum.StaleFenceError, match="fenced this rank out"
+    ):
+        _survive(d1, "level.2")
+    d0.close()
+    d1.close()
+
+
+def test_flight_merge_mesh_epoch_timeline(tmp_path):
+    """Satellite pin: the merged post-mortem carries the mesh-epoch
+    timeline — quorum transitions (abort reason, dead ranks, survivor
+    set) and the level loop's reseed notes — pulled out of the
+    interleaved stream."""
+    from fastapriori_tpu.obs import flight as _flight
+    from tools.flight_merge import merge_flights
+
+    out = str(tmp_path) + "/"
+    r0 = _flight.FlightRecorder(cap=16)
+    r0.note("ledger", event="other")
+    r0.note(
+        "mesh_epoch", mesh_epoch=1, from_epoch=0, dead=[1],
+        members=[0], reason="PeerLost",
+    )
+    r0.note(
+        "mesh_epoch_reseed", mesh_epoch=1, members=[0],
+        resume_from_k=3, levels_kept=2, respec={"exchange": "flat"},
+    )
+    p0 = r0.dump(out + "rank0.", "test r0")
+    merged = merge_flights([p0])
+    tl = merged["mesh_epochs"]
+    assert [e["kind"] for e in tl] == ["mesh_epoch", "mesh_epoch_reseed"]
+    assert tl[0]["dead"] == [1] and tl[0]["members"] == [0]
+    assert tl[1]["resume_from_k"] == 3
+    assert tl[1]["respec"] == {"exchange": "flat"}
+    assert all(e["src"] == "rank0" for e in tl)
+
+
 # -- real 2/4-subprocess meshes (tools/chaos.py --procs harness) -------
 
 
@@ -2238,6 +2449,32 @@ def test_mp_four_process_divergence(mp_fixture):
     assert out.kind == "degraded", out.detail
 
 
+def test_mp_two_process_elastic_kill(mp_fixture):
+    """The elastic continuation pin (ISSUE 17) on a real 2-subprocess
+    mesh: kill one rank mid-level with FA_EPOCH_RETRY_MAX armed — the
+    survivor must abort the in-flight level, re-rendezvous alone under
+    mesh epoch 1, finish, and produce output byte-identical to the
+    clean run."""
+    from tools import chaos
+
+    root, inp, clean = mp_fixture
+    sch = _mp_schedule_of_kind("elastic_kill", 2)
+    out = chaos.run_mp_scenario(sch, inp, root, clean, timeout_s=120.0)
+    assert out.kind == "elastic", out.detail
+
+
+def test_mp_two_process_elastic_exhaust(mp_fixture):
+    """Retry-budget exhaustion stays CLASSIFIED: with the budget at
+    zero the first death must surface as PeerLost naming the rank on
+    every survivor — never a hang, never an unclassified crash."""
+    from tools import chaos
+
+    root, inp, clean = mp_fixture
+    sch = _mp_schedule_of_kind("elastic_exhaust", 2)
+    out = chaos.run_mp_scenario(sch, inp, root, clean, timeout_s=120.0)
+    assert out.kind == "classified", out.detail
+
+
 def test_mp_schedule_deterministic():
     from tools import chaos
 
@@ -2249,3 +2486,5 @@ def test_mp_schedule_deterministic():
         for spec in a["failpoints_by_rank"].values():
             site, _, rest = spec.partition(":")
             failpoints.parse_spec(f"{site}:{rest}")  # armable
+        if a["kind"].startswith("elastic"):
+            assert "epoch_retry_max" in a

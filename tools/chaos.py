@@ -381,7 +381,22 @@ def run_scenario(
 # fail classified naming a rank/site; never a hang, never a mixed-epoch
 # artifact.
 
-MP_KINDS = ("kill", "divergence", "flap", "hb_delay", "wstotals")
+MP_KINDS = (
+    "kill",
+    "divergence",
+    "flap",
+    "hb_delay",
+    "wstotals",
+    # Elastic-mesh continuation (ISSUE 17): the same deaths as "kill",
+    # but with FA_EPOCH_RETRY_MAX armed so survivors must ABSORB the
+    # loss — abort the in-flight level, re-rendezvous under a bumped
+    # mesh epoch, and finish byte-identical to the clean run — plus
+    # the exhaustion arm where deaths past the budget must still end
+    # classified on every rank.
+    "elastic_kill",
+    "elastic_rendezvous",
+    "elastic_exhaust",
+)
 
 # Divergence injections: a transient-exhaustion spec that walks ONE
 # consensus chain on the target rank only (oom*3 exhausts the default
@@ -415,6 +430,9 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
     # checkpointing off when its armed site needs the whole-loop path.
     checkpoint = True
     failpoints_by_rank: Dict[int, str] = {}
+    # Elastic retry budget (ISSUE 17): 0 keeps continuation disabled —
+    # the non-elastic kinds must behave exactly as before.
+    epoch_retry_max = 0
     if kind == "kill":
         # Sites: a committed level boundary, or the mine.start W_s
         # rendezvous itself (ISSUE 15) — a rank dying INSIDE the
@@ -442,7 +460,7 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
         failpoints_by_rank[target] = (
             f"quorum.heartbeat:delay@{rng.randint(100, 300)}"
         )
-    else:  # wstotals (ISSUE 15)
+    elif kind == "wstotals":  # ISSUE 15
         # A slow rank INSIDE the W_s rendezvous: the delay is well
         # under the quorum timeout, so peers must wait it out (the
         # heartbeat keeps beating through it) and the run completes
@@ -450,6 +468,45 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
         failpoints_by_rank[target] = (
             f"quorum.mine.wstotals:delay@{rng.randint(500, 1500)}"
         )
+    elif kind == "elastic_kill":
+        # Kill at a committed level boundary with continuation armed:
+        # survivors must abort the in-flight level, re-rendezvous
+        # under mesh epoch 1, and finish byte-identical to the clean
+        # run (membership never changes mined bytes on full replicas).
+        # level.3 commits per-level only under the LEVEL engine — the
+        # segment engine's cadence can fold past it, leaving the
+        # failpoint unreached (the armed rank would exit 0) — so the
+        # fused/auto draws pin the always-committed level.2 boundary.
+        site = rng.choice(("level.2", "level.3"))
+        if engine != "level":
+            site = "level.2"
+        failpoints_by_rank[target] = f"{site}:abort"
+        epoch_retry_max = rng.choice((1, 2))
+    elif kind == "elastic_rendezvous":
+        # Kill INSIDE the mine.start W_s rendezvous itself: the abort
+        # lands mid-exchange, so the epoch-namespaced quorum rounds
+        # must keep the survivors' post-abort re-exchange from ever
+        # pairing with the dead rank's pre-abort payload.
+        failpoints_by_rank[target] = "quorum.mine.wstotals:abort"
+        epoch_retry_max = 1
+    else:  # elastic_exhaust (ISSUE 17)
+        # Deaths past the budget must still END classified.  With
+        # >= 3 ranks a double kill either coalesces into one absorbed
+        # transition (survivors continue, byte-identical) or sequences
+        # past the budget (every survivor exits classified) — both
+        # legal, neither a hang.  With 2 ranks the zero budget makes
+        # exhaustion-at-first-death deterministic.  The LEVEL engine is
+        # pinned so both armed level boundaries commit (and fire)
+        # regardless of cadence — budget semantics are what this kind
+        # covers, and they are engine-independent.
+        engine = "level"
+        if procs >= 3:
+            failpoints_by_rank[target] = "level.2:abort"
+            failpoints_by_rank[(target + 1) % procs] = "level.3:abort"
+            epoch_retry_max = 1
+        else:
+            failpoints_by_rank[target] = "level.2:abort"
+            epoch_retry_max = 0
     return {
         "seed": seed,
         "kind": kind,
@@ -459,6 +516,7 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
         "checkpoint": checkpoint,
         "cadence": rng.choice((1, 2)),
         "failpoints_by_rank": failpoints_by_rank,
+        "epoch_retry_max": epoch_retry_max,
     }
 
 
@@ -491,6 +549,12 @@ def _spawn_rank(
         FA_HEARTBEAT_MS="100",
     )
     env.pop("FA_FAILPOINTS", None)
+    # Elastic retry budget (ISSUE 17): uniform across ranks — every
+    # survivor must reach the same exhaustion verdict independently.
+    env.pop("FA_EPOCH_RETRY_MAX", None)
+    retry_max = int(schedule.get("epoch_retry_max", 0))
+    if retry_max:
+        env["FA_EPOCH_RETRY_MAX"] = str(retry_max)
     spec = schedule["failpoints_by_rank"].get(rank)
     if spec is not None:
         env["FA_FAILPOINTS"] = spec  # schedule specs ARE the env format
@@ -553,6 +617,7 @@ _CLASSIFIED_MARKERS = (
     "quorum peer rank",  # PeerLost naming the rank
     "mesh divergence",  # MeshDivergence naming both sides
     "stale checkpoint",  # StaleFenceError (split-brain commit/resume)
+    "mesh epoch",  # elastic fence-out / superseded straggler (ISSUE 17)
     "corrupt checkpoint",  # structural rejection
     "fails manifest validation",  # torn-artifact contract
     "UNAVAILABLE",
@@ -665,6 +730,77 @@ def run_mp_scenario(
                 f"exchange silent) — {detail}",
             )
         return Outcome("degraded", detail)
+    if schedule["kind"] in ("elastic_kill", "elastic_rendezvous"):
+        # The elastic continuation invariant (ISSUE 17): the killed
+        # rank dies classified (checked above), every survivor ABSORBS
+        # the death — abort, re-rendezvous under the bumped mesh
+        # epoch, finish — and the survivor output is byte-identical to
+        # the CLEAN run, because membership never changes mined bytes
+        # on full replicas.
+        if rcs[target] == 0:
+            return Outcome("FAIL", f"killed rank exited 0 — {detail}")
+        alive = [r for r in range(procs) if r != target]
+        bad = [r for r in alive if rcs[r] != 0]
+        if bad:
+            return Outcome(
+                "FAIL",
+                f"survivor rank(s) {bad} failed under elastic "
+                f"continuation (FA_EPOCH_RETRY_MAX="
+                f"{schedule['epoch_retry_max']}) — {detail}; tail: "
+                f"{texts[bad[0]][-300:]!r}",
+            )
+        want = tuple(clean[n] for n in ("freqItemset", "recommends"))
+        for r in alive:
+            blob = tuple(
+                _read(outs[r] + n) for n in ("freqItemset", "recommends")
+            )
+            if blob != want:
+                return Outcome(
+                    "FAIL",
+                    f"survivor rank {r} output differs from the clean "
+                    f"run after elastic continuation — {detail}",
+                )
+        if not any("mesh_epoch" in texts[r] for r in alive):
+            return Outcome(
+                "FAIL",
+                f"no survivor recorded a mesh_epoch transition — "
+                f"elastic continuation never engaged — {detail}",
+            )
+        return Outcome("elastic", detail)
+    if schedule["kind"] == "elastic_exhaust":
+        # Deaths past the retry budget: survivors either ALL absorbed
+        # the coalesced loss (continue, byte-identical to clean) or
+        # ALL exited classified at exhaustion — never a hang (checked
+        # above), never an unclassified crash (checked above), never a
+        # split verdict (completed rejoins leave every survivor with
+        # the same mesh epoch, so the budget check is symmetric).
+        died = sorted(
+            r for r in schedule["failpoints_by_rank"] if rcs[r] != 0
+        )
+        if not died:
+            return Outcome("FAIL", f"no armed rank died — {detail}")
+        alive = [r for r in range(procs) if r not in died]
+        if all(rcs[r] == 0 for r in alive):
+            want = tuple(clean[n] for n in ("freqItemset", "recommends"))
+            for r in alive:
+                blob = tuple(
+                    _read(outs[r] + n)
+                    for n in ("freqItemset", "recommends")
+                )
+                if blob != want:
+                    return Outcome(
+                        "FAIL",
+                        f"survivor rank {r} output differs from the "
+                        f"clean run after absorbed deaths — {detail}",
+                    )
+            return Outcome("elastic", f"{detail} absorbed")
+        if any(rcs[r] == 0 for r in alive):
+            return Outcome(
+                "FAIL",
+                f"survivors SPLIT at exhaustion (some continued, some "
+                f"classified) — {detail}",
+            )
+        return Outcome("classified", f"{detail} exhausted")
     if schedule["kind"] == "kill":
         if rcs[target] == 0:
             return Outcome(
@@ -691,8 +827,8 @@ def run_mp_scenario(
 
 def main_chaos_mp(args, seeds: List[int]) -> int:
     """The multi-process soak driver (``--procs N``): seeded schedules
-    over kill/divergence/flap/heartbeat-delay scenarios, each a real
-    N-subprocess mesh over the file-transport quorum."""
+    over kill/divergence/flap/heartbeat-delay/elastic scenarios, each
+    a real N-subprocess mesh over the file-transport quorum."""
     t0 = time.monotonic()
     root = tempfile.mkdtemp(prefix="fa_chaos_mp_")
     failures: List[str] = []
@@ -780,7 +916,8 @@ def main_chaos(argv: Optional[List[str]] = None) -> int:
         "subprocess ranks per scenario, coordinated through the "
         "file-transport quorum (reliability/quorum.py); schedules "
         "cover kill-mid-level / divergence injection / coordinator "
-        "flap / heartbeat delay (default 1 = the single-process soak)",
+        "flap / heartbeat delay / elastic-mesh continuation and "
+        "exhaustion (default 1 = the single-process soak)",
     )
     args = ap.parse_args(argv)
 

@@ -106,14 +106,16 @@ EOF
 
 # Multi-process fault-domain soak (ISSUE 12): 2 real subprocess ranks
 # per scenario over the file-transport quorum — seeded kill-mid-level /
-# divergence-injection / coordinator-flap / heartbeat-delay schedules
-# under the EXTENDED invariant: all surviving ranks byte-identical, or
+# divergence-injection / coordinator-flap / heartbeat-delay /
+# elastic-mesh (ISSUE 17: continuation, rendezvous kill, retry-budget
+# exhaustion) schedules under the EXTENDED invariant: all surviving
+# ranks byte-identical, or
 # all failing ranks classified naming a rank/site; never a hang, never
 # a mixed-epoch checkpoint.  Hard gate derived like the single-process
 # soak's: soft budget (120 s) + one scenario hang bound (90 s) + slack.
 chaos_mp_t0=$(python -c 'import time; print(time.time())')
 env JAX_PLATFORMS=cpu python tools/chaos.py --procs 2 \
-    --seeds 0,3,7 --scenarios 3 --budget-s 120
+    --seeds 0,2,5 --scenarios 3 --budget-s 120
 python - "$chaos_mp_t0" <<'PYEOF'
 import sys, time
 elapsed = time.time() - float(sys.argv[1])

@@ -81,7 +81,55 @@ def merge_flights(paths: List[str]) -> Dict:
             e.get("seq", 0),
         )
     )
-    return {"version": 1, "sources": sources, "events": events}
+    return {
+        "version": 1,
+        "sources": sources,
+        # Elastic-mesh transitions (ISSUE 17): the mesh-epoch timeline
+        # — who aborted, why, which survivors re-rendezvoused, and
+        # what each epoch's level loop re-seeded from — pulled out of
+        # the interleaved stream so a continued run's post-mortem
+        # shows which epoch produced which levels at a glance.
+        "mesh_epochs": _mesh_epoch_timeline(events),
+        "events": events,
+    }
+
+
+def _mesh_epoch_timeline(events: List[Dict]) -> List[Dict]:
+    """The chronological mesh-epoch transitions in ``events``: the
+    quorum layer's ``mesh_epoch`` notes (abort reason + dead ranks +
+    survivor set, one per rank per transition), the ledger's copy of
+    the same (kind ``ledger``, event ``mesh_epoch``), and the level
+    loop's ``mesh_epoch_reseed`` notes (resume level + respec
+    summary).  ``events`` must already be sorted."""
+    out: List[Dict] = []
+    for e in events:
+        kind = e.get("kind")
+        if kind == "ledger" and e.get("event") == "mesh_epoch":
+            kind = "mesh_epoch"
+        elif kind not in ("mesh_epoch", "mesh_epoch_reseed"):
+            continue
+        keep = {
+            k: v
+            for k, v in e.items()
+            if k
+            in (
+                "src",
+                "t_abs_s",
+                "seq",
+                "mesh_epoch",
+                "epoch",
+                "from_epoch",
+                "dead",
+                "members",
+                "reason",
+                "resume_from_k",
+                "levels_kept",
+                "respec",
+            )
+        }
+        keep["kind"] = kind
+        out.append(keep)
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
