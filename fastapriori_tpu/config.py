@@ -160,6 +160,14 @@ class MinerConfig:
     # bucketed, clamped to the dispatch's candidate budget).
     # FA_VERTICAL_CHUNK overrides, strictly parsed.
     vertical_cand_chunk: int = 1 << 12
+    # Vertical engine: lanes (uint32 words of the tid axis) per streamed
+    # slab of the level-k AND+popcount — bounds the [P_cap, lane_tile]
+    # prefix intermediate so big-T corpora stream the lane axis instead
+    # of materializing [P_cap, NL] whole (the old ~50K-lane ceiling).
+    # Also the lane-tile ceiling of the Pallas vertical kernel
+    # (ops/pallas_vertical.py), so both tiers stream identically.
+    # pow2-bucketed; FA_VERTICAL_LANE_TILE overrides, strictly parsed.
+    vertical_lane_tile: int = 1 << 13
     # Level engine (transfer-minimal kernels, ops/count.py
     # local_level_gather / local_pair_gather): transaction-axis scan chunk
     # (bounds the [tc, P] membership intermediate in HBM), padded prefix

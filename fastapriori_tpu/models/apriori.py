@@ -817,6 +817,21 @@ class FastApriori:
             chunk //= 2
         return max(chunk, 1)
 
+    def _vertical_lane_tile(self) -> int:
+        """Lane-slab width for the vertical level kernels: the
+        config/env knob pow2-bucketed (G011 — one compiled program per
+        bucket, not per observed lane count).  Bounds the
+        [P_cap, lane_tile] prefix intermediate on the XLA path and
+        ceilings the Pallas kernel's lane tile, so big-T corpora stream
+        the lane axis on BOTH tiers instead of hitting the old ~50K
+        [P_cap, NL] ceiling."""
+        from fastapriori_tpu.utils.env import env_int
+
+        tile = env_int(
+            "FA_VERTICAL_LANE_TILE", 0, minimum=0
+        ) or self.config.vertical_lane_tile
+        return _next_pow2(max(int(tile), 128))
+
     def _mine_vertical(
         self, data: CompressedData
     ) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -3985,6 +4000,7 @@ class FastApriori:
                     self._vertical_chunk(c_cap),
                     sparse_cap=sp_cap,
                     sparse_thr=sparse_thr,
+                    lane_tile=self._vertical_lane_tile(),
                 )
             else:
                 bits, counts_out = ctx.level_gather_batch(
@@ -4039,6 +4055,16 @@ class FastApriori:
                 ) + vertical_level_word_ops(
                     nb_pad, p_cap, k_pad, c_cap, len(scales), t_pad // 32
                 )
+                # HBM-traffic model for the Pallas tier: the [P_cap, NL]
+                # prefix-AND write+read the VMEM-resident kernel never
+                # pays (bench --engine-compare's member_bytes_saved).
+                from fastapriori_tpu.ops.vertical import (
+                    vertical_member_bytes,
+                )
+
+                stats["member_bytes_saved"] = stats.get(
+                    "member_bytes_saved", 0
+                ) + vertical_member_bytes(nb_pad, p_cap, t_pad // 32)
             else:
                 stats["macs"] += (
                     nb_pad * (1 + d_eff) * t_pad * p_cap * f_pad
@@ -4121,22 +4147,44 @@ class FastApriori:
             # flat exchange is the cheaper exact fallback), so the
             # dense recount below and every later sparse dispatch run
             # flat.
-            if count_reduce != "sparse" or not watchdog.transient(exc):
+            # A vertical level that ran the Pallas kernel tier walks
+            # vertical_kernel pallas→xla FIRST (the kernel is the
+            # newest moving part; the XLA vertical path is exact by
+            # construction) — sticky local disable + quorum proposal,
+            # so every later dispatch (and the recount below) compiles
+            # the XLA body.
+            pallas_walk = (
+                vertical
+                and ctx.vertical_pallas_active()
+                and watchdog.transient(exc)
+            )
+            if not pallas_walk and (
+                count_reduce != "sparse" or not watchdog.transient(exc)
+            ):
                 raise
-            if ctx.exchange_spec is not None:
+            if pallas_walk:
                 watchdog.downgrade(
-                    "exchange", "hier", "flat",
+                    "vertical_kernel", "pallas", "xla",
+                    reason="transient_exhausted", site="vlevel",
+                    k=s + 1,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+                ctx.disable_vertical_pallas()
+            if count_reduce == "sparse":
+                if ctx.exchange_spec is not None:
+                    watchdog.downgrade(
+                        "exchange", "hier", "flat",
+                        reason="transient_exhausted",
+                        site="vlevel" if vertical else "level", k=s + 1,
+                    )
+                    ctx.set_exchange_spec(None)
+                watchdog.downgrade(
+                    "count_reduce", "sparse", "dense",
                     reason="transient_exhausted",
                     site="vlevel" if vertical else "level", k=s + 1,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
                 )
-                ctx.set_exchange_spec(None)
             recount = "transient_exhausted"
-            watchdog.downgrade(
-                "count_reduce", "sparse", "dense",
-                reason="transient_exhausted",
-                site="vlevel" if vertical else "level", k=s + 1,
-                error=f"{type(exc).__name__}: {exc}"[:200],
-            )
         if max_nu:
             recount = "union_overflow"
             ledger.record(
@@ -4164,6 +4212,11 @@ class FastApriori:
             stats_d["macs"] += stats["macs"]
             if stats.get("vops"):
                 stats_d["vops"] = stats_d.get("vops", 0) + stats["vops"]
+            if stats.get("member_bytes_saved"):
+                stats_d["member_bytes_saved"] = (
+                    stats_d.get("member_bytes_saved", 0)
+                    + stats["member_bytes_saved"]
+                )
             stats_d["psum_bytes"] += stats["psum_bytes"]
             stats_d["gather_bytes"] = (
                 stats_d.get("gather_bytes", 0) + stats["gather_bytes"]

@@ -66,11 +66,11 @@ class ServeScanHandle:
 
     __slots__ = (
         "scan", "f", "f_pad", "resident", "shards", "table_bytes",
-        "decode", "row_multiple",
+        "decode", "row_multiple", "pallas",
     )
 
     def __init__(self, scan, *, f, f_pad, resident, shards, table_bytes,
-                 row_multiple, decode=None):
+                 row_multiple, decode=None, pallas=False):
         self.scan = scan
         self.f = f
         self.f_pad = f_pad
@@ -79,6 +79,11 @@ class ServeScanHandle:
         self.table_bytes = table_bytes
         self.decode = decode
         self.row_multiple = row_multiple
+        # True when the mounted local scan body is the Pallas first-
+        # match kernel (serve/state.py's serve_scan cascade attribution:
+        # a transient-exhausted scan walks pallas→xla and re-warms
+        # before abandoning the device table).
+        self.pallas = pallas
 
 
 class AssociationRules:
@@ -620,6 +625,7 @@ class AssociationRules:
             return ServeScanHandle(
                 scan, f=f, f_pad=f_pad, resident=True, shards=shards,
                 table_bytes=tbytes, row_multiple=1,
+                pallas=ctx.serve_pallas_active(),
             )
 
         ant_dev, size_dev, cons_dev, chunk, r_pad, consequent, rbytes = (

@@ -12,8 +12,12 @@ be importable from the lint-censused reliability layer and from tools):
 - :mod:`fastapriori_tpu.obs.flight` — a bounded ring of the last N
   span/ledger/watchdog events, dumped to a manifest-committed artifact
   on classified errors, ``AbandonedThreadCap``, and chaos-soak hangs.
+- :mod:`fastapriori_tpu.obs.device_trace` — ISSUE 18's device-internal
+  view: XLA profiler capture + stdlib Perfetto parsing that attributes
+  per-kernel device time (jax is lazy-imported inside the capture
+  helper, so the stdlib-only-at-import promise above still holds).
 """
 
-from fastapriori_tpu.obs import flight, metrics, trace  # noqa: F401
+from fastapriori_tpu.obs import device_trace, flight, metrics, trace  # noqa: F401
 from fastapriori_tpu.obs.metrics import MetricsRegistry  # noqa: F401
 from fastapriori_tpu.obs.trace import TRACER, span  # noqa: F401

@@ -1,0 +1,161 @@
+"""Device-internal kernel trace attribution (ISSUE 18 tentpole, part c).
+
+The span tracer (:mod:`fastapriori_tpu.obs.trace`) sees *host-side*
+wall time: a ``vlevel`` span covers dispatch + device execution + sync
+without saying which kernel burned the time.  This module adds the
+device-internal view: a bracketing helper around
+``jax.profiler.start_trace`` / ``stop_trace`` that captures an XLA
+device trace (Perfetto-loadable), plus a stdlib-only parser that
+aggregates per-kernel device durations out of the captured artifact —
+the evidence the bench ``--engine-compare`` pallas row cites.
+
+Contracts:
+
+- ``obs`` stays stdlib-only at *import* (the package docstring's
+  promise): jax is imported lazily inside :func:`capture`, never at
+  module scope.  :func:`kernel_summary` is pure stdlib (gzip + json).
+- Capture NEVER crashes the run it observes.  Any profiler failure
+  (unsupported platform, double-start, missing deps) is swallowed into
+  a once-keyed ``device_trace_unavailable`` ledger event and the run
+  proceeds untraced — same posture as the Pallas tier itself.
+- The strict ``FA_DEVICE_TRACE`` knob (``1`` enables capture where the
+  caller passes ``explicit=False``) follows the FA_NO_PALLAS contract:
+  a typo'd value raises InputError rather than silently disabling.
+
+Interpreter-mode caveat (mirrors ops/pallas_vertical.py): on CPU the
+profiler traces the *interpreted or XLA:CPU* program, so per-kernel
+rows attribute host execution, not TPU VMEM behaviour.  Rows are still
+useful as structural evidence (which kernels ran, how many launches);
+wall-time claims belong to real-chip captures only.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+_env_memo: Optional[bool] = None
+
+
+def enabled_by_env() -> bool:
+    """The strict ``FA_DEVICE_TRACE`` knob, parsed once per process
+    (tests use :func:`reload_from_env`)."""
+    global _env_memo
+    if _env_memo is None:
+        from fastapriori_tpu.utils.env import env_flag
+
+        _env_memo = env_flag("FA_DEVICE_TRACE", False)
+    return _env_memo
+
+
+def reload_from_env() -> None:
+    global _env_memo
+    _env_memo = None
+
+
+@contextmanager
+def capture(logdir: str, explicit: bool = False) -> Iterator[Dict[str, Any]]:
+    """Bracket a region with an XLA device-trace capture into ``logdir``.
+
+    Yields a mutable info dict; after the block exits it carries
+    ``active`` (whether a capture actually ran) and, when active,
+    ``trace_dir``.  When neither ``explicit`` nor ``FA_DEVICE_TRACE``
+    asks for capture, the body runs untraced at zero cost.  Profiler
+    errors are ledger-recorded (once per process per phase), never
+    raised: the traced computation must not die for its observer.
+    """
+    info: Dict[str, Any] = {"active": False, "trace_dir": logdir}
+    if not (explicit or enabled_by_env()):
+        yield info
+        return
+    from fastapriori_tpu.reliability import ledger
+
+    started = False
+    try:
+        import jax
+
+        # create_perfetto_trace asks XLA to emit the merged
+        # perfetto_trace.json.gz beside the per-host protobuf dumps —
+        # the one artifact kernel_summary() can read with stdlib gzip.
+        jax.profiler.start_trace(logdir, create_perfetto_trace=True)
+        started = True
+    except Exception as exc:  # lint: waive G006 -- observer must not kill the traced run; failure is ledgered once-keyed and the run proceeds untraced
+        ledger.record(
+            "device_trace_unavailable",
+            once_key="start",
+            phase="start",
+            error=f"{type(exc).__name__}: {exc}",
+        )
+    try:
+        yield info
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                info["active"] = True
+            except Exception as exc:  # lint: waive G006 -- stop_trace failure on an already-running mine: ledgered, never raised
+                ledger.record(
+                    "device_trace_unavailable",
+                    once_key="stop",
+                    phase="stop",
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+
+
+def find_perfetto_trace(trace_dir: str) -> Optional[str]:
+    """Locate the ``perfetto_trace.json.gz`` a capture left under
+    ``trace_dir`` (the profiler nests it in a timestamped run dir)."""
+    pattern = os.path.join(
+        trace_dir, "**", "perfetto_trace.json.gz"
+    )
+    hits = sorted(glob.glob(pattern, recursive=True))
+    return hits[-1] if hits else None
+
+
+def kernel_summary(trace_dir: str, top: int = 0) -> Dict[str, Any]:
+    """Aggregate per-kernel device durations from a captured trace.
+
+    Pure stdlib: gunzips the Perfetto/Chrome-trace JSON and sums the
+    complete-event (``ph == "X"``) durations by event name.  Returns
+    ``{"trace": path-or-None, "kernels": [{name, calls, total_us}...]}``
+    sorted by total time descending (``top`` truncates when > 0).
+    Missing or malformed traces yield an empty kernel list, never an
+    exception — the summary rides in bench artifacts where a parse
+    error must not sink the whole record.
+    """
+    path = find_perfetto_trace(trace_dir)
+    out: Dict[str, Any] = {"trace": path, "kernels": []}
+    if path is None:
+        return out
+    try:
+        with gzip.open(path, "rt", encoding="utf-8", errors="replace") as fh:
+            doc = json.load(fh)
+    except Exception:  # lint: waive G006 -- malformed trace artifact summarizes as empty; a parse error must not sink the bench record
+        return out
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else []
+    agg: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        name = ev.get("name")
+        dur = ev.get("dur")
+        if not isinstance(name, str) or not isinstance(dur, (int, float)):
+            continue
+        slot = agg.setdefault(name, {"calls": 0, "total_us": 0.0})
+        slot["calls"] += 1
+        slot["total_us"] += float(dur)
+    rows = [
+        {"name": k, "calls": int(v["calls"]), "total_us": v["total_us"]}
+        for k, v in agg.items()
+    ]
+    rows.sort(key=lambda r: (-r["total_us"], r["name"]))
+    if top > 0:
+        rows = rows[:top]
+    out["kernels"] = rows
+    return out

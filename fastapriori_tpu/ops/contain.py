@@ -18,6 +18,8 @@ are replicated, the analog of the reference's rule broadcast (:76-78).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -751,6 +753,7 @@ def local_strided_match_scan(
     chunk: int,
     n_shards: int,
     axis_name: str,
+    pallas: Optional[tuple] = None,  # (rule_tile, interpret)
 ):
     """Sharded first-match over the rank-strided resident table: each
     shard scans its R/S rule slice (local row i = global rank
@@ -778,6 +781,27 @@ def local_strided_match_scan(
     s = lax.axis_index(axis_name).astype(jnp.int32)
     real = basket_len > 0
 
+    if pallas is not None:
+        # Pallas tier: one fused launch sweeping EVERY rule tile with a
+        # running min (ops/pallas_vertical.py) — no early exit, but
+        # exact: later tiles hold only larger global ranks, so the min
+        # over all rules equals the early-exit result.  chunks_run
+        # reports the full sweep.  The pmin/pmax merge below is shared
+        # with the XLA while_loop path verbatim.
+        from fastapriori_tpu.ops.pallas_vertical import (
+            strided_best_rank_pallas,
+        )
+
+        rule_tile, interp = pallas
+        best = strided_best_rank_pallas(
+            baskets, basket_len, ant_cols, ant_size, consequent,
+            s, n_shards, rule_tile, NO_MATCH, interp,
+        )
+        c = jnp.int32(n_chunks)
+        return _strided_merge(
+            best, consequent, s, c, r_loc, n_shards, axis_name
+        )
+
     def cond(state):
         c, best = state
         return (c < n_chunks) & jnp.any(real & (best == jnp.int32(NO_MATCH)))
@@ -803,10 +827,15 @@ def local_strided_match_scan(
         to="varying",
     )
     c, best = lax.while_loop(cond, body, (jnp.int32(0), best0))
+    return _strided_merge(best, consequent, s, c, r_loc, n_shards, axis_name)
+
+
+def _strided_merge(best, consequent, s, c, r_loc, n_shards, axis_name):
+    """Cross-shard merge of the per-shard strided minima (shared by the
+    while_loop and Pallas local bodies).  The winner's consequent: only
+    the owning shard's local best equals the global minimum (ranks are
+    unique mod S), so a masked pmax is an exact one-collective select."""
     best_g = lax.pmin(best, axis_name)
-    # The winner's consequent: only the owning shard's local best equals
-    # the global minimum (ranks are unique mod S), so a masked pmax is
-    # an exact one-collective select.
     local_row = jnp.clip(
         (best - s) // jnp.int32(n_shards), 0, jnp.int32(r_loc - 1)
     )
@@ -816,11 +845,15 @@ def local_strided_match_scan(
     return best_g, cons_g, lax.pmax(c, axis_name)
 
 
-def make_strided_first_match_scan(mesh: Mesh, chunk: int, n_shards: int):
+def make_strided_first_match_scan(
+    mesh: Mesh, chunk: int, n_shards: int, pallas: Optional[tuple] = None
+):
     """shard_map-wrapped, jitted strided-table scan: the rule table
     sharded over the mesh axis (R/S rows per shard — the table's HBM
     footprint no longer replicates), basket micro-batches replicated,
-    outputs replicated after the pmin/pmax exchange."""
+    outputs replicated after the pmin/pmax exchange.  ``pallas``
+    (rule_tile, interpret) mounts the fused first-match kernel as the
+    local body (serve_scan chain stage "pallas")."""
     import functools
 
     return jax.jit(
@@ -830,6 +863,7 @@ def make_strided_first_match_scan(mesh: Mesh, chunk: int, n_shards: int):
                 chunk=chunk,
                 n_shards=n_shards,
                 axis_name=AXIS,
+                pallas=pallas,
             ),
             mesh=mesh,
             in_specs=(

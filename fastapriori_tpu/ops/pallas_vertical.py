@@ -1,0 +1,331 @@
+"""Pallas TPU kernels for the vertical engine's AND+popcount hot loop
+and the serving scan's strided first-match (ROADMAP direction 3; the
+vertical twin of ops/pallas_level.py's HBM-traffic-to-zero move).
+
+**Vertical popcount kernel.**  The XLA formulation
+(ops/vertical.py vertical_level_local) materializes the prefix AND
+``pref = AND_k arena[prefix_cols[:, k]]`` — a ``[P_cap, NL]`` uint32
+intermediate — in HBM and gathers it back per candidate chunk; at big T
+that write+read traffic bounds the level phase exactly like the bitmap
+engine's ``member`` tile did before pallas_level.  This kernel keeps the
+prefix intersections VMEM-resident across the candidate chunk: grid
+``(lane tiles, candidate tiles)`` with the candidate axis innermost, so
+one lane tile of the arena and the weight bit-planes is loaded per outer
+step, the prefix rows are ANDed in-register ONCE per lane tile (at the
+first candidate step, into a VMEM scratch that stays resident for the
+whole candidate sweep), and each candidate tile accumulates
+``Σ_b 2^b·popcount(inter & plane_b)`` into a VMEM-resident [1, C] output
+— the ``[P_cap, NL]`` intermediate is never written to HBM
+(``member_bytes_saved = 2·4·P_cap·NL`` per launch, the bench
+engine-compare HBM-traffic model).
+
+**Strided first-match kernel.**  The serving scan's per-shard while_loop
+(ops/contain.py local_strided_match_scan) early-exits on chunks; this
+kernel instead sweeps EVERY rule tile with a running min — bit-exact
+because later chunks hold only strictly larger global ranks, so the min
+over all rules equals the early-exit result (the trade: no data-
+dependent exit, but one fused launch with the rank-argmin in-register).
+The cross-shard pmin/pmax merge stays in contain.py, shared with the
+XLA path verbatim.
+
+**Correctness vs performance split.**  Interpreter mode
+(``interpret=True``) is the correctness contract — tests pin both
+kernels bit-exact against the XLA vertical path and the bitmap
+differential oracle on CPU.  Real-chip compilation (gather + popcount
+lowering on the VPU) is only exercised on TPU runs; the runtime gate in
+parallel/mesh.py walks to the exact-by-construction XLA path on any
+failure (CHAINS ``vertical_kernel``/``serve_scan``), so a Mosaic
+lowering gap degrades throughput, never correctness.
+
+Tile planning: the VMEM budget driver is the resident set
+``(arena rows + planes + prefix scratch + candidate tile) · lane_tile``
+words; :func:`plan_vertical_tiles` walks the pow2 lane-tile ladder until
+it fits (None = fall back to XLA).  Tile shape constants are pow2
+multiples of 128 lanes (G005).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fastapriori_tpu import compat
+from fastapriori_tpu.ops.pallas_level import pick_tile
+
+# Candidate/lane tile ladders (pow2, lane-dim multiples of 128 for the
+# VPU lane width).  VMEM_BUDGET leaves headroom under the ~16 MB/core
+# v5e budget for Mosaic's own double-buffering.
+CAND_TILE_CANDIDATES = (512, 256, 128)
+LANE_TILE_CANDIDATES = (4096, 2048, 1024, 512, 256, 128)
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def plan_vertical_tiles(
+    p_cap: int, f_pad: int, n_planes: int, c_cap: int, lane_cap: int
+):
+    """Pick ``(cand_tile, lane_tile)`` for :func:`vertical_counts_pallas`
+    fitting the VMEM budget, or None when no shape fits (the caller then
+    stays on the XLA vertical path).  ``lane_cap`` is the strict
+    FA_VERTICAL_LANE_TILE-bucketed ceiling — the same knob that bounds
+    the XLA path's lane streaming, so both tiers stream identically."""
+    ct = pick_tile(c_cap, CAND_TILE_CANDIDATES)
+    if not ct:
+        return None
+    for lt in LANE_TILE_CANDIDATES:
+        if lt > max(int(lane_cap), 128):
+            continue
+        resident = (f_pad + 1 + n_planes + p_cap + ct) * lt
+        if resident * 4 + 8 * c_cap <= VMEM_BUDGET_BYTES:
+            return (ct, lt)
+    return None
+
+
+def _vertical_kernel(
+    pc_ref,  # SMEM [P, K] int32 prefix cols (identity-remapped)
+    a_ref,  # VMEM [f_pad+1, LT] uint32 arena lane tile
+    w_ref,  # VMEM [B, LT] uint32 weight bit-plane lane tile
+    cand_ref,  # VMEM [1, C] int32 flat candidate indices (whole)
+    out_ref,  # VMEM [1, C] int32 accumulated counts (whole, resident)
+    pref_ref,  # VMEM scratch [P, LT] uint32 prefix AND for this lane tile
+    *,
+    scales,
+    f_pad,
+    cand_tile,
+):
+    t = pl.program_id(0)
+    c = pl.program_id(1)
+
+    @pl.when((t == 0) & (c == 0))
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # One prefix AND per lane tile, at the first candidate step; the
+    # scratch stays VMEM-resident across the whole candidate sweep —
+    # the [P_cap, NL] HBM intermediate of the XLA path never exists.
+    @pl.when(c == 0)
+    def _prefix():
+        a = a_ref[...]
+        cols = pc_ref[...]
+        acc = jnp.take(a, cols[:, 0], axis=0)
+        for i in range(1, cols.shape[1]):
+            acc = acc & jnp.take(a, cols[:, i], axis=0)
+        pref_ref[...] = acc
+
+    ix = cand_ref[0, pl.ds(c * cand_tile, cand_tile)]
+    row = ix // jnp.int32(f_pad)
+    y = ix % jnp.int32(f_pad)
+    a = a_ref[...]
+    inter = jnp.take(pref_ref[...], row, axis=0) & jnp.take(a, y, axis=0)
+    total = None
+    for b, scale in enumerate(scales):
+        pc = lax.population_count(inter & w_ref[b, :][None, :])
+        part = jnp.sum(pc.astype(jnp.int32), axis=1)
+        part = part if scale == 1 else part * jnp.int32(scale)
+        total = part if total is None else total + part
+    cur = out_ref[0, pl.ds(c * cand_tile, cand_tile)]
+    out_ref[0, pl.ds(c * cand_tile, cand_tile)] = cur + total
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scales", "cand_tile", "lane_tile", "interpret"),
+)
+def vertical_counts_pallas(
+    arena: jnp.ndarray,  # [f_pad+1, NL] uint32 (row f_pad = AND identity)
+    w_planes: jnp.ndarray,  # [B, NL] uint32
+    prefix_cols: jnp.ndarray,  # [P, K] int (padding -> zero column)
+    cand_idx: jnp.ndarray,  # [C] int32 flat row·f_pad + y
+    scales: tuple,  # static, len B
+    cand_tile: int,
+    lane_tile: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """LOCAL per-candidate weighted intersection counts (int32[C]) —
+    the drop-in body of ops/vertical.py ``vertical_level_local``; the
+    sparse/psum cross-shard reduction stays outside, shared with the
+    XLA path.  Lanes are zero-padded to the tile multiple (zero bits
+    contribute 0 to every popcount — the vertical_pair_local padding
+    argument), so any NL streams exactly."""
+    f_pad = arena.shape[0] - 1
+    nl = arena.shape[1]
+    c = cand_idx.shape[0]
+    assert c % cand_tile == 0, (c, cand_tile)
+    p = prefix_cols.shape[0]
+    # Padded prefix positions carry the horizontal engine's zero column
+    # f_pad-1; for the AND they must be the identity row f_pad (the
+    # _prefix_and remap, hoisted to the host side of the kernel).
+    cols = prefix_cols.astype(jnp.int32)
+    cols = jnp.where(cols == f_pad - 1, jnp.int32(f_pad), cols)
+    nlt = -(-nl // lane_tile) * lane_tile
+    if nlt > nl:
+        arena = jnp.pad(arena, ((0, 0), (0, nlt - nl)))
+        w_planes = jnp.pad(w_planes, ((0, 0), (0, nlt - nl)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nlt // lane_tile, c // cand_tile),
+        in_specs=[
+            pl.BlockSpec(
+                (f_pad + 1, lane_tile),
+                lambda t, cc, _pc: (0, t),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (w_planes.shape[0], lane_tile),
+                lambda t, cc, _pc: (0, t),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                # lint: waive G005 -- single-row candidate-index vector: sublane pads 1->8 (7 wasted rows of one int32 vector, bounded); the lane dim is cand-tile-aligned
+                (1, c), lambda t, cc, _pc: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            # lint: waive G005 -- single-row count accumulator, same sublane 1->8 padding trade as the candidate vector above
+            (1, c), lambda t, cc, _pc: (0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((p, lane_tile), jnp.uint32)],
+    )
+    vma = frozenset()
+    for arr in (arena, w_planes, prefix_cols, cand_idx):
+        vma = vma | getattr(compat.typeof(arr), "vma", frozenset())
+    out = pl.pallas_call(
+        functools.partial(
+            _vertical_kernel,
+            scales=tuple(scales),
+            f_pad=f_pad,
+            cand_tile=cand_tile,
+        ),
+        out_shape=compat.shape_dtype_struct((1, c), jnp.int32, vma=vma),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(cols, arena, w_planes, cand_idx.reshape(1, c).astype(jnp.int32))
+    return out.reshape(-1)
+
+
+def _match_kernel(
+    s_ref,  # SMEM (1,) int32 — this shard's mesh index
+    b_ref,  # VMEM [MB, F] int8 basket one-hot (whole, resident)
+    blen_ref,  # VMEM [MB, 1] int32 basket sizes
+    ant_ref,  # VMEM [RT, K] int32 antecedent cols (padding -> zero col)
+    size_ref,  # VMEM [RT, 1] int32 antecedent sizes
+    cons_ref,  # VMEM [RT, 1] int32 consequent cols
+    out_ref,  # VMEM [MB, 1] int32 running best global rank
+    *,
+    n_shards,
+    rule_tile,
+    no_match,
+):
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[:] = jnp.full_like(out_ref, no_match)
+
+    b = b_ref[...]
+    ant = ant_ref[...]
+    overlap = None
+    for k in range(ant.shape[1]):
+        part = jnp.take(b, ant[:, k], axis=1).astype(jnp.int32)
+        overlap = part if overlap is None else overlap + part
+    size = size_ref[...].reshape(-1)  # [RT]
+    cons = cons_ref[...].reshape(-1)
+    blen = blen_ref[...]  # [MB, 1]
+    cons_in = jnp.take(b, cons, axis=1).astype(jnp.int32)  # [MB, RT]
+    eligible = (
+        (overlap == size[None, :])
+        & (size[None, :] <= blen)
+        & (cons_in == 0)
+    )
+    local = r * rule_tile + lax.broadcasted_iota(
+        jnp.int32, (1, rule_tile), 1
+    )
+    ranks = local * jnp.int32(n_shards) + s_ref[0]
+    best = jnp.min(
+        jnp.where(eligible, ranks, jnp.int32(no_match)), axis=1
+    )
+    out_ref[...] = jnp.minimum(out_ref[...], best[:, None])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_shards", "rule_tile", "no_match", "interpret"),
+)
+def strided_best_rank_pallas(
+    baskets: jnp.ndarray,  # [MB, F] int8 one-hot (dup counts ok)
+    basket_len: jnp.ndarray,  # [MB] int32
+    ant_cols: jnp.ndarray,  # [R_loc, K] int32 (padding -> zero col)
+    ant_size: jnp.ndarray,  # [R_loc] int32 (padding > F)
+    consequent: jnp.ndarray,  # [R_loc] int32 (padding -> zero col)
+    shard: jnp.ndarray,  # () int32 this shard's mesh index
+    n_shards: int,
+    rule_tile: int,
+    no_match: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Per-shard best GLOBAL rank (int32[MB]; ``no_match`` where no
+    local rule fires) — the Pallas body of ops/contain.py
+    ``local_strided_match_scan``: every rule tile swept with a running
+    min (no early exit; exact because later tiles hold only larger
+    ranks).  The pmin/pmax shard merge stays in contain.py."""
+    mb, f = baskets.shape
+    r_loc, _k = ant_cols.shape
+    assert r_loc % rule_tile == 0, (r_loc, rule_tile)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r_loc // rule_tile,),
+        in_specs=[
+            pl.BlockSpec(
+                (mb, f), lambda r, _s: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                # lint: waive G005 -- per-basket length column: one int32 per basket row, kept column-shaped so it broadcasts against the [mb, rule_tile] eligibility mask; lane pads 1->128 on mb<=batch-cap rows, bounded
+                (mb, 1), lambda r, _s: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (rule_tile, ant_cols.shape[1]),
+                lambda r, _s: (r, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                # lint: waive G005 -- per-rule antecedent-size column (one int32 per rule of the tile); lane pads 1->128, bounded
+                (rule_tile, 1), lambda r, _s: (r, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                # lint: waive G005 -- per-rule consequent column, same 1->128 lane padding trade as the size column above
+                (rule_tile, 1), lambda r, _s: (r, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            # lint: waive G005 -- per-basket best-rank column accumulator; lane pads 1->128, bounded (mb rows only)
+            (mb, 1), lambda r, _s: (0, 0), memory_space=pltpu.VMEM
+        ),
+    )
+    vma = frozenset()
+    for arr in (baskets, basket_len, ant_cols, ant_size, consequent, shard):
+        vma = vma | getattr(compat.typeof(arr), "vma", frozenset())
+    out = pl.pallas_call(
+        functools.partial(
+            _match_kernel,
+            n_shards=n_shards,
+            rule_tile=rule_tile,
+            no_match=no_match,
+        ),
+        out_shape=compat.shape_dtype_struct((mb, 1), jnp.int32, vma=vma),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        shard.reshape(1).astype(jnp.int32),
+        baskets,
+        basket_len.reshape(mb, 1).astype(jnp.int32),
+        ant_cols.astype(jnp.int32),
+        ant_size.reshape(r_loc, 1).astype(jnp.int32),
+        consequent.reshape(r_loc, 1).astype(jnp.int32),
+    )
+    return out.reshape(-1)

@@ -325,6 +325,50 @@ def _chunked_candidate_counts(
     return parts.reshape(-1)
 
 
+def _lane_tiled_counts(
+    arena: jnp.ndarray,  # [f_pad+1, NL] uint32
+    w_planes: jnp.ndarray,
+    scales: Sequence[int],
+    prefix_cols: jnp.ndarray,  # [P, K]
+    cand_idx: jnp.ndarray,  # [C] int32
+    cand_chunk: int,
+    lane_tile: int,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Lane-streamed form of the level-k count: ``lax.scan`` over
+    ``lane_tile``-wide slabs of the arena, each step the plain
+    prefix-AND + candidate-intersection body on a ``[P, lane_tile]``
+    slice — the prefix intermediate is bounded by the tile regardless
+    of T (the ~50K-lane ceiling the unstreamed ``[P, NL]`` form hits).
+    Bit-exact vs the single-slab form: int32 addition is associative
+    and the zero-lane padding of the last slab contributes 0 to every
+    popcount (the vertical_pair_local padding argument — padded member
+    and plane lanes are all zero)."""
+    nl = arena.shape[1]
+    nt = -(-nl // lane_tile)
+    pad = nt * lane_tile - nl
+    a = jnp.pad(arena, ((0, 0), (0, pad))) if pad else arena
+    w = jnp.pad(w_planes, ((0, 0), (0, pad))) if pad else w_planes
+    a_t = a.reshape(a.shape[0], nt, lane_tile).transpose(1, 0, 2)
+    w_t = w.reshape(w.shape[0], nt, lane_tile).transpose(1, 0, 2)
+
+    def step(acc, xs):
+        at, wt = xs  # [f_pad+1, LT] uint32, [B, LT] uint32
+        pref = _prefix_and(at, prefix_cols)
+        part = _chunked_candidate_counts(
+            pref, at, wt, scales, cand_idx, cand_chunk
+        )
+        return acc + part, None
+
+    acc0 = jnp.zeros((cand_idx.shape[0],), jnp.int32)
+    if axis_name is not None:
+        from fastapriori_tpu import compat
+
+        acc0 = compat.pcast(acc0, (axis_name,), to="varying")
+    local, _ = lax.scan(step, acc0, (a_t, w_t))
+    return local
+
+
 def _unpack_lanes(lanes: jnp.ndarray) -> jnp.ndarray:
     """uint32 [..., L] -> int8 [..., L*32] (LSB-first per lane — the
     arena/plane bit order)."""
@@ -460,6 +504,8 @@ def vertical_level_local(
     sparse_thr: Optional[jnp.ndarray] = None,
     sparse_cap: Optional[int] = None,
     groups: Optional[tuple] = None,
+    lane_tile: int = 0,
+    pallas: Optional[tuple] = None,  # (cand_tile, lane_tile, interpret)
 ):
     """C8, vertical form: one AND-reduction per prefix row, then per-
     candidate lane intersections with the extension items — only the
@@ -469,12 +515,33 @@ def vertical_level_local(
     candidate slots all resolve to zero counts; the prefix width K is
     static per bucket but needs NO traced ``k1`` (the AND identity
     handles padding, and popcounts are exact at any depth — no int8
-    membership bound, no ``wide_member`` widen).  Returns int32[C]
-    reduced counts, or ``(counts, n_union)`` under ``sparse_cap``."""
-    pref = _prefix_and(arena, prefix_cols)
-    local = _chunked_candidate_counts(
-        pref, arena, w_planes, scales, cand_idx, cand_chunk
-    )
+    membership bound, no ``wide_member`` widen).  ``lane_tile`` streams
+    the lane axis in tiles (0 = single slab, exact either way);
+    ``pallas`` swaps the local body for the VMEM-resident kernel
+    (ops/pallas_vertical.py) — the cross-shard reduction below is
+    shared by all three forms, so the tiers cannot drift.  Returns
+    int32[C] reduced counts, or ``(counts, n_union)`` under
+    ``sparse_cap``."""
+    if pallas is not None:
+        from fastapriori_tpu.ops.pallas_vertical import (
+            vertical_counts_pallas,
+        )
+
+        ct, lt, interp = pallas
+        local = vertical_counts_pallas(
+            arena, w_planes, prefix_cols, cand_idx,
+            tuple(scales), ct, lt, interp,
+        )
+    elif lane_tile and arena.shape[1] > lane_tile:
+        local = _lane_tiled_counts(
+            arena, w_planes, scales, prefix_cols, cand_idx,
+            cand_chunk, lane_tile, axis_name=axis_name,
+        )
+    else:
+        pref = _prefix_and(arena, prefix_cols)
+        local = _chunked_candidate_counts(
+            pref, arena, w_planes, scales, cand_idx, cand_chunk
+        )
     if sparse_cap is not None and axis_name is not None:
         return local_sparse_psum(
             local, sparse_thr, sparse_cap, axis_name, groups=groups
@@ -495,6 +562,8 @@ def vertical_level_batch(
     sparse_thr: Optional[jnp.ndarray] = None,
     sparse_cap: Optional[int] = None,
     groups: Optional[tuple] = None,
+    lane_tile: int = 0,
+    pallas: Optional[tuple] = None,
 ):
     """A whole level's prefix blocks in ONE launch (the vertical twin of
     ``local_level_gather_batch``): ``lax.scan`` over the stacked blocks,
@@ -508,6 +577,7 @@ def vertical_level_batch(
             arena, w_planes, scales, pc, ci, cand_chunk,
             axis_name=axis_name, sparse_thr=sparse_thr,
             sparse_cap=sparse_cap, groups=groups,
+            lane_tile=lane_tile, pallas=pallas,
         )
         return carry, out
 
@@ -525,3 +595,12 @@ def vertical_level_word_ops(
     ``(1 + B)`` AND+popcount passes over the [C, NL] candidate
     intersections."""
     return nb * (k_pad * p_cap + (1 + n_planes) * c_cap) * nl
+
+
+def vertical_member_bytes(nb: int, p_cap: int, nl: int) -> int:
+    """HBM bytes of the ``[P_cap, NL]`` prefix-AND intermediate per
+    level launch (one uint32 write + one read) — the traffic the Pallas
+    tier (ops/pallas_vertical.py) keeps in VMEM.  Rides the metrics
+    ``member_bytes_saved`` field: bench --engine-compare's per-level
+    HBM-traffic model for the pallas flavor."""
+    return nb * 2 * 4 * p_cap * nl

@@ -226,6 +226,15 @@ class DeviceContext:
         self._fused_fails: set = set()
         self._auto_level: set = set()
         self._pair_caps: Dict[Tuple, int] = {}
+        # Pallas kernel-tier state (ops/pallas_vertical.py): sticky
+        # local disables set by the vertical_kernel/serve_scan cascade
+        # walks (forward-only — a failed kernel never re-arms within a
+        # context), plus the last vertical plan so the engine layer can
+        # attribute a transient to the Pallas tier.
+        self._vertical_pallas_off = False
+        self._serve_pallas_off = False
+        self._vertical_pallas_last = False
+        self._serve_pallas_last = False
 
     def set_exchange_spec(
         self, spec: Optional[Tuple[int, int]]
@@ -977,6 +986,88 @@ class DeviceContext:
             info,
         )
 
+    def _vertical_pallas_plan(
+        self, arena, prefix_stack, cand_stack, n_planes: int,
+        lane_tile: int,
+    ) -> Optional[tuple]:
+        """``(cand_tile, lane_tile, interpret)`` for the vertical Pallas
+        kernel (ops/pallas_vertical.py), or None for the XLA path.  The
+        strict FA_NO_PALLAS parse runs on EVERY backend — a typo'd value
+        must fail loudly even on runs where Pallas was never a candidate
+        (the level_gather_batch contract).  The quorum floor
+        (``vertical_kernel`` chain) keeps the tier choice mesh-wide
+        consistent; ``_vertical_pallas_off`` is the sticky local disable
+        the cascade walk sets.  Tests monkeypatch this method to return
+        interpreter-mode plans on CPU."""
+        no_pallas_env = pallas_disabled_by_env()
+        if self.platform != "tpu":
+            return None
+        if no_pallas_env:
+            # The run IS degraded (the XLA path round-trips the [P, NL]
+            # prefix intermediate through HBM) — say so once.
+            ledger.record(
+                "pallas_disabled",
+                once_key="env",
+                reason="FA_NO_PALLAS",
+                value=os.environ.get("FA_NO_PALLAS", ""),
+            )
+            return None
+        if self._vertical_pallas_off:
+            return None
+        from fastapriori_tpu.reliability import quorum
+
+        if not quorum.stage_allowed("vertical_kernel", "pallas"):
+            return None
+        from fastapriori_tpu.ops.pallas_vertical import (
+            plan_vertical_tiles,
+        )
+
+        plan = plan_vertical_tiles(
+            prefix_stack.shape[1], arena.shape[0] - 1, n_planes,
+            cand_stack.shape[1], lane_tile,
+        )
+        return plan + (False,) if plan else None
+
+    def vertical_pallas_active(self) -> bool:
+        """True when the LAST vertical level dispatch ran the Pallas
+        tier (the engine layer's cascade attribution signal)."""
+        return self._vertical_pallas_last
+
+    def disable_vertical_pallas(self) -> None:
+        """Sticky local disable (vertical_kernel pallas→xla walk)."""
+        self._vertical_pallas_off = True
+
+    def _serve_pallas_plan(self, chunk: int) -> Optional[tuple]:
+        """``(rule_tile, interpret)`` for the serving first-match kernel,
+        or None for the XLA while_loop scan.  Same strict-parse /
+        warn-once contract as :meth:`_vertical_pallas_plan`; the rule
+        tile is the scan chunk (a pow2 multiple of 128 by construction,
+        models/recommender.py _ensure_scan_table).  The serve_scan chain
+        is host-local (reliability/quorum.py: serving never crosses the
+        mesh), so no quorum consult here."""
+        no_pallas_env = pallas_disabled_by_env()
+        if self.platform != "tpu":
+            return None
+        if no_pallas_env:
+            ledger.record(
+                "pallas_disabled",
+                once_key="env",
+                reason="FA_NO_PALLAS",
+                value=os.environ.get("FA_NO_PALLAS", ""),
+            )
+            return None
+        if self._serve_pallas_off:
+            return None
+        return (chunk, False)
+
+    def serve_pallas_active(self) -> bool:
+        """True when the LAST strided-scan mount ran the Pallas tier."""
+        return self._serve_pallas_last
+
+    def disable_serve_pallas(self) -> None:
+        """Sticky local disable (serve_scan pallas→xla walk)."""
+        self._serve_pallas_off = True
+
     def vertical_level_gather_batch(
         self,
         arena,
@@ -988,6 +1079,7 @@ class DeviceContext:
         cand_chunk: int,
         sparse_cap: Optional[int] = None,
         sparse_thr=None,
+        lane_tile: int = 0,
     ) -> tuple:
         """Vertical twin of :meth:`level_gather_batch`: a whole level's
         prefix blocks in one launch over the tid-lane arena
@@ -998,13 +1090,20 @@ class DeviceContext:
         AND identity handles prefix padding and popcounts are exact at
         any depth."""
         xspec = self.exchange_spec if sparse_cap is not None else None
+        pallas_plan = self._vertical_pallas_plan(
+            arena, prefix_stack, cand_stack, w_planes.shape[0], lane_tile
+        )
+        self._vertical_pallas_last = pallas_plan is not None
         key = (
             "vlevel_batch", tuple(scales), cand_chunk, sparse_cap, xspec,
+            lane_tile, pallas_plan,
         )
         if key not in self._fns:
             mesh = self.mesh
             scl = tuple(scales)
             s_cap = sparse_cap
+            l_tile = lane_tile
+            p_plan = pallas_plan
 
             def _local(arena, w_planes, ps, mc, cs, *rest):
                 from fastapriori_tpu.ops.vertical import (
@@ -1022,6 +1121,8 @@ class DeviceContext:
                     ),
                     sparse_cap=s_cap,
                     groups=xspec,
+                    lane_tile=l_tile,
+                    pallas=p_plan,
                 )
                 if s_cap is not None:
                     counts, nus = out
@@ -1550,15 +1651,21 @@ class DeviceContext:
     def strided_first_match_scan(self, chunk: int):
         """The sharded-resident-table priority scan (ops/contain.py
         local_strided_match_scan); returns ``(best_rank, consequent,
-        chunks_run)`` per micro-batch."""
-        key = ("strided_match_scan", chunk)
+        chunks_run)`` per micro-batch.  On TPU the local body mounts the
+        fused Pallas first-match kernel (serve_scan chain stage
+        "pallas", :meth:`_serve_pallas_plan`); the plan is part of the
+        compile key so the pallas→xla walk re-mounts the while_loop
+        body on the next warm."""
+        plan = self._serve_pallas_plan(chunk)
+        self._serve_pallas_last = plan is not None
+        key = ("strided_match_scan", chunk, plan)
         if key not in self._fns:
             from fastapriori_tpu.ops.contain import (
                 make_strided_first_match_scan,
             )
 
             self._fns[key] = make_strided_first_match_scan(
-                self.mesh, chunk, self.txn_shards
+                self.mesh, chunk, self.txn_shards, pallas=plan
             )
         return self._fns[key]
 

@@ -102,6 +102,14 @@ CONSENSUS_CHAINS: Tuple[str, ...] = (
     # exhaustion clamps every survivor's next rejoin decision
     # identically.  Appended at the END (pinned wire order).
     "elastic",
+    # ISSUE 18: the vertical Pallas kernel tier compiles a DIFFERENT
+    # local program per shard than the XLA vertical path, so one rank's
+    # pallas→xla walk must clamp every peer's next dispatch to the same
+    # tier (the plan consult in parallel/mesh.py _vertical_pallas_plan
+    # reads this floor).  serve_scan stays host-local — the serving
+    # merge collectives are shape-identical across tiers.  Appended at
+    # the END (pinned wire order).
+    "vertical_kernel",
 )
 
 FENCE_NAME = "FENCE"

@@ -98,6 +98,19 @@ CHAINS: Dict[str, Tuple[str, ...]] = {
     # consensus-registered so one rank's exhaustion clamps every
     # survivor's next rejoin decision identically.
     "elastic": ("continue", "abort"),
+    # Vertical level kernel tier (ISSUE 18): VMEM-resident Pallas
+    # popcount kernel (ops/pallas_vertical.py) -> the exact-by-
+    # construction XLA vertical path.  Consensus-registered: the tier
+    # choice changes every shard's compiled local program, so one
+    # rank's kernel failure must clamp the whole domain to XLA before
+    # its next dispatch.
+    "vertical_kernel": ("pallas", "xla"),
+    # Serving first-match scan body: fused Pallas rank-argmin kernel ->
+    # XLA while_loop scan.  Host-local like rule_scan (the pmin/pmax
+    # merge is shape-identical either way, so the tier never shapes a
+    # collective); walked BEFORE rule_scan device→host — the XLA scan
+    # retry is cheaper than abandoning the device table.
+    "serve_scan": ("pallas", "xla"),
 }
 
 

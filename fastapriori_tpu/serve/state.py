@@ -434,21 +434,49 @@ class ServingState:
             except Exception as exc:
                 if not watchdog.transient(exc):
                     raise
-                watchdog.downgrade(
-                    "rule_scan", "device", "host",
-                    reason="serve_transient_exhausted",
-                    once_key=f"serve:{self.signature}",
-                    error=f"{type(exc).__name__}: {exc}"[:200],
-                )
-                self._engine = "host"
-                # The cascade is forward-only — the device engine never
-                # serves this state again, so free its table instead of
-                # pinning HBM for the degraded server's lifetime.
-                self._drop_device_table()
-                # lint: host-data -- host-scan result list, no device fetch
-                cons = np.asarray(
-                    self._rec._host_first_match(baskets), dtype=np.int64
-                )
+                cons = None
+                h = self._handle
+                if h is not None and h.pallas:
+                    # A Pallas-kernel scan walks serve_scan pallas→xla
+                    # FIRST: drop only the compiled handle (the device
+                    # table stays mounted), sticky-disable the kernel
+                    # tier, re-warm on the XLA while_loop body and retry
+                    # this batch once — abandoning the device table for
+                    # the host oracle is the LAST resort, not the first.
+                    watchdog.downgrade(
+                        "serve_scan", "pallas", "xla",
+                        reason="serve_transient_exhausted",
+                        once_key=f"serve_kernel:{self.signature}",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                    )
+                    self._rec.context.disable_serve_pallas()
+                    self._handle = None
+                    try:
+                        self.warm()
+                        cons = self._scan_blocks(baskets)
+                    except Exception as exc2:
+                        if not watchdog.transient(exc2):
+                            raise
+                        exc = exc2
+                        cons = None
+                if cons is None:
+                    watchdog.downgrade(
+                        "rule_scan", "device", "host",
+                        reason="serve_transient_exhausted",
+                        once_key=f"serve:{self.signature}",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                    )
+                    self._engine = "host"
+                    # The cascade is forward-only — the device engine
+                    # never serves this state again, so free its table
+                    # instead of pinning HBM for the degraded server's
+                    # lifetime.
+                    self._drop_device_table()
+                    # lint: host-data -- host-scan result list, no device fetch
+                    cons = np.asarray(
+                        self._rec._host_first_match(baskets),
+                        dtype=np.int64,
+                    )
         else:
             with trace.span("serve.host_scan", baskets=len(baskets)):
                 # lint: host-data -- host-scan result list, no device fetch

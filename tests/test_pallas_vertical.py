@@ -1,0 +1,416 @@
+"""Pallas kernel tier for the vertical popcount and serving scan hot
+loops (ISSUE 18): the VMEM-resident vertical kernel and the strided
+first-match serving kernel must be BIT-EXACT in interpreter mode
+against the XLA vertical path and the bitmap differential oracle on
+every corpus shape x mesh size, their engine-selection/env tables
+mirror the FA_NO_PALLAS contract, transient exhaustion walks the
+``vertical_kernel`` cascade to the exact-by-construction XLA path,
+and kill-and-resume stays byte-identical with the tier engaged.
+
+CPU-only: the kernels are TPU-gated in production
+(DeviceContext._vertical_pallas_plan / _serve_pallas_plan return None
+off-TPU), so every test here monkeypatches the plan hook to force an
+``interpret=True`` plan — the documented test seam.  Interpreter mode
+proves VALUES, not VMEM behaviour; real-chip shape coverage is the
+standing TPU-time item (ROADMAP)."""
+
+import inspect
+
+import numpy as np
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.io import checkpoint as ckpt
+from fastapriori_tpu.io import writer
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.parallel.mesh import DeviceContext
+from fastapriori_tpu.reliability import failpoints, ledger, retry
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+
+
+def _mine(lines, min_support, **cfg):
+    miner = FastApriori(
+        config=MinerConfig(min_support=min_support, **cfg)
+    )
+    got, _, _ = miner.run(lines)
+    return dict(got), miner
+
+
+def _patch_vertical_pallas(monkeypatch, lane_tile=128):
+    """Force the interpreter-mode vertical Pallas plan on CPU: the
+    candidate tile walks a small ladder (test candidate counts are
+    modest), the lane tile is the caller's, interpret=True."""
+    from fastapriori_tpu.ops.pallas_level import pick_tile
+
+    def plan(self, arena, ps, cs, n_planes, lt):
+        if self._vertical_pallas_off:  # honor the sticky cascade switch
+            return None
+        return (
+            pick_tile(cs.shape[1], (256, 128, 64, 32, 16, 8, 4, 2, 1)),
+            lane_tile,
+            True,
+        )
+
+    monkeypatch.setattr(DeviceContext, "_vertical_pallas_plan", plan)
+
+
+# ---------------------------------------------------------------------------
+# corpora (mirrors tests/test_vertical.py: the shapes the XLA engine is
+# pinned on are exactly the shapes the Pallas tier must match)
+
+
+def _t10i4_shaped(n_txns=1500):
+    from fastapriori_tpu.utils.datagen import generate_transactions
+
+    return [
+        l.split()
+        for l in generate_transactions(
+            n_txns=n_txns, n_items=90, avg_txn_len=9, n_patterns=30,
+            avg_pattern_len=4, corruption=0.35, seed=11,
+        )
+    ]
+
+
+def _webdocs_shaped():
+    return tokenized(
+        random_dataset(23, n_txns=400, n_items=40, max_len=12)
+    )
+
+
+def _deep_lattice():
+    return tokenized(
+        random_dataset(13, n_txns=200, n_items=14, max_len=9)
+    )
+
+
+def _no_survivor_level():
+    return tokenized(random_dataset(3, n_txns=120))
+
+
+# ---------------------------------------------------------------------------
+# differential matrix: Pallas (interpreter) vs XLA vertical vs bitmap
+
+
+@pytest.mark.parametrize(
+    "lines_fn, min_support",
+    [
+        (_t10i4_shaped, 0.03),
+        (_webdocs_shaped, 0.04),
+        (_deep_lattice, 0.05),
+        (_no_survivor_level, 0.4),
+    ],
+    ids=["t10i4", "webdocs", "deep-lattice", "no-survivor"],
+)
+@pytest.mark.parametrize("n_devices", [1, 2, 4])
+def test_pallas_vertical_bitexact_differential(
+    monkeypatch, lines_fn, min_support, n_devices
+):
+    lines = lines_fn()
+    exp, _ = _mine(
+        lines, min_support, engine="level", num_devices=n_devices,
+        mine_engine="bitmap",
+    )
+    xla, _ = _mine(
+        lines, min_support, engine="level", num_devices=n_devices,
+        mine_engine="vertical",
+    )
+    assert xla == exp
+    _patch_vertical_pallas(monkeypatch)
+    pal, miner = _mine(
+        lines, min_support, engine="level", num_devices=n_devices,
+        mine_engine="vertical",
+    )
+    assert pal == exp
+    # The Pallas tier really ran (every vlevel dispatch got the forced
+    # plan) — except on corpora whose lattice dies before k=3, where
+    # there is no vlevel dispatch to run it (the no-survivor case).
+    if any(len(items) >= 3 for items in pal):
+        assert miner.context.vertical_pallas_active()
+
+
+@pytest.mark.parametrize("lane_tile", [16, 32, 64])
+def test_pallas_lane_tile_boundaries_bitexact(monkeypatch, lane_tile):
+    """Lane counts that divide, under-fill, and straddle the tile (the
+    kernel zero-pads the lane axis to a tile multiple; zero words add
+    zero popcount, so every boundary case must stay exact)."""
+    lines = _t10i4_shaped()
+    exp, _ = _mine(lines, 0.03, engine="level", mine_engine="bitmap")
+    _patch_vertical_pallas(monkeypatch, lane_tile=lane_tile)
+    pal, _ = _mine(lines, 0.03, engine="level", mine_engine="vertical")
+    assert pal == exp
+
+
+# ---------------------------------------------------------------------------
+# lane chunking (the ceiling lift) — XLA path and knob contracts
+
+
+def test_xla_lane_tiling_past_ceiling_bitexact(monkeypatch):
+    """FA_VERTICAL_LANE_TILE=128 on a ~6000-txn corpus forces multiple
+    lane slabs through the scan (arena words >> tile) — the same slab
+    code path a real >50K-lane (>1.6M-txn) arena takes with the default
+    8192 tile, shrunk to tier-1 scale.  Must stay bit-exact vs bitmap,
+    which never tiles lanes."""
+    monkeypatch.setenv("FA_VERTICAL_LANE_TILE", "128")
+    lines = _t10i4_shaped(n_txns=6000)
+    exp, _ = _mine(lines, 0.03, engine="level", mine_engine="bitmap")
+    got, miner = _mine(
+        lines, 0.03, engine="level", mine_engine="vertical"
+    )
+    assert got == exp
+    assert miner._vertical_lane_tile() == 128
+    # The corpus really overflows the tile (else this test is vacuous).
+    assert -(-len(lines) // 32) > 128
+
+
+def test_pallas_with_lane_tiling_bitexact(monkeypatch):
+    """Both tiers tile lanes under the same knob: the Pallas plan's
+    lane tile and the XLA slab scan must agree with the oracle."""
+    monkeypatch.setenv("FA_VERTICAL_LANE_TILE", "128")
+    lines = _t10i4_shaped(n_txns=6000)
+    exp, _ = _mine(lines, 0.03, engine="level", mine_engine="bitmap")
+    _patch_vertical_pallas(monkeypatch, lane_tile=128)
+    pal, _ = _mine(lines, 0.03, engine="level", mine_engine="vertical")
+    assert pal == exp
+
+
+def test_lane_tile_pow2_bucketed_with_floor():
+    """G011: one compiled program per pow2 bucket, 128 floor."""
+    def tile(**kw):
+        return FastApriori(
+            config=MinerConfig(min_support=0.1, **kw)
+        )._vertical_lane_tile()
+
+    assert tile() == 1 << 13  # the documented default
+    assert tile(vertical_lane_tile=100) == 128  # floor
+    assert tile(vertical_lane_tile=5000) == 8192  # next pow2
+    assert tile(vertical_lane_tile=4096) == 4096  # exact pow2 kept
+
+
+def test_env_lane_tile_overrides_and_strict(monkeypatch):
+    monkeypatch.setenv("FA_VERTICAL_LANE_TILE", "300")
+    m = FastApriori(config=MinerConfig(min_support=0.1))
+    assert m._vertical_lane_tile() == 512
+    monkeypatch.setenv("FA_VERTICAL_LANE_TILE", "4k")  # the typo class
+    with pytest.raises(InputError, match="FA_VERTICAL_LANE_TILE"):
+        FastApriori(
+            config=MinerConfig(min_support=0.1)
+        )._vertical_lane_tile()
+
+
+# ---------------------------------------------------------------------------
+# engine-selection / env-strictness table (the FA_NO_PALLAS contract)
+
+
+def test_no_pallas_typo_fails_loudly_on_cpu():
+    """The strict parse runs at vertical dispatch on EVERY backend — a
+    typo'd kill switch must not silently no-op just because this host
+    has no TPU."""
+    import os
+
+    os.environ["FA_NO_PALLAS"] = "maybe"
+    try:
+        with pytest.raises(InputError, match="FA_NO_PALLAS"):
+            _mine(
+                _deep_lattice(), 0.05, engine="level",
+                mine_engine="vertical",
+            )
+    finally:
+        del os.environ["FA_NO_PALLAS"]
+
+
+def _gate_args():
+    """Dummy shape-carrying args for the plan hook (it reads shapes
+    only): 256 candidates divide the production cand-tile ladder."""
+    arena = np.zeros((257, 64), np.uint32)
+    prefix = np.zeros((256, 4), np.int32)
+    cand = np.zeros((1, 256), np.int32)
+    return arena, prefix, cand
+
+
+def test_vertical_gate_table(monkeypatch, capsys):
+    ctx = DeviceContext(num_devices=1)
+    arena, prefix, cand = _gate_args()
+    # CPU: never a candidate, regardless of the env value.
+    assert ctx._vertical_pallas_plan(arena, prefix, cand, 1, 8192) is None
+    monkeypatch.setenv("FA_NO_PALLAS", "1")
+    assert ctx._vertical_pallas_plan(arena, prefix, cand, 1, 8192) is None
+    monkeypatch.delenv("FA_NO_PALLAS")
+    # TPU platform (faked): the gate engages, with a non-interpret plan.
+    monkeypatch.setattr(
+        DeviceContext, "platform", property(lambda self: "tpu")
+    )
+    plan = ctx._vertical_pallas_plan(arena, prefix, cand, 1, 8192)
+    assert plan is not None and plan[-1] is False
+    ct, lt, _interp = plan
+    assert 256 % ct == 0 and lt >= 128
+    # Falsy spellings keep it on.
+    for v in ("0", "false", "no", ""):
+        monkeypatch.setenv("FA_NO_PALLAS", v)
+        assert (
+            ctx._vertical_pallas_plan(arena, prefix, cand, 1, 8192)
+            is not None
+        )
+    # Kill switch: off, every dispatch ledger-recorded, but the
+    # operator warning printed ONCE (the once_key="env" contract).
+    monkeypatch.setenv("FA_NO_PALLAS", "on")
+    assert ctx._vertical_pallas_plan(arena, prefix, cand, 1, 8192) is None
+    assert ctx._vertical_pallas_plan(arena, prefix, cand, 1, 8192) is None
+    evs = [
+        e for e in ledger.snapshot() if e["kind"] == "pallas_disabled"
+    ]
+    assert len(evs) == 2
+    assert all(e["reason"] == "FA_NO_PALLAS" for e in evs)
+    assert capsys.readouterr().err.count("pallas_disabled") == 1
+    monkeypatch.delenv("FA_NO_PALLAS")
+    # Sticky local disable (the cascade walk's switch): forward-only.
+    ctx.disable_vertical_pallas()
+    assert ctx._vertical_pallas_plan(arena, prefix, cand, 1, 8192) is None
+
+
+def test_serve_gate_table(monkeypatch):
+    ctx = DeviceContext(num_devices=1)
+    assert ctx._serve_pallas_plan(512) is None  # CPU
+    monkeypatch.setattr(
+        DeviceContext, "platform", property(lambda self: "tpu")
+    )
+    assert ctx._serve_pallas_plan(512) == (512, False)
+    monkeypatch.setenv("FA_NO_PALLAS", "yes")
+    assert ctx._serve_pallas_plan(512) is None
+    monkeypatch.delenv("FA_NO_PALLAS")
+    ctx.disable_serve_pallas()
+    assert ctx._serve_pallas_plan(512) is None
+
+
+def test_cpu_runs_never_select_pallas():
+    """The acceptance line: TPU-only execution runtime-gates cleanly on
+    CPU — a plain vertical mine neither crashes nor engages the tier."""
+    lines = _deep_lattice()
+    got, miner = _mine(
+        lines, 0.05, engine="level", mine_engine="vertical"
+    )
+    exp, _ = _mine(lines, 0.05, engine="level", mine_engine="bitmap")
+    assert got == exp
+    assert miner.context.vertical_pallas_active() is False
+
+
+# ---------------------------------------------------------------------------
+# cascade: transient exhaustion walks vertical_kernel pallas -> xla
+
+
+def test_vertical_kernel_cascade_walks_to_xla(monkeypatch):
+    """Unlimited oom at the vlevel fetch with the Pallas tier active:
+    the FIRST exhaustion walks vertical_kernel pallas->xla (sticky,
+    ledger-recorded); the still-armed fetch then exhausts the XLA
+    retier too and the engine chain finishes on bitmap — the full
+    forward-only walk, bit-exact at the end."""
+    monkeypatch.setenv("FA_RETRY_MAX", "2")
+    monkeypatch.setenv("FA_RETRY_BACKOFF_MS", "0")
+    retry.reload_policy_from_env()
+    try:
+        lines = _deep_lattice()
+        exp, _ = _mine(lines, 0.05, engine="level", mine_engine="bitmap")
+        ledger.reset()
+        _patch_vertical_pallas(monkeypatch)
+        failpoints.arm("fetch.vlevel_bits", "oom")  # every attempt
+        got, miner = _mine(
+            lines, 0.05, engine="level", mine_engine="vertical"
+        )
+        failpoints.disarm_all()
+        assert got == exp
+        casc = [
+            e for e in ledger.snapshot() if e["kind"] == "cascade"
+        ]
+        assert any(
+            e["chain"] == "vertical_kernel"
+            and e["frm"] == "pallas"
+            and e["to"] == "xla"
+            and e["reason"] == "transient_exhausted"
+            for e in casc
+        )
+        assert any(
+            e["chain"] == "mine_engine"
+            and e["frm"] == "vertical"
+            and e["to"] == "bitmap"
+            for e in casc
+        )
+        # Sticky: the tier stays off for the rest of the process run.
+        assert miner.context.vertical_pallas_active() is False
+    finally:
+        retry.reload_policy_from_env()
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume stays byte-identical with the tier engaged
+
+
+def test_pallas_kill_resume_round_trip_bit_exact(tmp_path, monkeypatch):
+    lines = _deep_lattice()
+    prefix = str(tmp_path) + "/"
+    cfg = dict(min_support=0.05, engine="level")
+    clean_sets, _, clean_items = FastApriori(
+        config=MinerConfig(**cfg)
+    ).run(lines)
+    _patch_vertical_pallas(monkeypatch)
+    failpoints.arm("level.3", "abort")  # die right after level 3 commits
+    miner = FastApriori(
+        config=MinerConfig(
+            mine_engine="vertical", checkpoint_prefix=prefix, **cfg
+        )
+    )
+    with pytest.raises(failpoints.InjectedAbort):
+        miner.run(lines)
+    failpoints.disarm_all()
+    levels, meta = ckpt.load_checkpoint(prefix)
+    assert levels[-1][0].shape[1] == 3
+    resumed = FastApriori(
+        config=MinerConfig(mine_engine="vertical", **cfg)
+    )
+    resumed.set_resume_levels(levels, meta, label=prefix)
+    got_sets, _, got_items = resumed.run(lines)
+    assert got_items == clean_items
+    out_a, out_b = str(tmp_path / "a_"), str(tmp_path / "b_")
+    writer.save_freq_itemsets(out_a, clean_sets, clean_items)
+    writer.save_freq_itemsets(out_b, got_sets, got_items)
+    assert (
+        open(out_a + "freqItemset", "rb").read()
+        == open(out_b + "freqItemset", "rb").read()
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite: ops/pallas_level.py design-note constants stay pinned
+
+
+def test_pallas_level_design_constants_pinned():
+    from fastapriori_tpu.ops import pallas_level, pallas_vertical
+
+    # The measured production tiles from the module's design note: t
+    # generous ([tt, F] int8 B tiles are cheap), m bounded so the VMEM
+    # [mt, tt] membership tile stays <= 16 MB.
+    assert pallas_level.T_TILE == 4096
+    assert pallas_level.M_TILE == 1024
+    # pick_tile: largest ladder entry evenly dividing n, 0 = no fit.
+    assert pallas_level.pick_tile(8192) == 4096
+    assert pallas_level.pick_tile(768) == 256
+    assert pallas_level.pick_tile(4224) == 0
+    assert pallas_level.pick_tile(512, (512, 128)) == 512
+    # The vertical kernel shares the SAME helper (one tile-planning
+    # idiom across kernel modules, not a drifting copy).
+    assert pallas_vertical.pick_tile is pallas_level.pick_tile
+
+
+def test_level_gate_wb_single_digit_contract_pinned():
+    """The level kernel takes ONE unscaled w (.) B digit; the mesh gate
+    must keep routing multi-digit weight profiles to the XLA path."""
+    src = inspect.getsource(DeviceContext.level_gather_batch)
+    assert 'tuple(scales) == (1,)' in src

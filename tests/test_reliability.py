@@ -1265,6 +1265,8 @@ def test_cascade_chain_ordering_pinned():
         "rule_scan": ("device", "host"),
         "serving": ("accept", "shed"),
         "elastic": ("continue", "abort"),
+        "vertical_kernel": ("pallas", "xla"),
+        "serve_scan": ("pallas", "xla"),
     }
     assert watchdog.chain_rank("engine", "fused") == 0
     assert watchdog.chain_rank("engine", "level") == 2
@@ -1835,11 +1837,12 @@ def test_quorum_wire_order_pinned():
     reordering is a wire-format change (pin it)."""
     assert quorum.CONSENSUS_CHAINS == (
         "engine", "mine_engine", "count_reduce", "rule_engine",
-        # ISSUE 15 / ISSUE 17: appended at the END — pre-existing
-        # position indices are unchanged (appending extends the
-        # vector, it does not reorder it).
+        # ISSUE 15 / ISSUE 17 / ISSUE 18: appended at the END —
+        # pre-existing position indices are unchanged (appending
+        # extends the vector, it does not reorder it).
         "exchange",
         "elastic",
+        "vertical_kernel",
     )
     for chain in quorum.CONSENSUS_CHAINS:
         assert chain in watchdog.CHAINS

@@ -442,6 +442,74 @@ def test_serve_transient_exhaustion_walks_rule_scan_cascade(monkeypatch):
         retry.reload_policy_from_env()
 
 
+def _patch_serve_pallas(monkeypatch):
+    """Force the interpreter-mode serving Pallas plan on CPU (the
+    production hook is TPU-gated); honors the sticky cascade switch
+    like the real _serve_pallas_plan."""
+    from fastapriori_tpu.parallel.mesh import DeviceContext
+
+    def plan(self, chunk):
+        if self._serve_pallas_off:
+            return None
+        return (chunk, True)
+
+    monkeypatch.setattr(DeviceContext, "_serve_pallas_plan", plan)
+
+
+def test_serving_pallas_first_match_interpreter_pin(monkeypatch):
+    """ISSUE 18: the Pallas strided first-match kernel (interpreter
+    mode) mounts on the resident sharded table and answers
+    bit-identically to the host oracle — the running-min tile scan has
+    no early exit, so exactness is by construction, pinned here."""
+    _patch_serve_pallas(monkeypatch)
+    st = _state(num_devices=4, rule_engine="device")
+    st.warm()
+    assert st._handle is not None and st._handle.pallas is True
+    assert st.describe()["resident_table"] is True
+    host = _state(engine="host")
+    assert st.recommend_batch(U_LINES) == host.recommend_batch(U_LINES)
+
+
+def test_serve_scan_pallas_cascade_walks_to_xla(monkeypatch):
+    """serve_scan transient exhaustion with the Pallas kernel mounted:
+    the first walk drops only the kernel (pallas->xla; the device rule
+    table survives for the re-warm); the still-armed fetch then
+    exhausts the XLA scan too and rule_scan walks device->host — both
+    forward-only, both on the ledger, answers staying exact."""
+    monkeypatch.setenv("FA_RETRY_MAX", "2")
+    monkeypatch.setenv("FA_RETRY_BACKOFF_MS", "0")
+    from fastapriori_tpu.reliability import retry
+
+    retry.reload_policy_from_env()
+    try:
+        _patch_serve_pallas(monkeypatch)
+        st = _state(num_devices=4, rule_engine="device")
+        baseline_host = _state(engine="host").recommend_batch(U_LINES)
+        st.warm()
+        assert st._handle is not None and st._handle.pallas is True
+        failpoints.arm("fetch.serve_match", "oom")  # unlimited
+        out = st.recommend_batch(U_LINES)
+        failpoints.disarm_all()
+        assert out == baseline_host
+        casc = [
+            e for e in ledger.snapshot() if e["kind"] == "cascade"
+        ]
+        assert any(
+            e["chain"] == "serve_scan"
+            and e["frm"] == "pallas"
+            and e["to"] == "xla"
+            for e in casc
+        )
+        assert any(
+            e["chain"] == "rule_scan"
+            and e["frm"] == "device"
+            and e["to"] == "host"
+            for e in casc
+        )
+    finally:
+        retry.reload_policy_from_env()
+
+
 # ---------------------------------------------------------------------------
 # open-loop load generation
 

@@ -201,6 +201,21 @@ def render(parsed: dict) -> str:
             if b is not None and v is not None:
                 line += f"; {n}-dev {b}s vs {v}s"
         out.append(line + ".")
+        pal = ec.get("pallas") or {}
+        if pal.get("expected_speedup") is not None:
+            # ISSUE 18: the Pallas tier row is MODELED on CPU hosts
+            # (kernels are TPU-only); render it clearly labeled with
+            # the HBM-traffic saving it models and the device-trace
+            # artifact the attribution evidence lives at.
+            pline = (
+                f"Pallas vertical tier (modeled, HBM-traffic): "
+                f"{pal['expected_speedup']}x expected over the XLA "
+                f"vertical path ({pal.get('member_bytes_saved', 0):,} "
+                f"prefix-intermediate bytes kept VMEM-resident)"
+            )
+            if pal.get("device_trace"):
+                pline += f"; device trace: `{pal['device_trace']}`"
+            out.append(pline + ".")
     cal = parsed.get("calibration")
     if cal:
         out.append("")

@@ -40,6 +40,24 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_rules_device.py -q \
 env JAX_PLATFORMS=cpu python -m pytest tests/test_vertical.py -q \
     -p no:cacheprovider
 
+# Pallas kernel-tier differential suite (ISSUE 18): the VMEM-resident
+# vertical kernel and the strided first-match serving kernel, in
+# interpreter mode, must stay bit-exact against the XLA vertical path
+# and the bitmap oracle on every corpus/mesh shape, the FA_NO_PALLAS
+# gate table strict, the vertical_kernel/serve_scan cascades walked,
+# and kill-resume byte-identical with the tier engaged.  Wall-budgeted
+# like the serving smoke (the suite is the slowest differential block:
+# three engines per corpus cell).
+pallas_t0=$(python -c 'import time; print(time.time())')
+env JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_vertical.py -q \
+    -p no:cacheprovider
+python - "$pallas_t0" <<'EOF'
+import sys, time
+elapsed = time.time() - float(sys.argv[1])
+print(f"pallas differential wall time: {elapsed:.2f}s (budget 240s)")
+sys.exit(1 if elapsed > 240.0 else 0)
+EOF
+
 # Sharded rule generation + device-resident priority scan differential
 # suite (ISSUE 8): the sharded join engine and the rank-strided
 # resident scan must stay bit-exact against the host oracle at

@@ -943,6 +943,40 @@ def build_inventory(pkg: "PackageContext") -> dict:
         {"label": v, "path": c.path}
         for v, c, _n in span_declarations(pkg)
     ]
+    # The ISSUE 18 kernel census: every ``pallas_call`` site in
+    # non-test code, by enclosing function — the inventory row that
+    # makes a new device kernel a reviewed, drift-checked event (a
+    # kernel added without regenerating the inventory fails
+    # --check-inventory in CI).
+    kernels = []
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        func_stack = ["<module>"]
+
+        def _walk(node):
+            is_fn = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_fn:
+                func_stack.append(node.name)
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = (
+                    fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None
+                )
+                if name == "pallas_call":
+                    kernels.append(
+                        {"path": ctx.path, "function": func_stack[-1]}
+                    )
+            for child in ast.iter_child_nodes(node):
+                _walk(child)
+            if is_fn:
+                func_stack.pop()
+
+        _walk(ctx.tree)
     # The v3 collective census (tools/lint/collective.py): every
     # collective-issuing call site with its mesh axis, issuing engine
     # path, and enclosing branch conditions — the artifact G015-G017
@@ -967,6 +1001,7 @@ def build_inventory(pkg: "PackageContext") -> dict:
         "fetch_sites": _counted(fetches),
         "failpoint_sites": _counted(fires),
         "span_sites": _counted(spans),
+        "kernel_sites": _counted(kernels),
         "env_reads": _counted(envs),
         "collective_sites": _counted(collectives),
         "raise_sites": _counted(proto.raise_census(pkg)),
