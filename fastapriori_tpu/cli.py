@@ -227,6 +227,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "the micro-batch rows); a full queue sheds ('0' + ledger)",
     )
     p.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=None,
+        help="two-stage dispatcher hand-off ring depth (default "
+        "FA_SERVE_PIPELINE_DEPTH=2; 0 forces the serial "
+        "pack+scan-on-one-thread dispatcher)",
+    )
+    p.add_argument(
+        "--hosts",
+        type=int,
+        default=None,
+        help="serving hosts in the mesh (default FA_SERVE_HOSTS=1); "
+        ">1 mounts the model on N in-process hosts behind the "
+        "request router — round-robin + spill admission, global "
+        "shed, one merged metrics surface",
+    )
+    p.add_argument(
         "--rate",
         type=float,
         default=None,
@@ -361,6 +378,11 @@ def _run_serve(args) -> int:
         log_metrics=args.metrics,
         retain_csr=False,
     )
+    from fastapriori_tpu.serve.router import hosts_from_env
+
+    n_hosts = args.hosts if args.hosts is not None else hosts_from_env()
+    if n_hosts < 1:
+        raise InputError(f"--hosts must be >= 1, got {n_hosts}")
     t0 = time.perf_counter()
     with phase_timer("serve model mount", enabled=False):
         if args.from_serving:
@@ -374,12 +396,50 @@ def _run_serve(args) -> int:
             )
         if args.save_serving:
             state.save(args.output)
-        server = RecommendServer(
-            state,
-            batch_rows=args.batch_rows,
-            linger_ms=args.linger_ms,
-            queue_depth=args.queue_depth,
-        ).start()
+
+        def _mk_server(st):
+            return RecommendServer(
+                st,
+                batch_rows=args.batch_rows,
+                linger_ms=args.linger_ms,
+                queue_depth=args.queue_depth,
+                pipeline_depth=args.pipeline_depth,
+            ).start()
+
+        if n_hosts > 1:
+            # Mesh mode (ISSUE 19): each host mounts its OWN state (no
+            # shared device table — per-host scan state is what the
+            # hot-swap signature discipline protects), loaded from the
+            # serving checkpoint; a mined model is checkpointed to a
+            # scratch prefix first.
+            import os
+            import tempfile
+
+            from fastapriori_tpu.serve import LocalHost, MeshRouter
+
+            if args.from_serving:
+                prefix = args.from_serving
+            elif args.save_serving:
+                prefix = args.output
+            else:
+                prefix = os.path.join(
+                    tempfile.mkdtemp(prefix="fa_mesh_cli_"), "m_"
+                )
+                state.save(prefix)
+            states = [state] + [
+                ServingState.load(
+                    prefix, config=config, engine=args.serve_engine
+                )
+                for _ in range(n_hosts - 1)
+            ]
+            server = MeshRouter(
+                [
+                    LocalHost(f"host{i}", _mk_server(st))
+                    for i, st in enumerate(states)
+                ]
+            )
+        else:
+            server = _mk_server(state)
     print(
         "==== Total time for serve model mount "
         f"{int((time.perf_counter() - t0) * 1e3)}",
@@ -418,11 +478,19 @@ def _run_serve(args) -> int:
             print(json.dumps({"serve_open_loop": result}), file=sys.stderr)
     else:
         for tokens in lines:
-            reqs.append(server.submit_wait(tokens))
+            if n_hosts > 1:
+                # The router's closed-loop shape: admission spills
+                # across hosts and sheds (never blocks) at mesh-full.
+                reqs.append(server.submit(tokens))
+            else:
+                reqs.append(server.submit_wait(tokens))
     completed = server.wait_for(reqs, timeout_s=600.0)
     served_wall = time.perf_counter() - t1
     stats = server.stats()
-    stopped = server.stop(drain=True)
+    if n_hosts > 1:
+        stopped = server.drain() and server.stop()
+    else:
+        stopped = server.stop(drain=True)
     if dump_stop is not None:
         dump_stop()  # final metrics snapshot, thread joined (bounded)
     if args.trace:
@@ -465,10 +533,22 @@ def _run_serve(args) -> int:
     else:
         for _, item in recommends:
             print(item)
+    avg_batch = stats.get("avg_batch")
+    if avg_batch is None:  # mesh stats aggregate; derive the average
+        avg_batch = round(stats["served"] / max(stats["batches"], 1), 1)
+    engine = (stats.get("model") or {}).get("engine")
+    if engine is None:
+        ph = stats.get("per_host") or [{}]
+        engine = (ph[0].get("model") or {}).get("engine", "?")
+    mesh_note = (
+        f"{stats['hosts']} hosts ({stats.get('router_shed', 0)} "
+        f"router-shed), " if n_hosts > 1 else ""
+    )
     print(
         f"==== serve: {stats['served']} served, {stats['shed']} shed, "
-        f"{stats['batches']} batches (avg {stats['avg_batch']} rows), "
-        f"engine {stats['model']['engine']}, "
+        f"{mesh_note}"
+        f"{stats['batches']} batches (avg {avg_batch} rows), "
+        f"engine {engine}, "
         f"{int(served_wall * 1e3)} ms",
         file=sys.stderr,
     )

@@ -118,16 +118,54 @@ def find_perfetto_trace(trace_dir: str) -> Optional[str]:
     return hits[-1] if hits else None
 
 
+# Raw kernel/event substrings -> host-side span stage labels (ISSUE 19
+# satellite): XLA mangles program names, but the mangled forms keep
+# recognizable fragments of the operations each serving/mining stage
+# dispatches.  ORDERED — first match wins, so the specific fragments
+# (the Pallas kernel symbols) precede the generic ones.  Unmatched
+# kernels map to "other": attribution must never silently drop device
+# time, a whole-stage gap would misread as pipeline overlap.
+STAGE_PATTERNS = (
+    ("strided_best_rank", "serve.scan"),   # serving Pallas match kernel
+    ("first_match", "serve.scan"),          # XLA serving scan program
+    ("serve", "serve.scan"),
+    ("vertical_kernel", "mine.count"),      # Pallas popcount kernel
+    ("vertical", "mine.count"),
+    ("count", "mine.count"),
+    ("contain", "rules.join"),
+    ("rule", "rules.join"),
+    ("gather", "serve.scan"),               # decode/gather of scan hits
+    ("convert", "xfer"),
+    ("copy", "xfer"),
+    ("transfer", "xfer"),
+)
+
+
+def stage_for_kernel(name: str) -> str:
+    """Map one raw (possibly mangled) kernel event name onto the span
+    stage label its dispatch site owns — the first matching substring
+    in :data:`STAGE_PATTERNS` wins, ``"other"`` otherwise."""
+    low = name.lower()
+    for frag, stage in STAGE_PATTERNS:
+        if frag in low:
+            return stage
+    return "other"
+
+
 def kernel_summary(trace_dir: str, top: int = 0) -> Dict[str, Any]:
     """Aggregate per-kernel device durations from a captured trace.
 
     Pure stdlib: gunzips the Perfetto/Chrome-trace JSON and sums the
     complete-event (``ph == "X"``) durations by event name.  Returns
-    ``{"trace": path-or-None, "kernels": [{name, calls, total_us}...]}``
-    sorted by total time descending (``top`` truncates when > 0).
-    Missing or malformed traces yield an empty kernel list, never an
-    exception — the summary rides in bench artifacts where a parse
-    error must not sink the whole record.
+    ``{"trace": path-or-None, "kernels": [{name, stage, calls,
+    total_us}...], "by_stage": {stage: total_us}}`` sorted by total
+    time descending (``top`` truncates the kernel rows when > 0; the
+    stage aggregate always covers every event), each kernel mapped
+    back onto its host span stage via :func:`stage_for_kernel` so
+    ``--engine-compare`` attributes device time per STAGE, not per
+    mangled name.  Missing or malformed traces yield an empty kernel
+    list, never an exception — the summary rides in bench artifacts
+    where a parse error must not sink the whole record.
     """
     path = find_perfetto_trace(trace_dir)
     out: Dict[str, Any] = {"trace": path, "kernels": []}
@@ -151,11 +189,22 @@ def kernel_summary(trace_dir: str, top: int = 0) -> Dict[str, Any]:
         slot["calls"] += 1
         slot["total_us"] += float(dur)
     rows = [
-        {"name": k, "calls": int(v["calls"]), "total_us": v["total_us"]}
+        {
+            "name": k,
+            "stage": stage_for_kernel(k),
+            "calls": int(v["calls"]),
+            "total_us": v["total_us"],
+        }
         for k, v in agg.items()
     ]
     rows.sort(key=lambda r: (-r["total_us"], r["name"]))
+    by_stage: Dict[str, float] = {}
+    for r in rows:
+        by_stage[r["stage"]] = by_stage.get(r["stage"], 0.0) + r["total_us"]
     if top > 0:
         rows = rows[:top]
     out["kernels"] = rows
+    out["by_stage"] = dict(
+        sorted(by_stage.items(), key=lambda kv: (-kv[1], kv[0]))
+    )
     return out

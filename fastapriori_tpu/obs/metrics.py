@@ -244,6 +244,144 @@ class MetricsRegistry:
         self._instruments.clear()
 
 
+# -- cross-process aggregation (ISSUE 19 satellite) ---------------------
+# A serving mesh has one registry PER HOST PROCESS; the router merges
+# their snapshot() dicts into one scrapeable surface.  Snapshots are the
+# merge currency (JSON-safe, so a subprocess host's registry rides a
+# file): the instrument kind is recovered from the snapshot shape —
+# Counter -> number, Gauge -> {value,max}, Histogram ->
+# {buckets,count,sum}, labeled histogram -> {label: histogram}.
+
+
+def _snap_kind(val) -> str:
+    if isinstance(val, (int, float)) and not isinstance(val, bool):
+        return "counter"
+    if isinstance(val, dict):
+        if set(val) == {"value", "max"}:
+            return "gauge"
+        if set(val) == {"buckets", "count", "sum"}:
+            return "histogram"
+        return "labeled"
+    raise ValueError(f"unrecognized metrics snapshot value: {val!r}")
+
+
+def _copy_val(val):
+    if isinstance(val, dict):
+        return {k: _copy_val(v) for k, v in val.items()}
+    return val
+
+
+def _merge_hist(name: str, a: dict, b: dict) -> dict:
+    if set(a["buckets"]) != set(b["buckets"]):
+        raise ValueError(
+            f"histogram {name}: bucket bounds differ across hosts "
+            f"({sorted(a['buckets'])} vs {sorted(b['buckets'])}) — "
+            "mesh hosts must run the same instrument layout"
+        )
+    return {
+        "buckets": {
+            k: a["buckets"][k] + b["buckets"][k] for k in a["buckets"]
+        },
+        "count": a["count"] + b["count"],
+        "sum": round(a["sum"] + b["sum"], 6),
+    }
+
+
+def _merge_val(name: str, a, b):
+    ka, kb = _snap_kind(a), _snap_kind(b)
+    if ka != kb:
+        raise ValueError(
+            f"metric {name}: instrument kind differs across hosts "
+            f"({ka} vs {kb})"
+        )
+    if ka == "counter":
+        return a + b
+    if ka == "gauge":
+        return {
+            "value": max(a["value"], b["value"]),
+            "max": max(a["max"], b["max"]),
+        }
+    if ka == "histogram":
+        return _merge_hist(name, a, b)
+    out = {k: _copy_val(v) for k, v in a.items()}
+    for k, v in b.items():
+        out[k] = _merge_hist(name, out[k], v) if k in out else _copy_val(v)
+    return out
+
+
+def merge_snapshots(snaps: Sequence[dict]) -> dict:
+    """Fold per-host registry ``snapshot()`` dicts into one mesh-level
+    snapshot: counters SUM, gauges MAX (current value and peak —
+    per-host queue depths are not additive load), histograms add
+    BUCKET-WISE (same bounds required; a mismatch raises rather than
+    silently skewing percentiles), labeled histograms merge per label.
+    The result is itself snapshot-shaped — :func:`render_snapshot`
+    exposes it as ordinary Prometheus text."""
+    out: Dict[str, object] = {}
+    for snap in snaps:
+        for name, val in snap.items():
+            if name in out:
+                out[name] = _merge_val(name, out[name], val)
+            else:
+                out[name] = _copy_val(val)
+    return dict(sorted(out.items()))
+
+
+def _hist_lines(name: str, lbl: str, hs: dict) -> List[str]:
+    # Cumulative le-ordered buckets (the Prometheus contract); bucket
+    # keys sort numerically with +Inf last — a JSON round-trip keeps
+    # insertion order, but don't depend on it.
+    finite = sorted(
+        (k for k in hs["buckets"] if k != "+Inf"), key=float
+    )
+    out = []
+    cum = 0
+    for k in finite:
+        cum += hs["buckets"][k]
+        sel = f'{lbl},le="{k}"' if lbl else f'le="{k}"'
+        out.append(f"{name}_bucket{{{sel}}} {cum}")
+    cum += hs["buckets"].get("+Inf", 0)
+    sel = f'{lbl},le="+Inf"' if lbl else 'le="+Inf"'
+    out.append(f"{name}_bucket{{{sel}}} {cum}")
+    suffix = f"{{{lbl}}}" if lbl else ""
+    out.append(f"{name}_sum{suffix} {round(hs['sum'], 6)}")
+    out.append(f"{name}_count{suffix} {hs['count']}")
+    return out
+
+
+def render_snapshot(
+    snap: dict, helps: Optional[Dict[str, str]] = None,
+    label: str = "site",
+) -> str:
+    """Prometheus text exposition of a snapshot dict (typically the
+    output of :func:`merge_snapshots`) — the same format the live
+    registries render, so one scraper config serves single-host and
+    mesh deployments."""
+    lines: List[str] = []
+    for name in sorted(snap):
+        val = snap[name]
+        kind = _snap_kind(val)
+        h = (helps or {}).get(name, "")
+        if kind == "counter":
+            lines += [
+                f"# HELP {name} {h}", f"# TYPE {name} counter",
+                f"{name} {val}",
+            ]
+        elif kind == "gauge":
+            lines += [
+                f"# HELP {name} {h}", f"# TYPE {name} gauge",
+                f"{name} {val['value']}", f"{name}_max {val['max']}",
+            ]
+        elif kind == "histogram":
+            lines += [f"# HELP {name} {h}", f"# TYPE {name} histogram"]
+            lines += _hist_lines(name, "", val)
+        else:
+            lines += [f"# HELP {name} {h}", f"# TYPE {name} histogram"]
+            for key in sorted(val):
+                lines += _hist_lines(name, f'{label}="{key}"', val[key])
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 # Process-global registry for instruments whose sites have no server or
 # config in scope (the ledger pattern): today the per-site audited-fetch
 # latency histograms updated by reliability/retry.py.

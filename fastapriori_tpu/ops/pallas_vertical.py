@@ -228,10 +228,14 @@ def _match_kernel(
 
     b = b_ref[...]
     ant = ant_ref[...]
-    overlap = None
-    for k in range(ant.shape[1]):
-        part = jnp.take(b, ant[:, k], axis=1).astype(jnp.int32)
-        overlap = part if overlap is None else overlap + part
+    # One-pass antecedent gather (ISSUE 19 satellite): a single flat
+    # take over all RT*K columns replaces K separate [MB, RT] sweeps —
+    # one gather instead of K per rule tile.  Bit-exact vs the K-pass
+    # form: the same int32 membership bits sum per (row, rule), and
+    # padding slots still gather column 0 (a zero column).
+    rt, k_width = ant.shape
+    gathered = jnp.take(b, ant.reshape(-1), axis=1).astype(jnp.int32)
+    overlap = gathered.reshape(b.shape[0], rt, k_width).sum(axis=2)
     size = size_ref[...].reshape(-1)  # [RT]
     cons = cons_ref[...].reshape(-1)
     blen = blen_ref[...]  # [MB, 1]

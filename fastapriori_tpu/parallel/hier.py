@@ -96,6 +96,32 @@ def auto_group_count(n_shards: int, n_procs: int = 1) -> int:
     return best
 
 
+def spill_order(primary: int, n_hosts: int, groups: int = 0) -> list:
+    """Serving-mesh admission fan-out (ISSUE 19): the spill sequence
+    for a request whose primary host refused admission.  The host set
+    is viewed as the same ``(groups, per_group)`` grid the exchange
+    tiers use: spill WITHIN the primary's group first (the fast tier —
+    same pod on real hardware), then the remaining hosts in ring order,
+    so overflow traffic stays pod-local until the whole pod saturates.
+    Meshes with no admissible grouping degenerate to the plain ring."""
+    if not 0 <= primary < n_hosts:
+        from fastapriori_tpu.errors import InputError
+
+        raise InputError(
+            f"spill_order: primary {primary} outside 0..{n_hosts - 1}"
+        )
+    spec = resolve_spec(n_hosts, groups)
+    ring = [(primary + k) % n_hosts for k in range(n_hosts)]
+    if spec is None:
+        return ring
+    _g, per = spec
+    pod = primary // per
+    return (
+        [h for h in ring if h // per == pod]
+        + [h for h in ring if h // per != pod]
+    )
+
+
 def resolve_spec(n_shards: int, requested: int, n_procs: int = 1) -> GroupSpec:
     """Validate/resolve the group-count knob against the mesh:
     ``requested`` 0 = auto (:func:`auto_group_count`), 1 = flat; any

@@ -111,6 +111,14 @@ CHAINS: Dict[str, Tuple[str, ...]] = {
     # collective); walked BEFORE rule_scan device→host — the XLA scan
     # retry is cheaper than abandoning the device table.
     "serve_scan": ("pallas", "xla"),
+    # Serving mesh health (ISSUE 19): full complement -> degraded (one
+    # or more hosts lost; survivors absorb the dead host's share, its
+    # in-flight requests answer "0" as recorded sheds).  Walked by the
+    # request router (serve/router.py) once per lost host.  HOST-LOCAL
+    # by design — the router is a single process observing heartbeat
+    # files; no collective shape changes, so NOT consensus-registered
+    # (G016: no collective-bearing function walks it).
+    "serve_mesh": ("full", "degraded"),
 }
 
 

@@ -9,12 +9,22 @@
   shed mode, barrier-ordered hot-swap.
 - :mod:`~fastapriori_tpu.serve.loadgen` — seeded open-loop load
   generation + the sustained-load record fields (bench / smoke / CLI).
+- :class:`~fastapriori_tpu.serve.router.MeshRouter` — the multi-host
+  serving mesh (ISSUE 19): request routing + global shed across
+  in-process (:class:`~fastapriori_tpu.serve.router.LocalHost`) or
+  subprocess (:class:`~fastapriori_tpu.serve.router.ProcHost`) hosts,
+  mesh-ordered hot-swap, PeerLost-driven rerouting, merged metrics.
 """
 
 from fastapriori_tpu.serve.loadgen import (  # noqa: F401
     arrival_offsets,
     percentiles_ms,
     run_open_loop,
+)
+from fastapriori_tpu.serve.router import (  # noqa: F401
+    LocalHost,
+    MeshRouter,
+    ProcHost,
 )
 from fastapriori_tpu.serve.server import (  # noqa: F401
     RecommendServer,

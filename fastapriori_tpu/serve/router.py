@@ -1,0 +1,870 @@
+"""MeshRouter — the multi-host serving mesh (ISSUE 19 tentpole, part b).
+
+One RecommendServer saturates at one host's scan capacity; the north
+star's "millions of users" needs the serving tier to shard the way the
+batch path shards transactions.  The router is that shard layer: it
+fans an open-loop request stream across a mesh of serving hosts — each
+a full admission-queue + two-stage-dispatcher + device-scan stack —
+and presents the SAME surface a single server does (submit / wait_for /
+stats / metrics / swap), so the load generator, bench, CLI and smoke
+drive a mesh exactly like one server.
+
+**Hosts.**  Two host forms behind one duck-typed face:
+
+- :class:`LocalHost` — an in-process ``RecommendServer`` (virtual-host
+  scaling on one machine; the bench's 1/2/4-host ladder).
+- :class:`ProcHost` — a subprocess worker (``python -m
+  fastapriori_tpu.serve.router --worker``) owning its own JAX runtime
+  and serving from a checkpoint prefix; the router talks to it through
+  an atomic-rename file protocol (the quorum FileTransport discipline:
+  ``tmp`` + ``os.replace``, so a reader never sees a torn file) with
+  heartbeat liveness under the SAME knobs the consensus substrate uses
+  (``FA_HEARTBEAT_MS`` publish interval, age judged against
+  ``FA_QUORUM_TIMEOUT_S``).
+
+**Routing + global shed.**  Requests round-robin across live hosts;
+a host that refuses admission (:meth:`RecommendServer.try_submit` —
+full queue, counts nothing) spills along
+:func:`~fastapriori_tpu.parallel.hier.spill_order` (pod-local first).
+Only when EVERY live host refuses does the router shed globally —
+answered "0" immediately, counted once at the router, one ``serving``
+accept→shed cascade event per overload episode.  A request is counted
+by exactly one host or by the router, never both — shed accounting
+stays exact under overload (test-pinned).
+
+**Mesh hot-swap.**  :meth:`swap` holds admission while it enqueues the
+barrier marker on every host in order, then releases; each host's
+barrier preserves the single-server contract (a batch never straddles
+the marker), so every response carries either the old or the new model
+signature and every request admitted after :meth:`swap` returns is
+served by the new — a response never mixes rule tables across the
+router (test-pinned via per-response signatures).
+
+**PeerLost-driven rerouting.**  A monitor thread runs the failure
+detector (thread liveness for LocalHost, process exit + heartbeat age
+for ProcHost).  A dead host walks the ``serve_mesh`` full→degraded
+cascade once (HOST-LOCAL: the router is one process observing files —
+no collective shape change, hence not consensus-registered), its
+in-flight requests are answered "0" as recorded sheds, and its share
+drains to the survivors through ordinary routing — degraded, recorded,
+never a hang.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.obs import metrics as obs_metrics
+from fastapriori_tpu.obs.metrics import MetricsRegistry
+from fastapriori_tpu.parallel.hier import spill_order
+from fastapriori_tpu.reliability import ledger, quorum, watchdog
+from fastapriori_tpu.serve.server import RecommendServer, ServeRequest
+from fastapriori_tpu.serve.state import ServingState
+
+_HOSTS: Optional[int] = None
+
+
+def hosts_from_env() -> int:
+    """``FA_SERVE_HOSTS`` — serving-mesh host count for the CLI/bench
+    entry points (strict int >= 1, default 1 = no mesh, the plain
+    single-server path).  The router itself takes an explicit host
+    list; this knob only sizes the default mesh the entry points
+    build."""
+    global _HOSTS
+    if _HOSTS is None:
+        from fastapriori_tpu.utils.env import env_int
+
+        _HOSTS = env_int("FA_SERVE_HOSTS", 1, minimum=1)
+    return _HOSTS
+
+
+def reload_from_env() -> None:
+    """Drop the memoized knob reads (tests repoint the environment)."""
+    global _HOSTS
+    _HOSTS = None
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    """The FileTransport write discipline: full content to a tmp name,
+    one atomic rename — a concurrent reader sees the old file or the
+    new one, never a torn prefix."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    # lint: waive G009 -- this IS the atomic discipline: tmp + os.replace; write_artifact would drag manifest machinery into a per-request protocol file
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(json.dumps(obj))
+    os.replace(tmp, path)
+
+
+def _read_json(path: str):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.loads(f.read())
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return None
+
+
+class LocalHost:
+    """An in-process mesh host: one started :class:`RecommendServer`.
+    The bench's virtual-host ladder and the router tests use these —
+    same admission/pipeline/swap machinery as a real host, zero
+    transport."""
+
+    def __init__(self, name: str, server: RecommendServer):
+        self.name = name
+        self.server = server
+        self._failed = False
+        # Requests accepted by this host and possibly still in flight —
+        # the router answers them as sheds if the host dies (pruned
+        # lazily; bounded by queue depth + pipeline buffering).
+        self._outstanding: deque = deque()
+
+    def try_submit(
+        self, tokens: Sequence[str], t_sched: Optional[float] = None
+    ) -> Optional[ServeRequest]:
+        if self._failed:
+            return None
+        req = self.server.try_submit(tokens, t_sched)
+        if req is not None:
+            out = self._outstanding
+            while out and out[0].done:
+                out.popleft()
+            out.append(req)
+        return req
+
+    def alive(self) -> bool:
+        return not self._failed and self.server.alive()
+
+    def swap(self, payload: ServingState) -> threading.Event:
+        return self.server.swap(payload)
+
+    def fail_outstanding(self) -> int:
+        """Answer every not-yet-served request as a recorded shed (the
+        dead host's in-flight share) — called by the router's failure
+        detector, never a hang for the waiters."""
+        now = time.monotonic()
+        n = 0
+        while self._outstanding:
+            r = self._outstanding.popleft()
+            if not r.done:
+                r.item = "0"
+                r.shed = True
+                r.t_done = now
+                n += 1
+        return n
+
+    def kill(self) -> None:
+        """Chaos/test hook: abrupt host death — the admission queue and
+        hand-off ring are dropped on the floor (their requests are the
+        router's to answer), the stage threads exit without drain."""
+        self._failed = True
+        srv = self.server
+        with srv._cond:
+            srv._q.clear()
+            srv._running = False
+            srv._cond.notify_all()
+        with srv._ring_cond:
+            srv._ring.clear()
+            srv._ring_cond.notify_all()
+
+    def stats(self) -> dict:
+        return self.server.stats()
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.server.metrics_snapshot()
+        return {**snap["server"], **snap["global"]}
+
+    def reset_max_queue(self) -> None:
+        self.server.reset_max_queue()
+
+    def stop(self, timeout_s: float = 30.0) -> bool:
+        if self._failed:
+            return True
+        return self.server.stop(timeout_s=timeout_s)
+
+
+class ProcHost:
+    """A subprocess mesh host: spawns ``python -m
+    fastapriori_tpu.serve.router --worker`` serving a checkpoint
+    prefix, and proxies admission through the file protocol.
+
+    Router-side shape: :meth:`try_submit` bounds in-flight requests at
+    the worker's queue depth (admission back-pressure without a
+    round-trip); a flusher thread packs pending requests into
+    ``req-<seq>.json`` batches; a poller thread completes them from
+    ``rsp-<seq>.json``.  Swap barriers ride the SAME seq stream —
+    ``swap-<seq>.json`` is written only after every request admitted
+    before the swap, so the worker observes router order."""
+
+    def __init__(
+        self,
+        name: str,
+        workdir: str,
+        serving_prefix: str,
+        *,
+        batch_rows: int = 0,
+        linger_ms: float = -1.0,
+        queue_depth: int = 0,
+        engine: str = "auto",
+        pipeline_depth: Optional[int] = None,
+        start_timeout_s: float = 120.0,
+        env: Optional[dict] = None,
+    ):
+        self.name = name
+        self.dir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self._cap = queue_depth if queue_depth else 4 * (batch_rows or 256)
+        self._lock = threading.Condition()
+        self._pending: deque = deque()  # ServeRequest | _SwapCmd
+        self._outstanding: Dict[int, ServeRequest] = {}
+        self._next_id = 0
+        self._next_seq = 0
+        self._swap_events: Dict[int, threading.Event] = {}
+        self._swap_sigs: Dict[int, str] = {}
+        self._failed = False
+        self._running = True
+        self._stats_cache: dict = {}
+        self._batch_cap = max(batch_rows or 256, 1)
+        cmd = [
+            sys.executable, "-m", "fastapriori_tpu.serve.router",
+            "--worker", "--dir", workdir, "--serving", serving_prefix,
+            "--engine", engine,
+            "--batch-rows", str(batch_rows),
+            "--linger-ms", str(linger_ms),
+            "--queue-depth", str(queue_depth),
+        ]
+        if pipeline_depth is not None:
+            cmd += ["--pipeline-depth", str(pipeline_depth)]
+        penv = dict(os.environ)
+        if env:
+            penv.update(env)
+        self.proc = subprocess.Popen(
+            cmd, env=penv,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        deadline = time.monotonic() + start_timeout_s
+        ready = None
+        while time.monotonic() < deadline:
+            ready = _read_json(os.path.join(workdir, "ready.json"))
+            if ready is not None:
+                break
+            if self.proc.poll() is not None:
+                break
+            time.sleep(0.02)
+        if ready is None:
+            self.proc.kill()
+            raise InputError(
+                f"mesh host {name}: worker failed to become ready "
+                f"within {start_timeout_s}s (exit="
+                f"{self.proc.poll()})"
+            )
+        self.signature = ready["signature"]
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name=f"fa-mesh-flush-{name}",
+            daemon=True,
+        )
+        self._poller = threading.Thread(
+            target=self._poll_loop, name=f"fa-mesh-poll-{name}",
+            daemon=True,
+        )
+        self._flusher.start()
+        self._poller.start()
+
+    # -- admission ------------------------------------------------------
+    def try_submit(
+        self, tokens: Sequence[str], t_sched: Optional[float] = None
+    ) -> Optional[ServeRequest]:
+        if self._failed:
+            return None
+        with self._lock:
+            if (
+                len(self._pending) + len(self._outstanding) >= self._cap
+            ):
+                return None
+            req = ServeRequest(list(tokens), t_sched, time.monotonic())
+            self._pending.append(req)
+            self._lock.notify_all()
+        return req
+
+    def swap(self, payload: str) -> threading.Event:
+        """Enqueue a swap barrier carrying a checkpoint PREFIX; it is
+        flushed behind every previously admitted request."""
+        ev = threading.Event()
+        with self._lock:
+            self._pending.append(("swap", payload, ev))
+            self._lock.notify_all()
+        return ev
+
+    # -- router-side threads --------------------------------------------
+    def _flush_loop(self) -> None:
+        while self._running:
+            with self._lock:
+                if not self._pending:
+                    self._lock.wait(0.005)
+                    continue
+                batch: List[ServeRequest] = []
+                swap_cmd = None
+                while self._pending and len(batch) < self._batch_cap:
+                    item = self._pending[0]
+                    if isinstance(item, tuple):
+                        if batch:
+                            break  # flush admitted requests first
+                        swap_cmd = self._pending.popleft()
+                        break
+                    batch.append(self._pending.popleft())
+                ids = []
+                for r in batch:
+                    self._outstanding[self._next_id] = r
+                    ids.append(self._next_id)
+                    self._next_id += 1
+                seq = self._next_seq
+                self._next_seq += 1
+            if swap_cmd is not None:
+                _, prefix, ev = swap_cmd
+                self._swap_events[seq] = ev
+                _write_json_atomic(
+                    os.path.join(self.dir, f"swap-{seq:08d}.json"),
+                    {"prefix": prefix},
+                )
+                continue
+            _write_json_atomic(
+                os.path.join(self.dir, f"req-{seq:08d}.json"),
+                {"ids": ids, "baskets": [list(r.tokens) for r in batch]},
+            )
+
+    def _poll_loop(self) -> None:
+        done_rsp = set()
+        while self._running:
+            progressed = False
+            try:
+                names = os.listdir(self.dir)
+            except OSError:
+                names = []
+            for fn in sorted(names):
+                if fn.startswith("rsp-") and fn.endswith(".json"):
+                    if fn in done_rsp:
+                        continue
+                    data = _read_json(os.path.join(self.dir, fn))
+                    if data is None:
+                        continue
+                    done_rsp.add(fn)
+                    now = time.monotonic()
+                    with self._lock:
+                        for i, rid in enumerate(data["ids"]):
+                            r = self._outstanding.pop(rid, None)
+                            if r is None:
+                                continue
+                            r.item = data["items"][i]
+                            r.model = data["models"][i]
+                            r.shed = bool(data["shed"][i])
+                            r.t_done = now
+                        self._lock.notify_all()
+                    progressed = True
+                elif fn.startswith("swapped-") and fn.endswith(".json"):
+                    seq = int(fn[8:-5])
+                    ev = self._swap_events.get(seq)
+                    if ev is not None and not ev.is_set():
+                        data = _read_json(os.path.join(self.dir, fn))
+                        if data is not None:
+                            self._swap_sigs[seq] = data.get("to", "")
+                            ev.set()
+                            progressed = True
+                elif fn == "stats.json":
+                    data = _read_json(os.path.join(self.dir, fn))
+                    if data is not None:
+                        self._stats_cache = data
+            if not progressed:
+                time.sleep(0.003)
+
+    # -- health / teardown ----------------------------------------------
+    def alive(self) -> bool:
+        if self._failed:
+            return False
+        if self.proc.poll() is not None:
+            return False
+        try:
+            age = time.time() - os.path.getmtime(
+                os.path.join(self.dir, "hb")
+            )
+        except OSError:
+            return True  # not yet published; process liveness covers it
+        return age <= quorum.quorum_timeout_s()
+
+    def fail_outstanding(self) -> int:
+        self._failed = True
+        now = time.monotonic()
+        n = 0
+        with self._lock:
+            for r in list(self._pending) + list(
+                self._outstanding.values()
+            ):
+                if not isinstance(r, tuple) and not r.done:
+                    r.item = "0"
+                    r.shed = True
+                    r.t_done = now
+                    n += 1
+            self._pending.clear()
+            self._outstanding.clear()
+            self._lock.notify_all()
+        for ev in self._swap_events.values():
+            ev.set()  # a dead host cannot hold the mesh barrier
+        return n
+
+    def kill(self) -> None:
+        """Chaos/test hook: hard-kill the worker process."""
+        self.proc.kill()
+
+    def stats(self) -> dict:
+        return dict(self._stats_cache)
+
+    def metrics_snapshot(self) -> dict:
+        snap = _read_json(os.path.join(self.dir, "metrics.json"))
+        return snap or {}
+
+    def reset_max_queue(self) -> None:
+        # Worker-side peak reset rides the stop-free control file.
+        _write_json_atomic(
+            os.path.join(self.dir, f"reset-{self._next_seq}.json"), {}
+        )
+
+    def stop(self, timeout_s: float = 60.0) -> bool:
+        self._running = False
+        if self._failed or self.proc.poll() is not None:
+            return True
+        _write_json_atomic(os.path.join(self.dir, "stop"), {})
+        try:
+            self.proc.wait(timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            return False
+        # Final worker state lands before exit; fold it in.
+        data = _read_json(os.path.join(self.dir, "stats.json"))
+        if data is not None:
+            self._stats_cache = data
+        return True
+
+
+class MeshRouter:
+    """Routes an open-loop request stream across serving hosts (module
+    docstring).  Duck-types the single-server surface the load
+    generator drives: submit / wait_for / stats / reset_max_queue /
+    metrics_text."""
+
+    def __init__(self, hosts: Sequence, metrics: bool = True):
+        if not hosts:
+            raise InputError("MeshRouter needs at least one host")
+        self._hosts = list(hosts)
+        self._lock = threading.Condition()
+        self._admit_lock = threading.Lock()
+        self._rr = 0
+        self._submitted = 0
+        self._shed = 0          # router-global sheds (all hosts full)
+        self._lost_shed = 0     # dead-host in-flight answered as shed
+        self._rerouted = 0      # primary dead, survivor accepted
+        self._swaps = 0
+        self._lost: set = set()
+        self._shedding = False
+        self._obs = metrics
+        self.registry = MetricsRegistry()
+        reg = self.registry
+        self._m_submitted = reg.counter(
+            "fa_mesh_submitted_total", "requests routed by the mesh"
+        )
+        self._m_shed = reg.counter(
+            "fa_mesh_shed_total",
+            "requests shed at the router (every live host refused)",
+        )
+        self._m_lost = reg.counter(
+            "fa_mesh_lost_shed_total",
+            "dead-host in-flight requests answered as sheds",
+        )
+        self._m_rerouted = reg.counter(
+            "fa_mesh_rerouted_total",
+            "requests rerouted off a dead primary host",
+        )
+        self._m_swaps = reg.counter(
+            "fa_mesh_swaps_total", "mesh-wide hot-swap barriers"
+        )
+        self._m_hosts = reg.gauge(
+            "fa_mesh_hosts_live", "live serving hosts"
+        )
+        self._m_hosts.set(len(self._hosts))
+        self._running = True
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fa-mesh-monitor",
+            daemon=True,
+        )
+        self._monitor.start()
+
+    # -- routing --------------------------------------------------------
+    def submit(
+        self,
+        tokens: Sequence[str],
+        t_sched: Optional[float] = None,
+    ) -> ServeRequest:
+        """Round-robin admission with pod-local spill; global shed only
+        when every live host refused (counted once, HERE)."""
+        with self._admit_lock:
+            with self._lock:
+                self._submitted += 1
+                if self._obs:
+                    self._m_submitted.inc()
+                primary = self._rr % len(self._hosts)
+                self._rr += 1
+            rerouted = False
+            for idx in spill_order(primary, len(self._hosts)):
+                host = self._hosts[idx]
+                if host.name in self._lost:
+                    if idx == primary:
+                        rerouted = True
+                    continue
+                if not host.alive():
+                    self._on_host_lost(host)
+                    if idx == primary:
+                        rerouted = True
+                    continue
+                req = host.try_submit(tokens, t_sched)
+                if req is not None:
+                    if rerouted:
+                        with self._lock:
+                            self._rerouted += 1
+                            if self._obs:
+                                self._m_rerouted.inc()
+                    if self._shedding:
+                        self._shedding = False
+                    return req
+            return self._shed_global(tokens, t_sched)
+
+    def _shed_global(self, tokens, t_sched) -> ServeRequest:
+        now = time.monotonic()
+        req = ServeRequest(list(tokens), t_sched, now)
+        req.item = "0"
+        req.shed = True
+        req.t_done = now
+        with self._lock:
+            self._shed += 1
+            if self._obs:
+                self._m_shed.inc()
+        if not self._shedding:
+            self._shedding = True
+            watchdog.downgrade(
+                "serving", "accept", "shed",
+                reason="mesh_queue_full",
+                once_key="mesh:accept>shed",
+                hosts=len(self._hosts),
+                lost=len(self._lost),
+            )
+        return req
+
+    # -- failure detector -----------------------------------------------
+    def _monitor_loop(self) -> None:
+        interval = max(quorum.heartbeat_ms() / 1e3, 0.02)
+        while self._running:
+            for host in self._hosts:
+                if host.name not in self._lost and not host.alive():
+                    self._on_host_lost(host)
+            time.sleep(interval)
+
+    def _on_host_lost(self, host) -> None:
+        with self._lock:
+            if host.name in self._lost:
+                return
+            self._lost.add(host.name)
+            live = len(self._hosts) - len(self._lost)
+        watchdog.downgrade(
+            "serve_mesh", "full", "degraded",
+            reason="host_lost",
+            once_key=f"serve_mesh:{host.name}",
+            host=host.name,
+            survivors=live,
+        )
+        n = host.fail_outstanding()
+        with self._lock:
+            self._lost_shed += n
+            self._shed += n
+            if self._obs:
+                self._m_lost.inc(n)
+                self._m_shed.inc(n)
+                self._m_hosts.set(live)
+            self._lock.notify_all()
+        ledger.record(
+            "serve_host_lost",
+            once_key=f"host:{host.name}",
+            host=host.name,
+            survivors=live,
+            inflight_shed=n,
+        )
+        if live == 0:
+            # Total mesh loss: admission flips to permanent global
+            # shed; the downgrade above already recorded degraded.
+            ledger.record(
+                "serve_mesh_empty", once_key="serve_mesh_empty"
+            )
+
+    # -- waiting / swap -------------------------------------------------
+    def wait_for(
+        self, reqs: Sequence[ServeRequest], timeout_s: float = 30.0
+    ) -> bool:
+        """Bounded completion wait.  Polls: requests complete on host
+        threads (LocalHost) or the poller (ProcHost); the monitor
+        answers a dead host's share — every path sets ``t_done``, so
+        this converges or times out, never hangs."""
+        deadline = time.monotonic() + timeout_s
+        while not all(r.done for r in reqs):
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def swap(
+        self, payloads: Sequence, timeout_s: Optional[float] = None
+    ) -> bool:
+        """Mesh-wide hot-swap, barrier-ordered across hosts: admission
+        is held while every live host enqueues its barrier marker (so a
+        request admitted after this returns is served by the new model
+        on whichever host it lands), then all barriers are awaited,
+        bounded.  ``payloads[i]`` is host i's swap payload — a
+        ServingState for a LocalHost, a checkpoint prefix for a
+        ProcHost."""
+        if len(payloads) != len(self._hosts):
+            raise InputError(
+                f"swap needs one payload per host "
+                f"({len(payloads)} != {len(self._hosts)})"
+            )
+        bound = (
+            quorum.quorum_timeout_s() if timeout_s is None else timeout_s
+        )
+        events = []
+        with self._admit_lock:
+            for host, payload in zip(self._hosts, payloads):
+                if host.name in self._lost:
+                    continue
+                events.append(host.swap(payload))
+        deadline = time.monotonic() + bound
+        ok = True
+        for ev in events:
+            ok = ev.wait(max(deadline - time.monotonic(), 0.001)) and ok
+        with self._lock:
+            self._swaps += 1
+            if self._obs:
+                self._m_swaps.inc()
+        ledger.record("serve_mesh_swap", hosts=len(events), ok=ok)
+        return ok
+
+    # -- observability ---------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        """The mesh-merged snapshot (counters sum, gauges max,
+        histograms bucket-wise add) of every host registry plus the
+        router's own instruments."""
+        snaps = [self.registry.snapshot()]
+        snaps += [h.metrics_snapshot() for h in self._hosts]
+        return obs_metrics.merge_snapshots(snaps)
+
+    def metrics_text(self) -> str:
+        """One scrapeable Prometheus text for the whole mesh."""
+        return obs_metrics.render_snapshot(self.metrics_snapshot())
+
+    def stats(self) -> dict:
+        per_host = []
+        served = batches = shed_hosts = submitted_hosts = 0
+        max_queue = 0
+        for h in self._hosts:
+            s = h.stats()
+            per_host.append(
+                {"host": h.name, "lost": h.name in self._lost, **s}
+            )
+            served += s.get("served", 0)
+            batches += s.get("batches", 0)
+            shed_hosts += s.get("shed", 0)
+            submitted_hosts += s.get("submitted", 0)
+            max_queue = max(max_queue, s.get("max_queue", 0))
+        with self._lock:
+            return {
+                "hosts": len(self._hosts),
+                "hosts_lost": len(self._lost),
+                "submitted": self._submitted,
+                "served": served,
+                "shed": self._shed + shed_hosts,
+                "router_shed": self._shed,
+                "lost_shed": self._lost_shed,
+                "rerouted": self._rerouted,
+                "swaps": self._swaps,
+                "batches": batches,
+                "max_queue": max_queue,
+                "per_host": per_host,
+            }
+
+    def reset_max_queue(self) -> None:
+        for h in self._hosts:
+            if h.name not in self._lost:
+                h.reset_max_queue()
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        for h in self._hosts:
+            if h.name in self._lost:
+                continue
+            if isinstance(h, LocalHost):
+                if not h.server.drain(
+                    max(deadline - time.monotonic(), 0.001)
+                ):
+                    return False
+        return True
+
+    def stop(self, timeout_s: float = 60.0) -> bool:
+        self._running = False
+        ok = True
+        for h in self._hosts:
+            ok = h.stop(timeout_s=timeout_s) and ok
+        return ok
+
+
+# ---------------------------------------------------------------------
+# Worker process: one serving host behind the file protocol.
+# ---------------------------------------------------------------------
+
+def _worker_serve(args) -> int:
+    from fastapriori_tpu.obs import trace
+
+    trace.maybe_enable(explicit=False)
+    state = ServingState.load(args.serving, engine=args.engine)
+    server = RecommendServer(
+        state,
+        batch_rows=args.batch_rows or None,
+        linger_ms=None if args.linger_ms < 0 else args.linger_ms,
+        queue_depth=args.queue_depth or None,
+        pipeline_depth=args.pipeline_depth,
+    ).start()
+    d = args.dir
+    hb_s = quorum.heartbeat_ms() / 1e3
+
+    def _publish() -> None:
+        # lint: waive G009 -- heartbeat tmp + os.replace below is the atomic pair; a torn hb is unreadable-as-float and skipped by the poller
+        with open(os.path.join(d, "hb.tmp"), "w") as f:
+            f.write(str(time.time()))
+        os.replace(os.path.join(d, "hb.tmp"), os.path.join(d, "hb"))
+        snap = server.metrics_snapshot()
+        _write_json_atomic(
+            os.path.join(d, "metrics.json"),
+            {**snap["server"], **snap["global"]},
+        )
+        _write_json_atomic(os.path.join(d, "stats.json"), server.stats())
+
+    _publish()
+    _write_json_atomic(
+        os.path.join(d, "ready.json"),
+        {"signature": state.signature, "pid": os.getpid()},
+    )
+    processed: set = set()
+    outstanding: deque = deque()  # (seq, ids, reqs)
+    swaps_pending: Dict[int, object] = {}  # seq -> (event, signature)
+    last_hb = time.monotonic()
+    stopping = False
+    while True:
+        now = time.monotonic()
+        if now - last_hb >= hb_s:
+            last_hb = now
+            _publish()
+        progressed = False
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            names = []
+        for fn in names:
+            if fn.startswith("req-") and fn.endswith(".json"):
+                seq = int(fn[4:-5])
+                if seq in processed:
+                    continue
+                data = _read_json(os.path.join(d, fn))
+                if data is None:
+                    continue
+                processed.add(seq)
+                reqs = [server.submit(b) for b in data["baskets"]]
+                outstanding.append((seq, data["ids"], reqs))
+                progressed = True
+            elif fn.startswith("swap-") and fn.endswith(".json"):
+                seq = int(fn[5:-5])
+                if seq in processed:
+                    continue
+                data = _read_json(os.path.join(d, fn))
+                if data is None:
+                    continue
+                processed.add(seq)
+                new_state = ServingState.load(
+                    data["prefix"], engine=args.engine
+                )
+                ev = server.swap(new_state)
+                swaps_pending[seq] = (ev, new_state.signature)
+                progressed = True
+            elif fn.startswith("reset-"):
+                server.reset_max_queue()
+                try:
+                    os.remove(os.path.join(d, fn))
+                except OSError:
+                    pass
+        while outstanding and all(r.done for r in outstanding[0][2]):
+            seq, ids, reqs = outstanding.popleft()
+            _write_json_atomic(
+                os.path.join(d, f"rsp-{seq:08d}.json"),
+                {
+                    "ids": ids,
+                    "items": [r.item for r in reqs],
+                    "models": [r.model for r in reqs],
+                    "shed": [bool(r.shed) for r in reqs],
+                },
+            )
+            # Counters must be current the moment the response is
+            # visible — a scrape at drain is exact, not hb-stale.
+            _publish()
+            last_hb = time.monotonic()
+            progressed = True
+        for seq in list(swaps_pending):
+            ev, sig = swaps_pending[seq]
+            if ev.is_set():
+                del swaps_pending[seq]
+                _write_json_atomic(
+                    os.path.join(d, f"swapped-{seq:08d}.json"),
+                    {"to": sig},
+                )
+                progressed = True
+        if os.path.exists(os.path.join(d, "stop")):
+            if not stopping:
+                stopping = True
+            if not outstanding and not swaps_pending:
+                break
+        if not progressed:
+            time.sleep(0.002)
+    server.stop(drain=True)
+    _publish()
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="fastapriori_tpu.serve.router",
+        description="serving-mesh worker host (spawned by ProcHost)",
+    )
+    p.add_argument("--worker", action="store_true", required=True)
+    p.add_argument("--dir", required=True)
+    p.add_argument("--serving", required=True,
+                   help="checkpoint prefix to serve from")
+    p.add_argument("--engine", default="auto")
+    p.add_argument("--batch-rows", type=int, default=0)
+    p.add_argument("--linger-ms", type=float, default=-1.0)
+    p.add_argument("--queue-depth", type=int, default=0)
+    p.add_argument("--pipeline-depth", type=int, default=None)
+    args = p.parse_args(argv)
+    return _worker_serve(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

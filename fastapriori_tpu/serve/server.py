@@ -1,10 +1,10 @@
 """RecommendServer — the resident, admission-controlled request loop
-(ISSUE 10 tentpole).
+(ISSUE 10 tentpole; two-stage pipelined dispatcher, ISSUE 19).
 
-One dispatcher thread turns an open-loop request stream into the fixed-
-shape micro-batches the device scan serves best (arxiv 1309.0215's
-pipelined micro-batching, with the buffer/latency trade-off as two
-explicit knobs):
+The dispatcher turns an open-loop request stream into the fixed-shape
+micro-batches the device scan serves best (arxiv 1309.0215's pipelined
+micro-batching, with the buffer/latency trade-off as two explicit
+knobs):
 
 - **batch_rows** (``config.rec_batch_rows`` / ``FA_REC_BATCH``): the
   micro-batch size — throughput side.  The dispatcher collects at most
@@ -24,11 +24,29 @@ unbounded queue, and a shed run can never masquerade as a clean one.
 :meth:`submit_wait` is the closed-loop flavor (file/stdin sources):
 bounded blocking for space instead of shedding.
 
+**Two-stage pipeline** (``FA_SERVE_PIPELINE_DEPTH``, default 2).  The
+PR 10 dispatcher pipelined one-deep: host-side dedup/pack serialized
+against the device scan, so sustained acceptance stalled at ~0.67× the
+closed-batch capacity.  At depth >= 1 the dispatcher splits into two
+threads joined by a bounded hand-off ring: **stage 1**
+(``fa-serve-pack``) collects + dedups + packs batch k+1 into fixed-
+shape bitmap blocks (:meth:`ServingState.pack_batch`, pure host work)
+while **stage 2** (``fa-serve-dispatch``) is still inside batch k's
+device scan fetch (:meth:`ServingState.scan_packed`) — the scan kernel
+never waits on host work.  The ring holds at most ``pipeline_depth``
+batches (double-buffered at the default 2); a full ring back-pressures
+the pack stage, it never grows.  Depth 0 keeps the serial one-thread
+loop (the one-deep baseline — the serve bench's pipelining control).
+
 **Hot-swap.**  :meth:`swap` enqueues a barrier marker: every request
 enqueued before it is served by the OLD state (a batch never straddles
 the marker), requests after it by the new — responses never mix tables
 (test-pinned via model signatures).  The old state is released at the
-barrier.
+barrier.  Under the pipeline the marker rides queue → ring in FIFO
+order and the PACK-side state pointer advances when the marker is
+forwarded, so post-barrier batches pack (and are then scanned) against
+the incoming model while pre-barrier batches — pinned to the old state
+at pack time — finish ahead of them.
 
 The scan fetches inside the state are the standard audited sites
 (``fetch.serve_match`` → retry + dispatch watchdog), so a wedged device
@@ -48,11 +66,37 @@ from fastapriori_tpu.obs import metrics as obs_metrics
 from fastapriori_tpu.obs import trace
 from fastapriori_tpu.obs.metrics import MetricsRegistry
 from fastapriori_tpu.reliability import ledger, watchdog
-from fastapriori_tpu.serve.state import ServingState
+from fastapriori_tpu.serve.state import PackedBatch, ServingState
+from fastapriori_tpu.utils.env import env_int
 
 # Batch-fill histogram bounds: pow2 rows up to the largest bucketed
 # micro-batch (models/recommender.py bucket_batch_rows ceiling is 4096).
 _FILL_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# Pack-stage shutdown sentinel: pushed to the ring after the last drained
+# batch so the scan stage exits in order.
+_STOP = object()
+
+_PIPELINE_DEPTH: Optional[int] = None
+
+
+def pipeline_depth_from_env() -> int:
+    """``FA_SERVE_PIPELINE_DEPTH`` — hand-off ring capacity between the
+    pack stage and the scan stage of the two-stage dispatcher.  0 = the
+    serial one-thread dispatcher (the one-deep PR 10 baseline, kept as
+    the serve bench's pipelining control); >= 1 pipelines, double-
+    buffered at the default 2.  Strict int >= 0 — a typo'd value raises
+    InputError rather than silently serving serial."""
+    global _PIPELINE_DEPTH
+    if _PIPELINE_DEPTH is None:
+        _PIPELINE_DEPTH = env_int("FA_SERVE_PIPELINE_DEPTH", 2, minimum=0)
+    return _PIPELINE_DEPTH
+
+
+def reload_from_env() -> None:
+    """Drop the memoized knob reads (tests repoint the environment)."""
+    global _PIPELINE_DEPTH
+    _PIPELINE_DEPTH = None
 
 
 class ServeRequest:
@@ -102,6 +146,7 @@ class RecommendServer:
         linger_ms: Optional[float] = None,
         queue_depth: Optional[int] = None,
         metrics: bool = True,
+        pipeline_depth: Optional[int] = None,
     ):
         from fastapriori_tpu.models.recommender import bucket_batch_rows
 
@@ -116,6 +161,13 @@ class RecommendServer:
         ) / 1e3
         depth = queue_depth if queue_depth else cfg.serve_queue_depth
         self._depth = int(depth) if depth else 4 * self._batch_rows
+        if pipeline_depth is None:
+            pipeline_depth = pipeline_depth_from_env()
+        if pipeline_depth < 0:
+            raise InputError(
+                f"pipeline_depth must be >= 0, got {pipeline_depth}"
+            )
+        self._pipeline_depth = int(pipeline_depth)
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._running = False
@@ -123,6 +175,15 @@ class RecommendServer:
         self._thread: Optional[threading.Thread] = None
         self._shedding = False
         self._pending_swaps = 0  # markers riding the queue
+        # Two-stage hand-off ring (pipeline_depth >= 1): stage 1 packs
+        # into it, stage 2 drains it in FIFO order; bounded, so a slow
+        # scan back-pressures packing instead of buffering unboundedly.
+        self._ring: deque = deque()
+        self._ring_cond = threading.Condition()
+        self._ring_cap = max(self._pipeline_depth, 1)
+        self._ring_peak = 0
+        self._pack_state = state  # stage-1 model pointer (pack thread)
+        self._pack_thread: Optional[threading.Thread] = None
         # Counters (under _cond).
         self._submitted = 0
         self._served = 0
@@ -159,6 +220,10 @@ class RecommendServer:
         self._m_queue = reg.gauge(
             "fa_serve_queue_depth", "admission queue depth (and peak)"
         )
+        self._m_ring = reg.gauge(
+            "fa_serve_ring_depth",
+            "pack-to-scan hand-off ring depth (and peak)",
+        )
         self._m_fill = reg.histogram(
             "fa_serve_batch_fill", _FILL_BUCKETS,
             "rows per dispatched micro-batch",
@@ -186,28 +251,53 @@ class RecommendServer:
         if warm:
             self._state.warm()
         self._running = True
-        self._thread = threading.Thread(
-            target=self._dispatch_loop, name="fa-serve-dispatch",
-            daemon=True,
-        )
+        self._pack_state = self._state
+        if self._pipeline_depth > 0:
+            # Two-stage pipeline: pack thread feeds the bounded ring,
+            # dispatch thread consumes it (thread names key the
+            # tracer's per-stage root spans).
+            self._pack_thread = threading.Thread(
+                target=self._pack_loop, name="fa-serve-pack",
+                daemon=True,
+            )
+            self._pack_thread.start()
+            self._thread = threading.Thread(
+                target=self._scan_loop, name="fa-serve-dispatch",
+                daemon=True,
+            )
+        else:
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="fa-serve-dispatch",
+                daemon=True,
+            )
         self._thread.start()
         return self
 
     def stop(self, drain: bool = True, timeout_s: float = 30.0) -> bool:
         """Stop the dispatcher (optionally draining queued work first,
-        bounded).  Returns True when the thread exited inside the
-        bound — callers assert it, so a wedged dispatcher is a loud
+        bounded).  Returns True when every stage thread exited inside
+        the bound — callers assert it, so a wedged dispatcher is a loud
         failure, not a leaked zombie."""
         if drain:
             self.drain(timeout_s=timeout_s)
         with self._cond:
             self._running = False
             self._cond.notify_all()
+        with self._ring_cond:
+            self._ring_cond.notify_all()
+        deadline = time.monotonic() + timeout_s
+        ok = True
+        for t in (self._pack_thread, self._thread):
+            if t is not None:
+                t.join(max(deadline - time.monotonic(), 0.001))
+                ok = ok and not t.is_alive()
+        return ok
+
+    def alive(self) -> bool:
+        """Liveness probe for the mesh router's failure detector: the
+        scan-stage dispatcher thread is still serving."""
         t = self._thread
-        if t is not None:
-            t.join(timeout_s)
-            return not t.is_alive()
-        return True
+        return bool(self._running and t is not None and t.is_alive())
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Wait (bounded) until the queue is empty and nothing is in
@@ -240,6 +330,36 @@ class RecommendServer:
                 return self._shed_locked(req, now)
             if self._shedding:
                 self._shedding = False  # overload episode over
+            self._q.append(req)
+            depth = len(self._q)
+            if self._obs:
+                self._m_queue.set(depth)
+            if depth > self._max_depth:
+                self._max_depth = depth
+            self._cond.notify_all()
+        return req
+
+    def try_submit(
+        self,
+        tokens: Sequence[str],
+        t_sched: Optional[float] = None,
+    ) -> Optional[ServeRequest]:
+        """Mesh-router admission probe (serve/router.py): enqueue like
+        :meth:`submit`, but return None — counting nothing — when the
+        queue is full or the server stopped.  The router spills the
+        request to another host first and sheds GLOBALLY only when every
+        host refused, so a spilled request never double-counts in
+        per-host submitted/shed."""
+        now = time.monotonic()
+        with self._cond:
+            if not self._running or len(self._q) >= self._depth:
+                return None
+            req = ServeRequest(tokens, t_sched, now)
+            self._submitted += 1
+            if self._obs:
+                self._m_submitted.inc()
+            if self._shedding:
+                self._shedding = False
             self._q.append(req)
             depth = len(self._q)
             if self._obs:
@@ -377,97 +497,194 @@ class RecommendServer:
             self._in_flight += len(batch)
             return batch
 
+    def _commit_swap(self, marker: _SwapMarker) -> None:
+        """Commit a hot-swap barrier on the scan stage: repoint the
+        serving state, ledger the transition, release the outgoing
+        model, wake the barrier waiters."""
+        old = self._state
+        marker.state.set_batch_rows(self._batch_rows)
+        self._state = marker.state
+        self._swaps += 1
+        if self._obs:
+            self._m_swaps.inc()
+            self._m_swap_ms.observe(
+                (time.monotonic() - marker.t_enq) * 1e3
+            )
+        ledger.record(
+            "serve_swap",
+            once_key=marker.state.signature,
+            frm=old.signature,
+            to=marker.state.signature,
+        )
+        if marker.release_old:
+            old.release()
+        marker.event.set()
+        with self._cond:
+            self._in_flight -= 1
+            self._cond.notify_all()
+
+    def _serve_batch(
+        self,
+        batch: list,
+        packed: Optional[PackedBatch],
+        state: ServingState,
+    ) -> None:
+        """Serve one collected micro-batch and complete its requests.
+        ``state`` is the model the batch is pinned to (the pack-time
+        pointer under the pipeline; ``self._state`` on the serial
+        path); ``packed`` is its stage-1 output, or None to run the
+        whole unsplit path here."""
+        t0 = time.monotonic()
+        # The per-batch span is the serving trace's unit of work:
+        # its children (serve.dedup / serve.pack on the pack stage,
+        # serve.scan here) separate host time from device time, the
+        # admission wait rides as an annotation, and the queue/shed
+        # counter track samples at batch rate.
+        with trace.span("serve.batch", rows=len(batch)) as sp:
+            sp.update(
+                admission_wait_ms=round(
+                    (t0 - batch[0].t_enq) * 1e3, 3
+                )
+            )
+            try:
+                if packed is not None:
+                    items = state.scan_packed(packed)
+                else:
+                    items = state.recommend_batch(
+                        [r.tokens for r in batch]
+                    )
+            # The dispatcher must survive anything the scan raises past
+            # its own cascade (a fatal error serves "0" to THIS batch,
+            # classified on the ledger; the next batch gets a fresh
+            # attempt) — a dead dispatcher would hang every later
+            # waiter, the one outcome the serving tier forbids.
+            # lint: waive G006 -- answered "0" + ledger serve_error; next batch retries
+            except Exception as exc:
+                ledger.record(
+                    "serve_error",
+                    once_key=type(exc).__name__,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                    rows=len(batch),
+                )
+                items = ["0"] * len(batch)
+                if self._obs:
+                    self._m_errors.inc()
+            now = time.monotonic()
+            sig = state.signature
+            with trace.span("serve.respond", rows=len(batch)):
+                with self._cond:
+                    for r, item in zip(batch, items):
+                        r.item = item
+                        r.model = sig
+                        r.t_done = now
+                    self._served += len(batch)
+                    self._batches += 1
+                    self._batch_rows_served += len(batch)
+                    self._scan_wall_s += now - t0
+                    self._in_flight -= len(batch)
+                    depth = len(self._q)
+                    shed = self._shed
+                    # Registry updates BEFORE the waiters wake: a
+                    # scrape racing wait_for() must never see the
+                    # last batch missing from the instruments (the
+                    # bench cross-check compares them to loadgen's
+                    # own counts; cheap int adds under the lock).
+                    if self._obs:
+                        self._m_served.inc(len(batch))
+                        self._m_fill.observe(len(batch))
+                        self._m_linger.observe(
+                            (t0 - batch[0].t_enq) * 1e3
+                        )
+                        self._m_batch_ms.observe((now - t0) * 1e3)
+                        self._m_queue.set(depth)
+                    self._cond.notify_all()
+            trace.counter("serve_queue", depth=depth, shed=shed)
+
     def _dispatch_loop(self) -> None:
+        """Serial (pipeline_depth=0) dispatcher: collect, scan, respond
+        on one thread — the one-deep baseline."""
         while True:
             batch = self._collect_batch()
             if batch is None:
                 return
             if len(batch) == 1 and isinstance(batch[0], _SwapMarker):
-                marker = batch[0]
-                old = self._state
-                marker.state.set_batch_rows(self._batch_rows)
-                self._state = marker.state
-                self._swaps += 1
-                if self._obs:
-                    self._m_swaps.inc()
-                    self._m_swap_ms.observe(
-                        (time.monotonic() - marker.t_enq) * 1e3
-                    )
-                ledger.record(
-                    "serve_swap",
-                    once_key=marker.state.signature,
-                    frm=old.signature,
-                    to=marker.state.signature,
-                )
-                if marker.release_old:
-                    old.release()
-                marker.event.set()
-                with self._cond:
-                    self._in_flight -= 1
-                    self._cond.notify_all()
+                self._commit_swap(batch[0])
                 continue
-            t0 = time.monotonic()
-            # The per-batch span is the serving trace's unit of work:
-            # its children (serve.dedup / serve.pack / serve.scan,
-            # opened inside recommend_batch) separate host time from
-            # device time, the admission wait rides as an annotation,
-            # and the queue/shed counter track samples at batch rate.
-            with trace.span("serve.batch", rows=len(batch)) as sp:
-                sp.update(
-                    admission_wait_ms=round(
-                        (t0 - batch[0].t_enq) * 1e3, 3
-                    )
-                )
+            self._serve_batch(batch, None, self._state)
+
+    # -- two-stage pipeline (pipeline_depth >= 1) -----------------------
+    def _ring_push(self, item) -> None:
+        """Bounded hand-off: block while the ring is at capacity (the
+        back-pressure that keeps the pipeline's buffering at
+        pipeline_depth batches); sentinel and shutdown pushes always
+        land so the scan stage drains in order."""
+        with self._ring_cond:
+            while (
+                self._running
+                and item is not _STOP
+                and len(self._ring) >= self._ring_cap
+            ):
+                self._ring_cond.wait(0.05)
+            self._ring.append(item)
+            depth = len(self._ring)
+            if depth > self._ring_peak:
+                self._ring_peak = depth
+            if self._obs:
+                self._m_ring.set(depth)
+            self._ring_cond.notify_all()
+
+    def _pack_loop(self) -> None:
+        """Stage 1: collect + dedup + bitmap-pack micro-batches on the
+        host while stage 2 scans the previous ones.  A swap marker
+        advances the pack-side model pointer immediately — later
+        batches pack against the incoming model; the marker itself
+        commits downstream in ring order, behind every batch pinned to
+        the old state."""
+        try:
+            while True:
+                batch = self._collect_batch()
+                if batch is None:
+                    return
+                if len(batch) == 1 and isinstance(batch[0], _SwapMarker):
+                    marker = batch[0]
+                    marker.state.set_batch_rows(self._batch_rows)
+                    self._pack_state = marker.state
+                    self._ring_push(marker)
+                    continue
+                state = self._pack_state
                 try:
-                    items = self._state.recommend_batch(
+                    packed = state.pack_batch(
                         [r.tokens for r in batch]
                     )
-                # The dispatcher must survive anything recommend_batch
-                # raises past its own cascade (a fatal error serves "0" to
-                # THIS batch, classified on the ledger; the next batch gets
-                # a fresh attempt) — a dead dispatcher would hang every
-                # later waiter, the one outcome the serving tier forbids.
-                # lint: waive G006 -- answered "0" + ledger serve_error; next batch retries
-                except Exception as exc:
-                    ledger.record(
-                        "serve_error",
-                        once_key=type(exc).__name__,
-                        error=f"{type(exc).__name__}: {exc}"[:200],
-                        rows=len(batch),
-                    )
-                    items = ["0"] * len(batch)
-                    if self._obs:
-                        self._m_errors.inc()
-                now = time.monotonic()
-                sig = self._state.signature
-                with trace.span("serve.respond", rows=len(batch)):
-                    with self._cond:
-                        for r, item in zip(batch, items):
-                            r.item = item
-                            r.model = sig
-                            r.t_done = now
-                        self._served += len(batch)
-                        self._batches += 1
-                        self._batch_rows_served += len(batch)
-                        self._scan_wall_s += now - t0
-                        self._in_flight -= len(batch)
-                        depth = len(self._q)
-                        shed = self._shed
-                        # Registry updates BEFORE the waiters wake: a
-                        # scrape racing wait_for() must never see the
-                        # last batch missing from the instruments (the
-                        # bench cross-check compares them to loadgen's
-                        # own counts; cheap int adds under the lock).
-                        if self._obs:
-                            self._m_served.inc(len(batch))
-                            self._m_fill.observe(len(batch))
-                            self._m_linger.observe(
-                                (t0 - batch[0].t_enq) * 1e3
-                            )
-                            self._m_batch_ms.observe((now - t0) * 1e3)
-                            self._m_queue.set(depth)
-                        self._cond.notify_all()
-                trace.counter("serve_queue", depth=depth, shed=shed)
+                # A failed pack replays in stage 2: scan_packed-less
+                # batches run the whole unsplit path there, where the
+                # serve_error contract answers "0".
+                except Exception:  # lint: waive G006 -- pack failure replays on stage 2's unsplit path
+                    packed = None
+                self._ring_push((batch, packed, state))
+        finally:
+            # Always deliver the shutdown sentinel — even on a pack-
+            # thread crash — so stage 2 never waits on a dead feeder.
+            self._ring_push(_STOP)
+
+    def _scan_loop(self) -> None:
+        """Stage 2: drain the ring in FIFO order — swap barriers commit
+        between batches exactly as on the serial path."""
+        while True:
+            with self._ring_cond:
+                while not self._ring:
+                    self._ring_cond.wait(0.05)
+                item = self._ring.popleft()
+                if self._obs:
+                    self._m_ring.set(len(self._ring))
+                self._ring_cond.notify_all()
+            if item is _STOP:
+                return
+            if isinstance(item, _SwapMarker):
+                self._commit_swap(item)
+                continue
+            batch, packed, state = item
+            self._serve_batch(batch, packed, state)
 
     # -- observability --------------------------------------------------
     def metrics_text(self) -> str:
@@ -512,6 +729,8 @@ class RecommendServer:
                 "max_queue": self._max_depth,
                 "swaps": self._swaps,
                 "scan_wall_s": round(self._scan_wall_s, 3),
+                "pipeline_depth": self._pipeline_depth,
+                "ring_peak": self._ring_peak,
             }
         out["model"] = self._state.describe()
         return out

@@ -1267,6 +1267,7 @@ def test_cascade_chain_ordering_pinned():
         "elastic": ("continue", "abort"),
         "vertical_kernel": ("pallas", "xla"),
         "serve_scan": ("pallas", "xla"),
+        "serve_mesh": ("full", "degraded"),
     }
     assert watchdog.chain_rank("engine", "fused") == 0
     assert watchdog.chain_rank("engine", "level") == 2

@@ -260,8 +260,12 @@ def test_server_shed_mode_answers_zero_with_ledger_event():
     """The satellite contract: a full queue sheds with "0" + a serving
     cascade event — and never hangs (every wait here is bounded)."""
     st = _state()
+    # pipeline_depth=0: the serial dispatcher's absorption is exactly
+    # queue + one in-flight batch — the bound below; the hand-off ring
+    # would absorb pipeline_depth more batches, timing-dependently.
     server = RecommendServer(
-        st, batch_rows=32, linger_ms=0.0, queue_depth=8
+        st, batch_rows=32, linger_ms=0.0, queue_depth=8,
+        pipeline_depth=0,
     )
     # NOT started: the dispatcher never drains, so the 9th+ submits MUST
     # overflow deterministically... except submit on a stopped server

@@ -1,0 +1,471 @@
+"""Serving mesh + pipelined dispatcher (ISSUE 19): the request
+router's invariants — never a mixed-table response during a mesh
+hot-swap, exact shed accounting under overload, kill-a-host-mid-burst
+survivor continuation — plus the two-stage pipeline's correctness and
+the strict FA_SERVE_PIPELINE_DEPTH / FA_SERVE_HOSTS knobs, the
+pod-local spill order, and the mesh metrics merge/render helpers."""
+
+import threading
+import time
+
+import pytest
+
+from conftest import random_dataset, tokenized
+from fastapriori_tpu.config import MinerConfig
+from fastapriori_tpu.errors import InputError
+from fastapriori_tpu.models.apriori import FastApriori
+from fastapriori_tpu.obs import metrics as obs_metrics
+from fastapriori_tpu.parallel.hier import spill_order
+from fastapriori_tpu.preprocess import preprocess
+from fastapriori_tpu.reliability import failpoints, ledger
+from fastapriori_tpu.serve import (
+    LocalHost,
+    MeshRouter,
+    RecommendServer,
+    ServingState,
+)
+from fastapriori_tpu.serve import router as serve_router
+from fastapriori_tpu.serve import server as serve_server
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    failpoints.disarm_all()
+    ledger.reset()
+    serve_server.reload_from_env()
+    serve_router.reload_from_env()
+    yield
+    failpoints.disarm_all()
+    ledger.reset()
+    serve_server.reload_from_env()
+    serve_router.reload_from_env()
+
+
+def _state(seed=6, min_support=0.05, engine="auto", **cfg_kw):
+    d_lines = tokenized(random_dataset(seed, n_txns=250, max_len=8))
+    data = preprocess(d_lines, min_support)
+    cfg = MinerConfig(min_support=min_support, engine="level", **cfg_kw)
+    miner = FastApriori(config=cfg)
+    levels = miner.mine_levels_raw(data)
+    return ServingState(
+        levels, data.item_counts, data.freq_items, data.item_to_rank,
+        config=cfg, context=miner.context, engine=engine,
+    )
+
+
+U_LINES = tokenized(random_dataset(60, n_txns=200))
+
+
+def _gate_state(st):
+    """Block the state's batch path behind an event — the no-timing-
+    assumptions tool the single-server swap test established."""
+    gate = threading.Event()
+    orig = st.recommend_batch
+
+    def gated(lines):
+        gate.wait(30.0)
+        return orig(lines)
+
+    st.recommend_batch = gated
+    return gate
+
+
+# ---------------------------------------------------------------------------
+# spill_order
+
+
+def test_spill_order_flat_ring():
+    assert spill_order(0, 4) == [0, 1, 2, 3]
+    assert spill_order(2, 4) == [2, 3, 0, 1]
+    assert spill_order(0, 1) == [0]
+
+
+def test_spill_order_pod_local_first():
+    # 8 hosts in 2 groups of 4: the primary's pod drains before the
+    # ring crosses into the other pod.
+    order = spill_order(5, 8, groups=2)
+    assert order[:4] == [5, 6, 7, 4]  # pod {4..7}, ring from 5
+    assert sorted(order[4:]) == [0, 1, 2, 3]
+    # Every host appears exactly once regardless of grouping.
+    assert sorted(spill_order(3, 8, groups=4)) == list(range(8))
+
+
+def test_spill_order_primary_out_of_range():
+    with pytest.raises(InputError, match="primary"):
+        spill_order(4, 4)
+    with pytest.raises(InputError, match="primary"):
+        spill_order(-1, 4)
+
+
+# ---------------------------------------------------------------------------
+# Strict env knobs
+
+
+def test_pipeline_depth_env_strict(monkeypatch):
+    monkeypatch.setenv("FA_SERVE_PIPELINE_DEPTH", "3")
+    serve_server.reload_from_env()
+    assert serve_server.pipeline_depth_from_env() == 3
+    monkeypatch.setenv("FA_SERVE_PIPELINE_DEPTH", "0")
+    serve_server.reload_from_env()
+    assert serve_server.pipeline_depth_from_env() == 0
+    monkeypatch.setenv("FA_SERVE_PIPELINE_DEPTH", "deep")
+    serve_server.reload_from_env()
+    with pytest.raises(InputError, match="FA_SERVE_PIPELINE_DEPTH"):
+        serve_server.pipeline_depth_from_env()
+    monkeypatch.setenv("FA_SERVE_PIPELINE_DEPTH", "-1")
+    serve_server.reload_from_env()
+    with pytest.raises(InputError, match="FA_SERVE_PIPELINE_DEPTH"):
+        serve_server.pipeline_depth_from_env()
+
+
+def test_hosts_env_strict(monkeypatch):
+    monkeypatch.setenv("FA_SERVE_HOSTS", "4")
+    serve_router.reload_from_env()
+    assert serve_router.hosts_from_env() == 4
+    monkeypatch.setenv("FA_SERVE_HOSTS", "many")
+    serve_router.reload_from_env()
+    with pytest.raises(InputError, match="FA_SERVE_HOSTS"):
+        serve_router.hosts_from_env()
+    monkeypatch.setenv("FA_SERVE_HOSTS", "0")
+    serve_router.reload_from_env()
+    with pytest.raises(InputError, match="FA_SERVE_HOSTS"):
+        serve_router.hosts_from_env()
+
+
+def test_server_rejects_negative_pipeline_depth():
+    st = _state()
+    with pytest.raises(InputError, match="pipeline_depth"):
+        RecommendServer(st, pipeline_depth=-2)
+
+
+# ---------------------------------------------------------------------------
+# Two-stage pipelined dispatcher
+
+
+def test_pipelined_matches_serial_responses():
+    """The pipeline split must not change a single byte of output:
+    depth-2 (two-stage) responses == depth-0 (serial) responses ==
+    the closed-batch answers."""
+    expected = _state().recommend_batch(U_LINES)
+    for depth in (0, 2):
+        server = RecommendServer(
+            _state(), batch_rows=32, linger_ms=0.5, pipeline_depth=depth
+        ).start()
+        reqs = [server.submit_wait(t) for t in U_LINES]
+        assert server.wait_for(reqs, timeout_s=60.0)
+        assert [r.item for r in reqs] == expected, f"depth={depth}"
+        stats = server.stats()
+        assert stats["pipeline_depth"] == depth
+        assert server.stop()
+
+
+def test_pipelined_ring_actually_buffers():
+    """Under a gated scan the pack stage must run AHEAD of the scan
+    stage: the hand-off ring fills (ring_peak > 0) while stage 2 is
+    blocked — the overlap the two-stage split exists for."""
+    st = _state()
+    gate = _gate_state(st)
+    server = RecommendServer(
+        st, batch_rows=8, linger_ms=0.0, queue_depth=256,
+        pipeline_depth=2,
+    ).start(warm=False)
+    reqs = [server.submit(t) for t in U_LINES[:80]]
+    deadline = time.monotonic() + 10.0
+    while (
+        server.stats()["ring_peak"] < 1 and time.monotonic() < deadline
+    ):
+        time.sleep(0.005)
+    peak = server.stats()["ring_peak"]
+    gate.set()
+    assert server.wait_for(reqs, timeout_s=60.0)
+    assert peak >= 1
+    # Bounded hand-off: the ring never exceeded its configured depth.
+    assert server.stats()["ring_peak"] <= 2
+    assert server.stop()
+
+
+def test_pipelined_shed_conservation():
+    """Exact accounting through the pipeline: every submitted request
+    is served or shed, never both, never lost — even with the ring
+    buffering batches between the stages."""
+    st = _state()
+    gate = _gate_state(st)
+    server = RecommendServer(
+        st, batch_rows=8, linger_ms=0.0, queue_depth=16,
+        pipeline_depth=2,
+    ).start(warm=False)
+    reqs = [server.submit(t) for t in (U_LINES * 2)[:300]]
+    gate.set()
+    assert server.wait_for(reqs, timeout_s=60.0)
+    shed = sum(1 for r in reqs if r.shed)
+    served = sum(1 for r in reqs if not r.shed)
+    assert served + shed == 300
+    stats = server.stats()
+    assert stats["served"] == served
+    assert stats["shed"] == shed
+    assert stats["submitted"] == 300
+    assert server.stop()
+
+
+def test_pipelined_hot_swap_never_mixes_tables():
+    """The swap marker rides the queue AND the ring in FIFO order:
+    batches packed against the old state keep its signature even when
+    they scan after the swap commits."""
+    st_a, st_b = _state(seed=6), _state(seed=7)
+    assert st_a.signature != st_b.signature
+    gate = _gate_state(st_a)
+    server = RecommendServer(
+        st_a, batch_rows=16, linger_ms=0.0, pipeline_depth=2
+    ).start(warm=False)
+    before = [server.submit(t) for t in U_LINES[:50]]
+    ev = server.swap(st_b)
+    after = [server.submit(t) for t in U_LINES[:50]]
+    gate.set()
+    assert server.wait_for(before + after, timeout_s=60.0)
+    assert ev.wait(30.0)
+    assert {r.model for r in before} == {st_a.signature}
+    assert {r.model for r in after} == {st_b.signature}
+    assert server.stop()
+
+
+# ---------------------------------------------------------------------------
+# MeshRouter on LocalHosts
+
+
+def _mesh(n=2, seeds=None, gated=False, **server_kw):
+    seeds = seeds or [6] * n
+    states, gates, hosts = [], [], []
+    for i, seed in enumerate(seeds):
+        st = _state(seed=seed)
+        if gated:
+            gates.append(_gate_state(st))
+        states.append(st)
+        hosts.append(
+            LocalHost(
+                f"h{i}",
+                RecommendServer(st, **server_kw).start(warm=False),
+            )
+        )
+    return MeshRouter(hosts), hosts, states, gates
+
+
+def test_mesh_routes_and_aggregates():
+    expected = _state().recommend_batch(U_LINES)
+    mesh, hosts, _, _ = _mesh(2, batch_rows=32, linger_ms=0.5)
+    reqs = [mesh.submit(t) for t in U_LINES]
+    assert mesh.wait_for(reqs, timeout_s=60.0)
+    assert [r.item for r in reqs] == expected
+    st = mesh.stats()
+    assert st["served"] == len(U_LINES)
+    assert st["shed"] == 0
+    assert st["hosts"] == 2 and st["hosts_lost"] == 0
+    # Round-robin really spread the load: both hosts served.
+    per_host = {h["host"]: h["served"] for h in st["per_host"]}
+    assert all(v > 0 for v in per_host.values()), per_host
+    # Satellite 1: ONE merged scrape surface — per-host counters sum.
+    snap = mesh.metrics_snapshot()
+    assert snap["fa_serve_served_total"] == len(U_LINES)
+    text = mesh.metrics_text()
+    assert f"fa_serve_served_total {len(U_LINES)}" in text
+    assert "fa_mesh_submitted_total" in text
+    assert mesh.stop()
+
+
+def test_mesh_hot_swap_never_mixes_tables():
+    """The mesh swap barrier holds admission while EVERY live host
+    enqueues its marker: pre-swap requests carry the old signature,
+    post-swap ones the new — on whichever host they landed."""
+    mesh, hosts, states, gates = _mesh(
+        2, gated=True, batch_rows=16, linger_ms=0.0
+    )
+    old_sig = states[0].signature
+    new_states = [_state(seed=7), _state(seed=7)]
+    new_sig = new_states[0].signature
+    assert old_sig != new_sig
+    before = [mesh.submit(t) for t in U_LINES[:60]]
+
+    done = threading.Event()
+
+    def do_swap():
+        mesh.swap(new_states, timeout_s=60.0)
+        done.set()
+
+    swapper = threading.Thread(target=do_swap, daemon=True)
+    swapper.start()
+    # The swap call blocks on the gated scans; release them.
+    time.sleep(0.05)
+    for g in gates:
+        g.set()
+    assert done.wait(60.0)
+    after = [mesh.submit(t) for t in U_LINES[:60]]
+    assert mesh.wait_for(before + after, timeout_s=60.0)
+    assert {r.model for r in before} == {old_sig}
+    assert {r.model for r in after} == {new_sig}
+    assert mesh.stats()["swaps"] == 1
+    assert mesh.stop()
+
+
+def test_mesh_swap_payload_count_strict():
+    mesh, _, _, _ = _mesh(2, batch_rows=16)
+    with pytest.raises(InputError, match="payload"):
+        mesh.swap([_state(seed=7)])
+    assert mesh.stop()
+
+
+def test_mesh_exact_shed_accounting_under_overload():
+    """Global shed only when EVERY host refuses; each request counted
+    by exactly one host or by the router, never both — submitted ==
+    served + shed exactly, and the router's shed is the global
+    remainder after both hosts' queues and in-flight absorption."""
+    mesh, hosts, states, gates = _mesh(
+        2, gated=True, batch_rows=8, linger_ms=0.0, queue_depth=8,
+        pipeline_depth=2,
+    )
+    n = 400
+    reqs = [mesh.submit((U_LINES * 2)[i % len(U_LINES)]) for i in range(n)]
+    router_shed = sum(1 for r in reqs if r.done and r.shed)
+    assert router_shed > 0  # both tiny queues filled during the gate
+    for g in gates:
+        g.set()
+    assert mesh.wait_for(reqs, timeout_s=60.0)
+    served = sum(1 for r in reqs if not r.shed)
+    shed = sum(1 for r in reqs if r.shed)
+    assert served + shed == n
+    st = mesh.stats()
+    assert st["submitted"] == n
+    assert st["served"] == served
+    assert st["shed"] == shed
+    assert st["router_shed"] >= router_shed
+    # Host sheds + router sheds partition the shed total.
+    host_shed = sum(h["shed"] for h in st["per_host"])
+    assert host_shed + st["router_shed"] == shed
+    # Every overload episode walked the serving chain (once per
+    # episode — an accepted request between sheds closes an episode,
+    # so the interleaved gate can legally open several).
+    cascades = [
+        e for e in ledger.snapshot()
+        if e.get("kind") == "cascade" and e.get("chain") == "serving"
+        and e.get("reason") == "mesh_queue_full"
+    ]
+    assert len(cascades) >= 1
+    assert mesh.stop()
+
+
+def test_mesh_kill_host_mid_burst_survivors_serve():
+    """Abrupt host death mid-burst: the dead host's in-flight share
+    drains to the router as recorded sheds (lost_shed), survivors keep
+    serving byte-correct responses, the loss lands on the ledger as
+    serve_mesh full->degraded + serve_host_lost — and nothing hangs."""
+    expected = _state().recommend_batch(U_LINES)
+    mesh, hosts, states, gates = _mesh(
+        2, gated=True, batch_rows=16, linger_ms=0.0, queue_depth=256
+    )
+    reqs = []
+    for i in range(240):
+        reqs.append(mesh.submit(U_LINES[i % len(U_LINES)]))
+        if i == 90:
+            hosts[0].kill()
+    for g in gates:
+        g.set()
+    assert mesh.wait_for(reqs, timeout_s=60.0)
+    assert all(r.done for r in reqs)
+    st = mesh.stats()
+    assert st["hosts_lost"] == 1
+    assert st["lost_shed"] > 0
+    # Every non-shed response is correct (the survivor's table).
+    for i, r in enumerate(reqs):
+        if not r.shed:
+            assert r.item == expected[i % len(U_LINES)]
+    # Exact accounting across the death: LocalHost counters don't lag.
+    served = sum(1 for r in reqs if not r.shed)
+    shed = sum(1 for r in reqs if r.shed)
+    assert served + shed == 240
+    assert st["shed"] == shed
+    events = ledger.snapshot()
+    assert any(
+        e.get("kind") == "cascade" and e.get("chain") == "serve_mesh"
+        and e.get("to") == "degraded"
+        for e in events
+    )
+    assert any(e.get("kind") == "serve_host_lost" for e in events)
+    assert mesh.stop()
+
+
+def test_mesh_total_loss_sheds_globally():
+    """Killing every host flips admission to permanent global shed —
+    answered '0', counted at the router, serve_mesh_empty ledgered —
+    never an exception, never a hang."""
+    mesh, hosts, _, _ = _mesh(2, batch_rows=16, linger_ms=0.0)
+    warm = [mesh.submit(t) for t in U_LINES[:8]]
+    assert mesh.wait_for(warm, timeout_s=60.0)
+    for h in hosts:
+        h.kill()
+    deadline = time.monotonic() + 10.0
+    while (
+        mesh.stats()["hosts_lost"] < 2 and time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
+    assert mesh.stats()["hosts_lost"] == 2
+    reqs = [mesh.submit(t) for t in U_LINES[:10]]
+    assert all(r.done and r.shed and r.item == "0" for r in reqs)
+    assert any(
+        e.get("kind") == "serve_mesh_empty" for e in ledger.snapshot()
+    )
+    assert mesh.stop()
+
+
+# ---------------------------------------------------------------------------
+# Metrics merge / render (satellite 1)
+
+
+def test_merge_snapshots_counter_gauge_histogram():
+    a = obs_metrics.MetricsRegistry()
+    b = obs_metrics.MetricsRegistry()
+    a.counter("c").inc(3)
+    b.counter("c").inc(4)
+    a.gauge("g").set(5)
+    b.gauge("g").set(2)
+    b.gauge("g").set(1)  # b's max is 2, value 1
+    ha = a.histogram("h", bounds=(1.0, 10.0))
+    hb = b.histogram("h", bounds=(1.0, 10.0))
+    ha.observe(0.5)
+    ha.observe(5.0)
+    hb.observe(50.0)
+    merged = obs_metrics.merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["c"] == 7  # counters sum
+    assert merged["g"]["value"] == 5  # gauges max, not sum
+    assert merged["g"]["max"] == 5
+    assert merged["h"]["count"] == 3  # histograms add bucket-wise
+    assert merged["h"]["buckets"]["1"] == 1
+    assert merged["h"]["buckets"]["10"] == 1
+    assert merged["h"]["buckets"]["+Inf"] == 1
+    assert merged["h"]["sum"] == pytest.approx(55.5)
+
+
+def test_merge_snapshots_bucket_mismatch_raises():
+    a = obs_metrics.MetricsRegistry()
+    b = obs_metrics.MetricsRegistry()
+    a.histogram("h", bounds=(1.0, 10.0)).observe(1.0)
+    b.histogram("h", bounds=(2.0, 20.0)).observe(1.0)
+    with pytest.raises(ValueError, match="bucket"):
+        obs_metrics.merge_snapshots([a.snapshot(), b.snapshot()])
+
+
+def test_render_snapshot_prometheus_text():
+    a = obs_metrics.MetricsRegistry()
+    a.counter("fa_x_total", "things").inc(2)
+    a.gauge("fa_depth").set(3)
+    a.histogram("fa_lat_ms", bounds=(1.0, 10.0)).observe(0.5)
+    text = obs_metrics.render_snapshot(
+        a.snapshot(), helps={"fa_x_total": "things"}
+    )
+    assert "# TYPE fa_x_total counter" in text
+    assert "fa_x_total 2" in text
+    assert "# HELP fa_x_total things" in text
+    assert "fa_depth 3" in text
+    assert 'fa_lat_ms_bucket{le="1"} 1' in text
+    assert 'fa_lat_ms_bucket{le="+Inf"} 1' in text
+    assert "fa_lat_ms_count 1" in text
+    # Merged mesh snapshots render through the same path.
+    merged = obs_metrics.merge_snapshots([a.snapshot(), a.snapshot()])
+    assert "fa_x_total 4" in obs_metrics.render_snapshot(merged)

@@ -396,6 +396,13 @@ MP_KINDS = (
     "elastic_kill",
     "elastic_rendezvous",
     "elastic_exhaust",
+    # Serving-mesh host kill (ISSUE 19): subprocess serving hosts
+    # behind the request router; one dies abruptly mid-burst.  The
+    # survivors must keep serving CORRECT responses, the dead host's
+    # in-flight share must drain as recorded sheds (exact accounting),
+    # and the loss must land on the ledger (serve_mesh full->degraded
+    # + serve_host_lost) — never a hang, never a silent wrong answer.
+    "serve_kill",
 )
 
 # Divergence injections: a transient-exhaustion spec that walks ONE
@@ -489,6 +496,12 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
         # pairing with the dead rank's pre-abort payload.
         failpoints_by_rank[target] = "quorum.mine.wstotals:abort"
         epoch_retry_max = 1
+    elif kind == "serve_kill":
+        # Router-side kill (ISSUE 19): the fault is ProcHost.kill() in
+        # the serving scenario runner, not a mining failpoint — the
+        # engine/checkpoint/failpoint fields stay at their defaults and
+        # are ignored by run_serve_mesh_scenario.
+        pass
     else:  # elastic_exhaust (ISSUE 17)
         # Deaths past the budget must still END classified.  With
         # >= 3 ranks a double kill either coalesces into one absorbed
@@ -507,7 +520,7 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
         else:
             failpoints_by_rank[target] = "level.2:abort"
             epoch_retry_max = 0
-    return {
+    sched = {
         "seed": seed,
         "kind": kind,
         "procs": procs,
@@ -518,6 +531,12 @@ def make_mp_schedule(seed: int, procs: int) -> dict:
         "failpoints_by_rank": failpoints_by_rank,
         "epoch_retry_max": epoch_retry_max,
     }
+    if kind == "serve_kill":
+        # Router-side kill (ProcHost.kill), not a mining failpoint:
+        # the burst index the target host dies at, and the burst size.
+        sched["kill_at"] = rng.randint(40, 120)
+        sched["n_requests"] = 300
+    return sched
 
 
 def _spawn_rank(
@@ -627,6 +646,108 @@ _CLASSIFIED_MARKERS = (
 )
 
 
+def run_serve_mesh_scenario(
+    schedule: dict, inp: str, root: str, timeout_s: float
+) -> Outcome:
+    """Kill-a-serving-host-mid-burst on a real subprocess mesh
+    (ISSUE 19): ``procs`` ProcHost workers behind the router; the
+    target dies abruptly at ``kill_at``.  Invariants: every request
+    completes (never a hang), shed accounting is exact (one answer per
+    request — served or recorded shed, never both or neither),
+    survivors' responses stay byte-identical to the single-host
+    baseline, and the loss lands on the ledger (serve_mesh
+    full->degraded cascade + serve_host_lost)."""
+    from fastapriori_tpu.config import MinerConfig
+    from fastapriori_tpu.io.reader import tokenize_line
+    from fastapriori_tpu.reliability import ledger
+    from fastapriori_tpu.serve import MeshRouter, ProcHost, ServingState
+
+    procs = schedule["procs"]
+    target = schedule["target"] % procs
+    tag = f"serve{schedule['seed']}x{procs}"
+    base = os.path.join(root, tag)
+    os.makedirs(base, exist_ok=True)
+    detail = (
+        f"kind=serve_kill target=w{target} hosts={procs} "
+        f"kill_at={schedule['kill_at']}"
+    )
+    cfg = MinerConfig(min_support=0.08, retain_csr=False)
+    state = ServingState.from_mine(inp + "D.dat", config=cfg)
+    ckpt = os.path.join(base, "ckpt_")
+    state.save(ckpt)
+    with open(inp + "D.dat") as f:
+        pool = [tokenize_line(l) for l in f][:40]
+    baseline = state.recommend_batch(pool)
+    ledger.reset()
+    hosts = [
+        ProcHost(
+            f"w{i}", os.path.join(base, f"w{i}"), ckpt,
+            queue_depth=1024, env={"JAX_PLATFORMS": "cpu"},
+        )
+        for i in range(procs)
+    ]
+    mesh = MeshRouter(hosts)
+    n_req = schedule["n_requests"]
+    reqs = []
+    try:
+        for i in range(n_req):
+            reqs.append(mesh.submit(pool[i % len(pool)]))
+            if i == schedule["kill_at"]:
+                hosts[target].kill()
+        done = mesh.wait_for(reqs, timeout_s=max(timeout_s - 10.0, 10.0))
+        served = sum(1 for r in reqs if not r.shed)
+        shed = n_req - served
+        st = mesh.stats()
+    finally:
+        mesh.stop()
+    if not done or not all(r.done for r in reqs):
+        pending = sum(1 for r in reqs if not r.done)
+        return Outcome(
+            "FAIL", f"hang: {pending} requests never answered — {detail}"
+        )
+    if st["hosts_lost"] != 1:
+        return Outcome(
+            "FAIL", f"hosts_lost {st['hosts_lost']} != 1 — {detail}"
+        )
+    # Mesh counters at an abrupt kill are inherently a snapshot race
+    # (the dead host's stats.json freezes at its last publish), so the
+    # request-side ledger above is the accounting truth here; the
+    # no-tolerance exact-accounting pin lives in
+    # tests/test_serve_router.py on LocalHost, where nothing lags.
+    if st["shed"] < st["lost_shed"]:
+        return Outcome(
+            "FAIL",
+            f"lost sheds not folded into the shed total "
+            f"({st['lost_shed']} > {st['shed']}) — {detail}",
+        )
+    wrong = sum(
+        1
+        for i, r in enumerate(reqs)
+        if not r.shed and r.item != baseline[i % len(pool)]
+    )
+    if wrong:
+        return Outcome(
+            "FAIL", f"{wrong} wrong survivor responses — {detail}"
+        )
+    events = ledger.snapshot()
+    cascade = [
+        e for e in events
+        if e.get("kind") == "cascade" and e.get("chain") == "serve_mesh"
+    ]
+    lost = [e for e in events if e.get("kind") == "serve_host_lost"]
+    if not cascade or not lost:
+        return Outcome(
+            "FAIL",
+            f"host loss unrecorded (cascade={len(cascade)} "
+            f"serve_host_lost={len(lost)}) — {detail}",
+        )
+    return Outcome(
+        "degraded",
+        f"{detail} served={served} shed={shed} "
+        f"lost_shed={st['lost_shed']}",
+    )
+
+
 def run_mp_scenario(
     schedule: dict, inp: str, root: str, clean: Dict[str, bytes],
     timeout_s: float,
@@ -634,6 +755,8 @@ def run_mp_scenario(
     """One multi-process scenario under the extended invariant."""
     import subprocess
 
+    if schedule["kind"] == "serve_kill":
+        return run_serve_mesh_scenario(schedule, inp, root, timeout_s)
     procs = schedule["procs"]
     tag = f"mp{schedule['seed']}x{procs}"
     qdir = os.path.join(root, tag + ".q")

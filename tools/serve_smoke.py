@@ -192,6 +192,69 @@ def main() -> int:
             f"retries {len(retries)}",
         )
 
+        # 5. mesh leg (ISSUE 19): two SUBPROCESS hosts behind the
+        # request router, serving the same checkpoint — responses match
+        # the single-host baseline, per-host metrics merge into one
+        # mesh surface, and killing one host mid-burst drains its share
+        # to the survivor as recorded sheds (never a hang).
+        from fastapriori_tpu.serve import MeshRouter, ProcHost
+
+        mesh_dir = os.path.join(root, "mesh")
+        hosts = [
+            ProcHost(
+                f"w{i}",
+                os.path.join(mesh_dir, f"w{i}"),
+                out,
+                queue_depth=512,
+                env={"JAX_PLATFORMS": "cpu"},
+            )
+            for i in range(2)
+        ]
+        mesh = MeshRouter(hosts)
+        reqs = [mesh.submit(t) for t in pool]
+        drained = mesh.wait_for(reqs, timeout_s=60.0)
+        served_total = None
+        if drained:
+            deadline = time.time() + 5.0
+            while time.time() < deadline:  # stats ride the poller; lag
+                served_total = mesh.metrics_snapshot().get(
+                    "fa_serve_served_total"
+                )
+                if served_total == len(pool):
+                    break
+                time.sleep(0.05)
+        check(
+            "mesh-serve",
+            drained
+            and [r.item for r in reqs] == baseline
+            and served_total == len(pool),
+            f"2 hosts, {len(pool)} requests, merged "
+            f"fa_serve_served_total {served_total}",
+        )
+        burst = [pool[i % len(pool)] for i in range(200)]
+        reqs2 = []
+        for i, t in enumerate(burst):
+            reqs2.append(mesh.submit(t))
+            if i == 60:
+                hosts[0].kill()  # abrupt death mid-burst
+        done = mesh.wait_for(reqs2, timeout_s=60.0)
+        st = mesh.stats()
+        wrong = sum(
+            1
+            for i, r in enumerate(reqs2)
+            if not r.shed and r.item != baseline[i % len(pool)]
+        )
+        check(
+            "mesh-kill",
+            done
+            and all(r.done for r in reqs2)
+            and st["hosts_lost"] == 1
+            and wrong == 0,
+            f"lost {st['hosts_lost']} host, shed {st['shed']} "
+            f"(lost-shed {st['lost_shed']}), 0 wrong responses",
+        )
+        check("mesh-stop", mesh.stop(), "mesh exited inside the bound")
+
     wall = time.time() - t_start
     print(f"serve-smoke: wall {wall:.1f}s, {len(failures)} failure(s)")
     return 1 if failures else 0
