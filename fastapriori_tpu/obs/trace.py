@@ -64,6 +64,7 @@ FETCH_SITE_SPANS = (
     "fetch.rule_mask",
     "fetch.rule_mask_shard",
     "fetch.serve_match",
+    "fetch.serve_swap_ready",
     "fetch.tail",
     "fetch.vlevel_bits",
     "fetch.vlevel_bits_sparse",

@@ -65,7 +65,7 @@ from fastapriori_tpu.errors import InputError
 from fastapriori_tpu.obs import metrics as obs_metrics
 from fastapriori_tpu.obs.metrics import MetricsRegistry
 from fastapriori_tpu.parallel.hier import spill_order
-from fastapriori_tpu.reliability import ledger, quorum, watchdog
+from fastapriori_tpu.reliability import failpoints, ledger, quorum, watchdog
 from fastapriori_tpu.serve.server import RecommendServer, ServeRequest
 from fastapriori_tpu.serve.state import ServingState
 
@@ -326,9 +326,13 @@ class ProcHost:
                     self._next_id += 1
                 seq = self._next_seq
                 self._next_seq += 1
+                if swap_cmd is not None:
+                    # Register the barrier event under the lock: a
+                    # concurrent fail_outstanding must either see it
+                    # (and release it) or miss the whole swap.
+                    self._swap_events[seq] = swap_cmd[2]
             if swap_cmd is not None:
-                _, prefix, ev = swap_cmd
-                self._swap_events[seq] = ev
+                _, prefix, _ev = swap_cmd
                 _write_json_atomic(
                     os.path.join(self.dir, f"swap-{seq:08d}.json"),
                     {"prefix": prefix},
@@ -379,7 +383,8 @@ class ProcHost:
                 elif fn == "stats.json":
                     data = _read_json(os.path.join(self.dir, fn))
                     if data is not None:
-                        self._stats_cache = data
+                        with self._lock:
+                            self._stats_cache = data
             if not progressed:
                 time.sleep(0.003)
 
@@ -413,7 +418,11 @@ class ProcHost:
             self._pending.clear()
             self._outstanding.clear()
             self._lock.notify_all()
-        for ev in self._swap_events.values():
+            # Snapshot under the lock: the flusher registers swap
+            # events concurrently, and iterating the live dict races
+            # that insert.
+            events = list(self._swap_events.values())
+        for ev in events:
             ev.set()  # a dead host cannot hold the mesh barrier
         return n
 
@@ -429,13 +438,20 @@ class ProcHost:
         return snap or {}
 
     def reset_max_queue(self) -> None:
-        # Worker-side peak reset rides the stop-free control file.
+        # Worker-side peak reset rides the stop-free control file; the
+        # seq is allocated under the lock like every other protocol
+        # file, so two resets can never share a name.
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
         _write_json_atomic(
-            os.path.join(self.dir, f"reset-{self._next_seq}.json"), {}
+            os.path.join(self.dir, f"reset-{seq}.json"), {}
         )
 
     def stop(self, timeout_s: float = 60.0) -> bool:
-        self._running = False
+        with self._lock:
+            self._running = False
+            self._lock.notify_all()
         if self._failed or self.proc.poll() is not None:
             return True
         _write_json_atomic(os.path.join(self.dir, "stop"), {})
@@ -447,7 +463,8 @@ class ProcHost:
         # Final worker state lands before exit; fold it in.
         data = _read_json(os.path.join(self.dir, "stats.json"))
         if data is not None:
-            self._stats_cache = data
+            with self._lock:
+                self._stats_cache = data
         return True
 
 
@@ -719,7 +736,10 @@ class MeshRouter:
         return True
 
     def stop(self, timeout_s: float = 60.0) -> bool:
-        self._running = False
+        with self._admit_lock:
+            # The hb monitor thread polls this flag; publish the store
+            # under the admission lock it already synchronizes on.
+            self._running = False
         ok = True
         for h in self._hosts:
             ok = h.stop(timeout_s=timeout_s) and ok
@@ -746,10 +766,10 @@ def _worker_serve(args) -> int:
     hb_s = quorum.heartbeat_ms() / 1e3
 
     def _publish() -> None:
-        # lint: waive G009 -- heartbeat tmp + os.replace below is the atomic pair; a torn hb is unreadable-as-float and skipped by the poller
-        with open(os.path.join(d, "hb.tmp"), "w") as f:
-            f.write(str(time.time()))
-        os.replace(os.path.join(d, "hb.tmp"), os.path.join(d, "hb"))
+        # The heartbeat rides the same atomic committer as every other
+        # protocol file; only its mtime is consulted (ProcHost.alive),
+        # and os.replace refreshes that either way.
+        _write_json_atomic(os.path.join(d, "hb"), {"t": time.time()})
         snap = server.metrics_snapshot()
         _write_json_atomic(
             os.path.join(d, "metrics.json"),
@@ -786,6 +806,7 @@ def _worker_serve(args) -> int:
                 if data is None:
                     continue
                 processed.add(seq)
+                failpoints.fire("router.req")
                 reqs = [server.submit(b) for b in data["baskets"]]
                 outstanding.append((seq, data["ids"], reqs))
                 progressed = True
@@ -797,9 +818,15 @@ def _worker_serve(args) -> int:
                 if data is None:
                     continue
                 processed.add(seq)
+                failpoints.fire("router.swap")
                 new_state = ServingState.load(
                     data["prefix"], engine=args.engine
                 )
+                # Readiness barrier: compile + device-load the new
+                # table BEFORE it enters the swap ring, so the scan
+                # stage never stalls on a cold XLA cache mid-batch
+                # (the audited fetch inside pins device residency).
+                new_state.device_ready()
                 ev = server.swap(new_state)
                 swaps_pending[seq] = (ev, new_state.signature)
                 progressed = True

@@ -503,8 +503,12 @@ class RecommendServer:
         model, wake the barrier waiters."""
         old = self._state
         marker.state.set_batch_rows(self._batch_rows)
-        self._state = marker.state
-        self._swaps += 1
+        with self._cond:
+            # The install itself is published under the lock: submit
+            # paths and stats() read the table concurrently, and the
+            # swap counter pairs with it.
+            self._state = marker.state
+            self._swaps += 1
         if self._obs:
             self._m_swaps.inc()
             self._m_swap_ms.observe(

@@ -393,6 +393,33 @@ class ServingState:
             self._rec._ensure_rules()
         self.warm_ms = (time.perf_counter() - t0) * 1e3
 
+    def device_ready(self) -> bool:
+        """Swap-path readiness barrier (the router worker calls this
+        BEFORE handing a new table to ``server.swap``): prove the
+        table is device-resident and the fixed-shape scan compiled by
+        running one dummy micro-batch end to end.  ``warm()`` compiles;
+        this VERIFIES — the result crosses the link through the
+        audited ``serve_swap_ready`` fetch, so a table that cannot
+        actually serve surfaces as a classified fetch failure on the
+        swap path instead of a latency cliff (or a crash) mid-batch
+        after the barrier commits.  Host engine: nothing device-side
+        to prove; returns False."""
+        if self._resolve_engine() != "device":
+            return False
+        if self._handle is None:
+            self.warm()
+        h = self._handle
+        rows = self.batch_rows()
+        bm = build_bitmap(
+            [np.zeros(1, dtype=np.int32)], h.f, rows,
+            self.config.item_tile,
+        )
+        blen = np.zeros(rows, dtype=np.int32)
+        blen[0] = 1
+        best, _cons, _chunks = h.scan(bm, blen)
+        retry.fetch(lambda: np.asarray(best), "serve_swap_ready")
+        return True
+
     def _pack_blocks(self, baskets: List[np.ndarray], rows: int,
                      base: int = 0) -> list:
         """HOST half of the scan: chunk distinct baskets into fixed-

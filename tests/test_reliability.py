@@ -153,6 +153,7 @@ FETCH_SITE_INVENTORY = [
     "fetch.rule_counts",  # rules/gen.py surviving-denominator gather
     "fetch.rec_match",  # models/recommender.py resident-scan result batch
     "fetch.serve_match",  # serve/state.py serving micro-batch result
+    "fetch.serve_swap_ready",  # serve/state.py swap readiness barrier
     "fetch.vpair",  # parallel/mesh.py vertical-engine pair packed fetch
     "fetch.vpair_sparse",  # parallel/mesh.py vertical pair + union census
     "fetch.vlevel_bits",  # models/apriori.py vertical survivor bitmask

@@ -104,6 +104,16 @@ def test_serving_state_engine_strictness():
         _state(engine="gpu")
 
 
+def test_swap_readiness_barrier_probes_device_path():
+    # device_ready() is the barrier the router worker runs before
+    # handing a table to server.swap: a device table must serve one
+    # dummy micro-batch end to end; a host engine has nothing to prove.
+    assert _state(engine="host").device_ready() is False
+    st = _state(engine="device")
+    assert st.device_ready() is True
+    assert st._handle is not None  # the probe warmed (and kept) the handle
+
+
 def test_serving_state_signature_tracks_model():
     a = _state(seed=6)
     b = _state(seed=6)

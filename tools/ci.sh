@@ -10,19 +10,25 @@ cd "$(dirname "$0")/.."
 # Full linted surface (package + tests + bench driver + entry script +
 # tooling) under the EMPTY baseline, plus the inventory drift check:
 # tools/lint/inventory.json, env_registry.json and the README knob
-# table must match what the tree regenerates (including the v3
-# collective_sites census) — inventory churn rides the PR that causes
-# it.  Wall time is logged and budgeted (<15 s; PR 13 grew the rule
-# set to 17 + the rank-taint pass but also added the node-type index
-# and the mtime+size analysis cache, so the measured wall DROPPED —
-# cold ~6.5 s, warm ~6 s on the CI box class).
+# table must match what the tree regenerates (including the v5
+# concurrency censuses) — inventory churn rides the PR that causes
+# it.  Both the COLD wall (cache deleted first — what a fresh CI box
+# pays, and what the v5 concurrency + k-hop passes actually cost) and
+# the WARM wall (second run over the schema-3 analysis cache) are
+# logged; the 15 s budget gates the cold run, the expensive one.
+rm -f tools/lint/.cache.json
 lint_t0=$(python -c 'import time; print(time.time())')
 python -m tools.lint --baseline tools/lint/baseline.json --check-inventory
-python - "$lint_t0" <<'EOF'
+lint_t1=$(python -c 'import time; print(time.time())')
+python -m tools.lint --baseline tools/lint/baseline.json --check-inventory
+python - "$lint_t0" "$lint_t1" <<'EOF'
 import sys, time
-elapsed = time.time() - float(sys.argv[1])
-print(f"lint+inventory wall time: {elapsed:.2f}s (budget 15s)")
-sys.exit(1 if elapsed > 15.0 else 0)
+t0, t1 = float(sys.argv[1]), float(sys.argv[2])
+cold = t1 - t0
+warm = time.time() - t1
+print(f"lint+inventory wall time: cold {cold:.2f}s, warm {warm:.2f}s "
+      "(cold budget 15s)")
+sys.exit(1 if cold > 15.0 else 0)
 EOF
 
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -10,9 +10,11 @@ resolution) read the cached fragments instead.
 Two deliberate scope limits keep it correct:
 
 - Only facts derivable from the file's OWN bytes are cached (comments,
-  waiver segments, module-level string/int constants, and — v4 — the
-  protocol pass's per-file raise/ledger-event facts, which feed the
-  ``raise_sites``/``ledger_events`` inventory censuses).  Anything
+  waiver segments, module-level string/int constants, the v4 protocol
+  pass's per-file raise/ledger-event facts, and — v5, schema 3 — the
+  concurrency pass's spawn/blocking/lock/hand-off/sentinel/marker
+  facts, which feed the ``thread_spawns``/``blocking_sites``/...
+  inventory censuses).  Anything
   resolved across files (fetch labels through cross-file constants,
   the collective census's axis resolution, the chain-walk census) is
   recomputed every run — an ``(mtime, size)`` key on one file cannot
@@ -32,7 +34,7 @@ import json
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-SCHEMA = 2  # v4: fragments carry the protocol pass's per-file facts
+SCHEMA = 3  # v5: fragments carry the concurrency pass's per-file facts
 
 CACHE_PATH = os.path.join("tools", "lint", ".cache.json")
 
@@ -127,6 +129,7 @@ def lookup(
 
 def to_fragment(ctx, full_path: str) -> Optional[dict]:
     """Serialize a FileContext's own-bytes-only facts."""
+    from tools.lint import concurrency as _concurrency
     from tools.lint import protocol as _protocol
 
     key = fragment_key(full_path)
@@ -148,6 +151,7 @@ def to_fragment(ctx, full_path: str) -> Optional[dict]:
         "ledger": [
             [k, ln] for k, ln in _protocol.file_ledger_events(ctx)
         ],
+        "concurrency": _concurrency.file_facts(ctx),
     }
 
 
@@ -179,3 +183,9 @@ def apply_fragment(ctx, fragment: dict) -> None:
         ctx._protocol_ledger = [
             (k, int(ln)) for k, ln in fragment["ledger"]
         ]
+    # v5 concurrency facts (schema 3): pre-installing them lets the
+    # thread/blocking/lock/hand-off/sentinel/marker censuses skip
+    # their AST scans on warm runs (concurrency.file_facts consults
+    # this attribute first).
+    if "concurrency" in fragment:
+        ctx._concurrency_facts = fragment["concurrency"]

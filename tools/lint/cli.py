@@ -270,7 +270,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"inventory written: {len(fresh_inv['fetch_sites'])} "
                 f"fetch site(s), {len(fresh_inv['failpoint_sites'])} "
                 f"failpoint site(s), {len(fresh_reg['vars'])} env "
-                f"knob(s), {len(fresh_inv['waivers'])} waiver(s)"
+                f"knob(s), {len(fresh_inv['thread_spawns'])} thread "
+                f"spawn(s), {len(fresh_inv['blocking_sites'])} "
+                f"blocking site(s), {len(fresh_inv['waivers'])} "
+                f"waiver(s)"
             )
             if undescribed:
                 print(

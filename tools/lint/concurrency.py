@@ -1,0 +1,897 @@
+"""graftlint v5 concurrency & liveness layer (ISSUE 20).
+
+PRs 17-19 grew the exact code the first four layers cannot see: a
+two-stage pipelined dispatcher with pack/scan threads and a bounded
+ring, a multi-host router speaking an atomic file protocol, and
+epoch-stamped elastic-mesh collectives.  Every one carries a
+hand-written "never a hang / never a mixed table / never a stale
+epoch" invariant that was enforced only by tests.  This module makes
+those contracts static:
+
+- a census of thread-spawn sites, blocking primitives (``.wait`` /
+  ``.join`` / queue ``.get``/``.put`` / constant-true poll loops),
+  lock acquisitions, ring/queue hand-offs, shutdown-sentinel
+  declarations/deliveries/checks, and quorum/router marker-path
+  constructions — shipped in ``inventory.json`` under the existing
+  drift gate;
+- the per-file analyses behind rules G021-G024 (tools/lint/rules.py
+  wraps them with scope filters; tools/lint/README.md documents the
+  "why" per rule).
+
+Heuristic boundaries, stated up front (same contract as the rest of
+the linter — a heuristic that guesses wrong SILENTLY is worse than
+one that asks for a waiver):
+
+- the race analysis (G022) is class-scoped: it only models classes
+  that construct a ``threading.Thread`` themselves.  Module-global
+  state shared with a function-spawned thread (reliability/watchdog's
+  abandon ledger) is out of scope and stays a test-enforced contract;
+- reads are not flagged, only unguarded stores — a torn read of a
+  Python reference is a staleness bug, not a corruption bug, and the
+  serving tier deliberately reads hot fields lock-free;
+- hand-off containers are recognized by name shape (``_ring``,
+  ``_q``, ``pending`` ...); a deque named ``self.stuff`` is invisible
+  to the census.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.lint.engine import (
+    FileContext,
+    PackageContext,
+    is_test_path,
+    terminal_name,
+)
+
+# -- name-shape vocabulary ------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_LOCKISH = re.compile(r"lock|cond|mutex|sem", re.I)
+_QUEUEISH = re.compile(
+    r"(^|_)(q|queue|ring|jobs|work|inbox|outbox|tasks|pending|deque)\d*$",
+    re.I,
+)
+_THREADISH = re.compile(
+    r"(^|_)(t|thread|threads|worker|workers|proc|flusher|poller)\d*$", re.I
+)
+_HANDOFF_OPS = {
+    "append",
+    "appendleft",
+    "pop",
+    "popleft",
+    "put",
+    "put_nowait",
+    "get",
+    "get_nowait",
+}
+_SLEEPISH = {"sleep", "wait"}
+# File-protocol payload heads (serve/router.py + reliability/quorum.py).
+_PROTO_PREFIXES = (
+    "req-",
+    "rsp-",
+    "swap-",
+    "swapped-",
+    "reset-",
+    "mark.",
+    "hb.",
+    "state.",
+    "exit.",
+)
+# Heads whose pairing depends on the *seq* namespace (G024 part B).
+_SEQ_PREFIXES = ("req-", "rsp-", "swap-", "swapped-", "reset-")
+_NAMESPACED = re.compile(r"seq|epoch|rank|site", re.I)
+_SEQNS = re.compile(r"seq|epoch", re.I)
+_STATEISH = re.compile(r"(^|_)state$")
+# Quorum marker-transport entry points; calls INSIDE these bodies are
+# the sanctioned implementation, not domain call sites.
+_MARKER_FNS = {"post_marker", "peer_marker", "_exchange_file"}
+_SANCTIONED_FNS = _MARKER_FNS | {"_esite"}
+
+
+def is_proto_file(path: str) -> bool:
+    """Files speaking the marker/payload file protocol (G024 scope)."""
+    base = path.rsplit("/", 1)[-1]
+    return "quorum" in base or "router" in base
+
+
+# -- small AST helpers ----------------------------------------------------
+
+
+def _is_none(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _is_false(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def _timeout_kw(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "timeout_s"):
+            return kw.value
+    return None
+
+
+def _walk_no_nested(root: ast.AST) -> Iterator[ast.AST]:
+    """Subtree walk that does not descend into nested function defs
+    (a closure's body runs on whichever thread calls it later)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_kind(call: ast.Call) -> Optional[str]:
+    """wait/join/get/put when the call shape can suspend the thread."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv = terminal_name(call.func.value)
+    if attr == "wait":
+        return "wait"
+    if attr == "join":
+        # ``", ".join(parts)`` / ``os.path.join(a, b)`` always pass
+        # arguments; a zero-arg join is essentially always Thread.join.
+        if recv is not None and _THREADISH.search(recv):
+            return "join"
+        if (
+            not call.args
+            and not call.keywords
+            and recv != "path"
+            and not isinstance(call.func.value, ast.Constant)
+        ):
+            return "join"
+        return None
+    if attr in ("get", "put") and recv and _QUEUEISH.search(recv):
+        # A str-constant first argument is dict.get(key)/dict-shaped
+        # access, not Queue.get(block, timeout) — bench's stats dicts
+        # are named `queue` too.
+        if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+            call.args[0].value, str
+        ):
+            return None
+        return attr
+    return None
+
+
+def _call_bounded(call: ast.Call, kind: str) -> bool:
+    """Does the blocking call carry a finite bound in its own shape?"""
+    tkw = _timeout_kw(call)
+    if kind == "wait":
+        if call.args and not _is_none(call.args[0]):
+            return True
+        return tkw is not None and not _is_none(tkw)
+    if kind == "join":
+        if call.args and not _is_none(call.args[0]):
+            return True
+        return tkw is not None and not _is_none(tkw)
+    if kind == "get":
+        # Queue.get(block=False) / .get(True, timeout) / .get(timeout=t)
+        if tkw is not None and not _is_none(tkw):
+            return True
+        if len(call.args) >= 2:
+            return True
+        return bool(call.args) and _is_false(call.args[0])
+    if kind == "put":
+        if tkw is not None and not _is_none(tkw):
+            return True
+        if len(call.args) >= 3:
+            return True
+        return len(call.args) >= 2 and _is_false(call.args[1])
+    return False
+
+
+def _is_lockish_expr(expr: ast.AST) -> Optional[str]:
+    """`with self._lock:` / `with cond:` — the guarded-region shape."""
+    t = terminal_name(expr)
+    if t is not None and _LOCKISH.search(t):
+        return t
+    if isinstance(expr, ast.Call):
+        # `with self._lock.acquire_timeout(...)`-style wrappers.
+        t = terminal_name(expr.func)
+        if t is not None and _LOCKISH.search(t):
+            return t
+    return None
+
+
+# -- per-file analysis ----------------------------------------------------
+
+
+class FileConcurrency:
+    """Every concurrency-relevant site in one file, node-bearing (the
+    serializable projection lives in :func:`file_facts`)."""
+
+    def __init__(self) -> None:
+        # (Thread(...) call, target label)
+        self.spawns: List[Tuple[ast.Call, str]] = []
+        # (call, kind, bound) with bound in {"timeout","sentinel","none"}
+        self.blocking: List[Tuple[ast.Call, str, str]] = []
+        # (while node, has break/return/raise)
+        self.polls: List[Tuple[ast.While, bool]] = []
+        # (with/acquire node, lock name)
+        self.locks: List[Tuple[ast.AST, str]] = []
+        # (call, container, op)
+        self.handoffs: List[Tuple[ast.Call, str, str]] = []
+        # module-level NAME = object() declarations
+        self.sentinels: Dict[str, ast.Assign] = {}
+        # (node, sentinel name) delivered from a `finally` suite
+        self.deliveries: List[Tuple[ast.AST, str]] = []
+        # (compare node, sentinel name) `is` / `is not` guards
+        self.checks: List[Tuple[ast.Compare, str]] = []
+        # (JoinedStr, head, namespaced) protocol payload constructions
+        self.markers: List[Tuple[ast.JoinedStr, str, bool]] = []
+
+
+def analyze(ctx: FileContext) -> FileConcurrency:
+    """The file's concurrency sites (memoized per FileContext)."""
+    cached = getattr(ctx, "_concurrency_analysis", None)
+    if cached is not None:
+        return cached
+    a = FileConcurrency()
+    ctx._concurrency_analysis = a
+    if ctx.tree is None:
+        return a
+
+    # Module-level shutdown sentinels: NAME = object().
+    for stmt in ctx.tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Call)
+            and terminal_name(stmt.value.func) == "object"
+            and not stmt.value.args
+            and not stmt.value.keywords
+        ):
+            a.sentinels[stmt.targets[0].id] = stmt
+
+    for call in ctx.nodes(ast.Call):
+        t = terminal_name(call.func)
+        if t == "Thread":
+            target = "<dynamic>"
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    tn = terminal_name(kw.value)
+                    if tn is not None:
+                        target = tn
+            a.spawns.append((call, target))
+        if t == "acquire" and isinstance(call.func, ast.Attribute):
+            ln = terminal_name(call.func.value)
+            if ln is not None and _LOCKISH.search(ln):
+                a.locks.append((call, ln))
+        if isinstance(call.func, ast.Attribute):
+            op = call.func.attr
+            recv = terminal_name(call.func.value)
+            if (
+                op in _HANDOFF_OPS
+                and recv is not None
+                and _QUEUEISH.search(recv)
+            ):
+                a.handoffs.append((call, recv, op))
+        kind = _blocking_kind(call)
+        if kind is not None:
+            bound = "timeout" if _call_bounded(call, kind) else "none"
+            a.blocking.append((call, kind, bound))
+
+    for node in ctx.nodes(ast.With):
+        for item in node.items:
+            name = _is_lockish_expr(item.context_expr)
+            if name is not None:
+                a.locks.append((node, name))
+
+    # Sentinel deliveries (from `finally` suites) and `is` checks.
+    if a.sentinels:
+        for tr in ctx.nodes(ast.Try):
+            for stmt in tr.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        for arg in sub.args:
+                            if (
+                                isinstance(arg, ast.Name)
+                                and arg.id in a.sentinels
+                            ):
+                                a.deliveries.append((sub, arg.id))
+        for cmp in ctx.nodes(ast.Compare):
+            if not any(isinstance(op, (ast.Is, ast.IsNot)) for op in cmp.ops):
+                continue
+            for side in [cmp.left] + list(cmp.comparators):
+                if isinstance(side, ast.Name) and side.id in a.sentinels:
+                    a.checks.append((cmp, side.id))
+
+    # Constant-true poll loops: a sleep/wait-bearing `while True:` with
+    # no break/return/raise can never exit — shutdown hangs.
+    for node in ctx.nodes(ast.While):
+        if not (
+            isinstance(node.test, ast.Constant) and bool(node.test.value)
+        ):
+            continue
+        sleeps = False
+        has_exit = False
+        for sub in _walk_no_nested(node):
+            if isinstance(sub, ast.Call):
+                st = terminal_name(sub.func)
+                if st in _SLEEPISH:
+                    sleeps = True
+            if isinstance(sub, (ast.Break, ast.Return, ast.Raise)):
+                has_exit = True
+        if sleeps:
+            a.polls.append((node, has_exit))
+
+    # Upgrade unbounded waits/gets that sit on a sentinel-guaranteed
+    # shutdown path: the enclosing function compares against a
+    # module-level sentinel that this file delivers from a `finally`.
+    delivered = {name for _n, name in a.deliveries}
+    if delivered:
+        enc = ctx.enclosing_functions()
+        checked_by_fn: Dict[int, Set[str]] = {}
+        for cmp, name in a.checks:
+            if name not in delivered:
+                continue
+            fn = enc.get(id(cmp))
+            if fn is not None:
+                checked_by_fn.setdefault(id(fn), set()).add(name)
+        for i, (call, kind, bound) in enumerate(a.blocking):
+            if bound != "none" or kind not in ("wait", "get"):
+                continue
+            fn = enc.get(id(call))
+            if fn is not None and checked_by_fn.get(id(fn)):
+                a.blocking[i] = (call, kind, "sentinel")
+
+    # Protocol payload-path constructions (quorum/router files only).
+    if is_proto_file(ctx.path):
+        for j in ctx.nodes(ast.JoinedStr):
+            if not j.values or not isinstance(j.values[0], ast.Constant):
+                continue
+            head_lit = j.values[0].value
+            if not isinstance(head_lit, str):
+                continue
+            head = next(
+                (p for p in _PROTO_PREFIXES if head_lit.startswith(p)), None
+            )
+            if head is None:
+                continue
+            namespaced = False
+            for v in j.values:
+                if not isinstance(v, ast.FormattedValue):
+                    continue
+                for sub in ast.walk(v.value):
+                    t = terminal_name(sub)
+                    if t is not None and (
+                        _NAMESPACED.search(t) or t in ("_esite", "_site_slug")
+                    ):
+                        namespaced = True
+            a.markers.append((j, head, namespaced))
+    return a
+
+
+def file_facts(ctx: FileContext) -> dict:
+    """Serializable own-bytes-only projection of :func:`analyze` —
+    cached in the per-file fragments (tools/lint/cache.py schema 3) so
+    warm runs skip the AST scan for the inventory censuses."""
+    cached = getattr(ctx, "_concurrency_facts", None)
+    if cached is not None:
+        return cached
+    a = analyze(ctx)
+    blocking = [[k, b, n.lineno] for n, k, b in a.blocking]
+    blocking += [
+        ["poll", "exit" if ex else "none", w.lineno] for w, ex in a.polls
+    ]
+    sentinels = [
+        ["decl", name, node.lineno] for name, node in a.sentinels.items()
+    ]
+    sentinels += [["delivery", name, n.lineno] for n, name in a.deliveries]
+    sentinels += [["check", name, n.lineno] for n, name in a.checks]
+    facts = {
+        "spawns": [[t, n.lineno] for n, t in a.spawns],
+        "blocking": blocking,
+        "locks": [[name, n.lineno] for n, name in a.locks],
+        "handoffs": [[c, op, n.lineno] for n, c, op in a.handoffs],
+        "sentinels": sentinels,
+        "markers": [
+            [head, 1 if ns else 0, j.lineno] for j, head, ns in a.markers
+        ],
+    }
+    ctx._concurrency_facts = facts
+    return facts
+
+
+# -- inventory censuses (drift-checked; test files excluded) --------------
+
+
+def _census_files(pkg: PackageContext) -> Iterator[FileContext]:
+    for ctx in pkg.files:
+        if ctx.tree is None or is_test_path(ctx.path):
+            continue
+        yield ctx
+
+
+def spawn_census(pkg: PackageContext) -> List[dict]:
+    return [
+        {"path": c.path, "target": t}
+        for c in _census_files(pkg)
+        for t, _ln in file_facts(c)["spawns"]
+    ]
+
+
+def blocking_census(pkg: PackageContext) -> List[dict]:
+    return [
+        {"path": c.path, "kind": k, "bound": b}
+        for c in _census_files(pkg)
+        for k, b, _ln in file_facts(c)["blocking"]
+    ]
+
+
+def lock_census(pkg: PackageContext) -> List[dict]:
+    return [
+        {"path": c.path, "lock": name}
+        for c in _census_files(pkg)
+        for name, _ln in file_facts(c)["locks"]
+    ]
+
+
+def handoff_census(pkg: PackageContext) -> List[dict]:
+    return [
+        {"path": c.path, "container": cont, "op": op}
+        for c in _census_files(pkg)
+        for cont, op, _ln in file_facts(c)["handoffs"]
+    ]
+
+
+def sentinel_census(pkg: PackageContext) -> List[dict]:
+    return [
+        {"path": c.path, "role": role, "name": name}
+        for c in _census_files(pkg)
+        for role, name, _ln in file_facts(c)["sentinels"]
+    ]
+
+
+def marker_census(pkg: PackageContext) -> List[dict]:
+    return [
+        {"path": c.path, "marker": head, "namespaced": bool(ns)}
+        for c in _census_files(pkg)
+        for head, ns, _ln in file_facts(c)["markers"]
+    ]
+
+
+# -- the class-scoped race model (G022 / G023) ----------------------------
+
+
+class ThreadClass:
+    """A class that constructs its own threads, decomposed into thread
+    groups: each spawn target's method-closure (``self.X()`` edges),
+    plus a "main" group for caller-thread methods.  ``__init__`` and
+    the spawning methods themselves are excluded from the accounting —
+    everything they touch happens-before ``Thread.start``."""
+
+    def __init__(self, cls: ast.ClassDef, ctx: FileContext) -> None:
+        self.cls = cls
+        self.methods: Dict[str, ast.FunctionDef] = {
+            f.name: f
+            for f in cls.body
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.spawn_methods: Set[str] = set()
+        self.lock_attrs: Set[str] = set()
+        # group label -> list of fn nodes (method bodies / closures)
+        self.groups: List[Tuple[str, List[ast.AST]]] = []
+        self._build(ctx)
+
+    def _build(self, ctx: FileContext) -> None:
+        targets: List[Tuple[str, Optional[ast.AST]]] = []
+        for mname, fn in self.methods.items():
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Call)
+                    and terminal_name(sub.func) == "Thread"
+                ):
+                    self.spawn_methods.add(mname)
+                    for kw in sub.keywords:
+                        if kw.arg != "target":
+                            continue
+                        if isinstance(
+                            kw.value, ast.Attribute
+                        ) and isinstance(kw.value.value, ast.Name):
+                            targets.append((kw.value.attr, None))
+                        elif isinstance(kw.value, ast.Name):
+                            nested = next(
+                                (
+                                    s
+                                    for s in ast.walk(fn)
+                                    if isinstance(s, ast.FunctionDef)
+                                    and s.name == kw.value.id
+                                ),
+                                None,
+                            )
+                            targets.append((kw.value.id, nested))
+        for fn in ast.walk(self.cls):
+            if isinstance(fn, ast.Assign) and isinstance(
+                fn.value, ast.Call
+            ):
+                if terminal_name(fn.value.func) in _LOCK_CTORS:
+                    for tgt in fn.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            self.lock_attrs.add(tgt.attr)
+            if isinstance(fn, ast.With):
+                for item in fn.items:
+                    ce = item.context_expr
+                    if (
+                        isinstance(ce, ast.Attribute)
+                        and isinstance(ce.value, ast.Name)
+                        and ce.value.id == "self"
+                        and _LOCKISH.search(ce.attr)
+                    ):
+                        self.lock_attrs.add(ce.attr)
+        # self.X() call edges between methods.
+        edges: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        for mname, fn in self.methods.items():
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "self"
+                    and sub.func.attr in self.methods
+                ):
+                    edges[mname].add(sub.func.attr)
+        in_thread: Set[str] = set()
+        for label, nested in targets:
+            roots = [label] if nested is None else list(edges_of(nested, self.methods))
+            members: Set[str] = set()
+            frontier = [r for r in roots if r in self.methods]
+            while frontier:
+                m = frontier.pop()
+                if m in members:
+                    continue
+                members.add(m)
+                frontier.extend(edges[m])
+            fns: List[ast.AST] = [self.methods[m] for m in sorted(members)]
+            if nested is not None:
+                fns.insert(0, nested)
+            if fns:
+                self.groups.append((label, fns))
+                in_thread |= members
+        main = [
+            self.methods[m]
+            for m in sorted(self.methods)
+            if m not in in_thread
+            and m not in self.spawn_methods
+            and m != "__init__"
+        ]
+        if main:
+            self.groups.append(("<main>", main))
+
+
+def edges_of(fn: ast.AST, methods: Dict[str, ast.FunctionDef]) -> Set[str]:
+    """``self.X()`` targets referenced from a closure body."""
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == "self"
+            and sub.func.attr in methods
+        ):
+            out.add(sub.func.attr)
+    return out
+
+
+def thread_classes(ctx: FileContext) -> List[ThreadClass]:
+    cached = getattr(ctx, "_thread_classes", None)
+    if cached is not None:
+        return cached
+    out = []
+    for cls in ctx.nodes(ast.ClassDef):
+        if any(
+            isinstance(sub, ast.Call)
+            and terminal_name(sub.func) == "Thread"
+            for sub in ast.walk(cls)
+        ):
+            out.append(ThreadClass(cls, ctx))
+    ctx._thread_classes = out
+    return out
+
+
+def _guarded_ids(fn: ast.AST, lock_attrs: Set[str]) -> Set[int]:
+    """ids of nodes inside a `with self.<lock>:` region of ``fn``."""
+
+    def lockish(expr: ast.AST) -> bool:
+        t = terminal_name(expr)
+        if t in lock_attrs:
+            return True
+        return t is not None and _LOCKISH.search(t) is not None
+
+    guarded: Set[int] = set()
+
+    def rec(node: ast.AST, g: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            cg = g or (
+                isinstance(child, ast.With)
+                and any(lockish(i.context_expr) for i in child.items)
+            )
+            if cg:
+                guarded.add(id(child))
+            rec(child, cg)
+
+    rec(fn, False)
+    return guarded
+
+
+def _self_root(node: ast.AST) -> Optional[str]:
+    """`self.X`, `self.X[i]`, `self.X[i][j]` ... -> "X"."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _fn_accesses(fn: ast.AST):
+    """(stores, loads) of self-attributes in one function body.
+    stores: [(attr, anchor node, value expr | None)]; loads: {attr}."""
+    stores: List[Tuple[str, ast.AST, Optional[ast.AST]]] = []
+    loads: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                for e in elts:
+                    attr = _self_root(e)
+                    if attr is not None:
+                        stores.append((attr, sub, sub.value))
+        elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+            attr = _self_root(sub.target)
+            if attr is not None:
+                stores.append((attr, sub, None))
+        elif isinstance(sub, ast.Attribute) and isinstance(
+            sub.ctx, ast.Load
+        ):
+            if isinstance(sub.value, ast.Name) and sub.value.id == "self":
+                loads.add(sub.attr)
+    return stores, loads
+
+
+def race_findings(ctx: FileContext):
+    """G022 core: unguarded stores to attributes reachable from >= 2
+    thread groups of a thread-spawning class.  Yields
+    ``(anchor node, attr, class name, n_groups)``."""
+    for tc in thread_classes(ctx):
+        if len(tc.groups) < 2:
+            continue
+        guarded: Dict[int, Set[int]] = {}
+        per_group: List[Dict[str, list]] = []
+        attr_groups: Dict[str, Set[int]] = {}
+        for gi, (_label, fns) in enumerate(tc.groups):
+            acc: Dict[str, list] = {}
+            for fn in fns:
+                guarded[id(fn)] = _guarded_ids(fn, tc.lock_attrs)
+                stores, loads = _fn_accesses(fn)
+                for attr, node, _val in stores:
+                    acc.setdefault(attr, []).append((node, fn))
+                    attr_groups.setdefault(attr, set()).add(gi)
+                for attr in loads:
+                    attr_groups.setdefault(attr, set()).add(gi)
+            per_group.append(acc)
+        # k=1 caller-context: a helper whose every intra-class call
+        # site is inside a guarded region inherits the caller's lock
+        # (serve/server.py's `_shed_locked` shape).
+        lock_context: Set[str] = set()
+        all_fns = [fn for _l, fns in tc.groups for fn in fns]
+        for mname, m in tc.methods.items():
+            sites = []
+            for fn in all_fns:
+                for sub in ast.walk(fn):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"
+                        and sub.func.attr == mname
+                    ):
+                        sites.append((sub, fn))
+            if sites and all(
+                id(call) in guarded.get(id(fn), ()) for call, fn in sites
+            ):
+                lock_context.add(mname)
+        seen_nodes: Set[int] = set()
+        for gi, acc in enumerate(per_group):
+            for attr, nodes in sorted(acc.items()):
+                if attr in tc.lock_attrs:
+                    continue
+                if len(attr_groups.get(attr, ())) < 2:
+                    continue
+                for node, fn in nodes:
+                    if id(node) in seen_nodes:
+                        continue  # a method shared by two groups
+                    if id(node) in guarded.get(id(fn), ()):
+                        continue
+                    fname = getattr(fn, "name", "")
+                    if fname in lock_context:
+                        continue
+                    seen_nodes.add(id(node))
+                    yield node, attr, tc.cls.name, len(
+                        attr_groups[attr]
+                    )
+
+
+def swap_findings(ctx: FileContext):
+    """G023 core: direct installs of a served table (``self.*state``)
+    outside a barrier path, in a thread-spawning class.  Yields
+    ``(anchor node, attr, class name)``."""
+    for tc in thread_classes(ctx):
+        for mname, fn in sorted(tc.methods.items()):
+            if mname == "__init__" or mname in tc.spawn_methods:
+                continue
+            stores, _loads = _fn_accesses(fn)
+            for attr, node, value in stores:
+                if not _STATEISH.search(attr):
+                    continue
+                if "swap" in mname:
+                    continue
+                if (
+                    isinstance(value, ast.Attribute)
+                    and value.attr == "state"
+                ):
+                    continue  # marker install: `self._x = marker.state`
+                yield node, attr, tc.cls.name
+
+
+# -- the epoch/seq namespace model (G024) ---------------------------------
+
+
+def _fn_parents(ctx: FileContext) -> Dict[int, ast.AST]:
+    """FunctionDef -> lexically enclosing FunctionDef (closure chain)."""
+    cached = getattr(ctx, "_fn_parents", None)
+    if cached is not None:
+        return cached
+    parents: Dict[int, ast.AST] = {}
+    for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # BFS order: deeper enclosing fns overwrite shallower.
+                parents[id(sub)] = fn
+    ctx._fn_parents = parents
+    return parents
+
+
+def _expr_epoch_tainted(
+    expr: ast.AST, tainted: Set[str], depth: int = 0
+) -> bool:
+    if depth > 4:
+        return False
+    if isinstance(expr, ast.Call):
+        return terminal_name(expr.func) == "_esite"
+    if isinstance(expr, ast.JoinedStr):
+        return any(
+            _expr_epoch_tainted(v.value, tainted, depth + 1)
+            for v in expr.values
+            if isinstance(v, ast.FormattedValue)
+        )
+    if isinstance(expr, ast.FormattedValue):
+        return _expr_epoch_tainted(expr.value, tainted, depth + 1)
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted or "epoch" in expr.id.lower()
+    if isinstance(expr, ast.Attribute):
+        return "epoch" in expr.attr.lower()
+    if isinstance(expr, ast.BinOp):
+        return _expr_epoch_tainted(
+            expr.left, tainted, depth + 1
+        ) or _expr_epoch_tainted(expr.right, tainted, depth + 1)
+    return False
+
+
+def epoch_findings(ctx: FileContext):
+    """G024 core.  Part A: quorum marker-transport calls whose site
+    argument is not provably namespaced by the mesh epoch (via
+    ``_esite`` or an epoch-tainted f-string, tracked through local
+    assignments across the closure chain).  Part B: router protocol
+    payload names built without a sequence number.  Yields
+    ``(node, message)``."""
+    if not is_proto_file(ctx.path):
+        return
+    enc = ctx.enclosing_functions()
+    parents = _fn_parents(ctx)
+    for call in ctx.nodes(ast.Call):
+        t = terminal_name(call.func)
+        if t not in _MARKER_FNS or not call.args:
+            continue
+        fn = enc.get(id(call))
+        if fn is not None and fn.name in _SANCTIONED_FNS:
+            continue
+        # Assignments visible from the call: the enclosing function
+        # plus its closure chain (quorum's `post_join` shape).
+        chain = []
+        cur = fn
+        while cur is not None and len(chain) < 6:
+            chain.append(cur)
+            cur = parents.get(id(cur))
+        assigns: Dict[str, List[ast.AST]] = {}
+        for f in chain:
+            for sub in ast.walk(f):
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            assigns.setdefault(tgt.id, []).append(
+                                sub.value
+                            )
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, rhss in assigns.items():
+                if name in tainted:
+                    continue
+                if any(_expr_epoch_tainted(r, tainted) for r in rhss):
+                    tainted.add(name)
+                    changed = True
+        if not _expr_epoch_tainted(call.args[0], tainted):
+            yield call, (
+                f"`{t}(...)` site is not namespaced by the mesh epoch "
+                "— route it through `_esite(...)` so an elastic-mesh "
+                "straggler can never pair with a stale epoch's marker"
+            )
+    a = analyze(ctx)
+    for j, head, _ns in a.markers:
+        if head not in _SEQ_PREFIXES:
+            continue
+        fn = enc.get(id(j))
+        if fn is not None and fn.name in _SANCTIONED_FNS:
+            continue
+        seq_ns = False
+        for v in j.values:
+            if not isinstance(v, ast.FormattedValue):
+                continue
+            for sub in ast.walk(v.value):
+                tn = terminal_name(sub)
+                if tn is not None and _SEQNS.search(tn):
+                    seq_ns = True
+        if not seq_ns:
+            yield j, (
+                f'protocol payload name `f"{head}..."` carries no '
+                "sequence number — req/rsp/swap pairing relies on the "
+                "seq namespace"
+            )
+
+
+# -- bounded-wait findings (G021) -----------------------------------------
+
+
+def liveness_findings(ctx: FileContext):
+    """G021 core: blocking calls with no finite bound and no censused
+    sentinel path, plus inescapable poll loops.  Yields
+    ``(node, message)``."""
+    a = analyze(ctx)
+    for call, kind, bound in a.blocking:
+        if bound != "none":
+            continue
+        yield call, (
+            f"unbounded blocking `.{kind}(...)` — pass a finite "
+            "timeout, or gate the loop on a module-level shutdown "
+            "sentinel delivered from a `finally` suite"
+        )
+    for node, has_exit in a.polls:
+        if has_exit:
+            continue
+        yield node, (
+            "constant-true poll loop with no break/return/raise — "
+            "this thread can never observe shutdown"
+        )

@@ -991,6 +991,15 @@ def build_inventory(pkg: "PackageContext") -> dict:
     # contracts against, drift-checked like everything above.
     from tools.lint import protocol as proto
 
+    # The v5 concurrency censuses (tools/lint/concurrency.py): every
+    # thread spawn, blocking primitive (with its boundedness class),
+    # lock acquisition, ring/queue hand-off, shutdown-sentinel
+    # declaration/delivery/check, and quorum/router marker-path
+    # construction — the artifacts G021-G024 prove the liveness /
+    # race / swap-barrier / epoch-namespace contracts against,
+    # drift-checked like everything above.
+    from tools.lint import concurrency as conc
+
     return {
         "version": 1,
         "comment": (
@@ -1007,6 +1016,12 @@ def build_inventory(pkg: "PackageContext") -> dict:
         "raise_sites": _counted(proto.raise_census(pkg)),
         "ledger_events": _counted(proto.ledger_census(pkg)),
         "chain_walks": _counted(proto.chain_walk_census(pkg)),
+        "thread_spawns": _counted(conc.spawn_census(pkg)),
+        "blocking_sites": _counted(conc.blocking_census(pkg)),
+        "lock_sites": _counted(conc.lock_census(pkg)),
+        "handoff_sites": _counted(conc.handoff_census(pkg)),
+        "sentinel_sites": _counted(conc.sentinel_census(pkg)),
+        "marker_paths": _counted(conc.marker_census(pkg)),
         "waivers": _counted(waivers),
     }
 

@@ -34,8 +34,14 @@ stay silent:
   inherit the contract.
 
 Both analyses get one level of cross-function propagation through
-tools/lint/graph.py summaries and no more — depth-2 inference is where
-static guesses about this codebase start being wrong silently.
+tools/lint/graph.py summaries and no more — these are VALUE inferences
+(shape families, buffer liveness), where depth-2 guesses about this
+codebase start being wrong silently.  The v4 protocol layer's
+REACHABILITY walks (does this helper construct a classified type, does
+this resume path hit the fence validator) carry no values and so go
+deeper safely: protocol.py k-bounds them at ``K_HOPS`` (= 3)
+graph-resolvable call edges, with fixture tests pinning both the
+3-hop resolve and the 4-hop flag.
 """
 
 from __future__ import annotations

@@ -302,6 +302,49 @@ def locally_wrapped_raises(ctx) -> Dict[int, Set[str]]:
     return out
 
 
+# v5: the helper walks are k-bounded instead of one-hop — a helper
+# that delegates construction/validation through one or two more
+# layers of indirection resolves instead of demanding a waiver, while
+# anything deeper still flags (an unbounded walk would turn a lint
+# pass into a whole-program analysis; 3 hops covers every shape this
+# codebase writes and the fixture tests pin the 4-hop flag).
+K_HOPS = 3
+
+
+def _k_reachable(start_ctx, start_fn, pkg, hops: int):
+    """``[(ctx, fn)]`` reachable from ``start_fn`` through at most
+    ``hops`` graph-resolvable call edges (BFS, id-deduplicated,
+    memoized per package — G018 and G020 share the walks)."""
+    memo = getattr(pkg, "_khop_memo", None)
+    if memo is None:
+        memo = pkg._khop_memo = {}
+    key = (id(start_fn), hops)
+    if key in memo:
+        return memo[key]
+    seen = {id(start_fn)}
+    out = [(start_ctx, start_fn)]
+    frontier = [(start_ctx, start_fn)]
+    for _ in range(hops):
+        nxt = []
+        for fctx, fn in frontier:
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                hit = pkg.graph.resolve_call(fctx, call)
+                if hit is not None and id(hit[1]) not in seen:
+                    seen.add(id(hit[1]))
+                    # resolve_call returns (ModuleTable, fn); walks
+                    # continue in the callee's own file context.
+                    pair = (hit[0].ctx, hit[1])
+                    nxt.append(pair)
+                    out.append(pair)
+        if not nxt:
+            break
+        frontier = nxt
+    memo[key] = out
+    return out
+
+
 def _fn_constructs_classified(fn: ast.AST, classified: Set[str]) -> bool:
     for node in ast.walk(fn):
         if isinstance(node, ast.Call) and terminal_name(
@@ -343,10 +386,14 @@ def unclassified_raises(ctx, pkg) -> List[Tuple[ast.Raise, str]]:
                 and spelling not in pkg_classes
             ):
                 # An unresolvable constructor: maybe a classified-
-                # constructing helper (`raise _closure_error(...)`).
+                # constructing helper (`raise _closure_error(...)`),
+                # possibly delegating through up to K_HOPS layers.
                 hit = pkg.graph.resolve_call(ctx, exc)
-                if hit is not None and _fn_constructs_classified(
-                    hit[1], classified
+                if hit is not None and any(
+                    _fn_constructs_classified(f, classified)
+                    for _fctx, f in _k_reachable(
+                        hit[0].ctx, hit[1], pkg, K_HOPS - 1
+                    )
                 ):
                     continue
                 if hit is None:
@@ -388,24 +435,14 @@ def _call_has_fence(call: ast.Call) -> bool:
 
 
 def _fn_validates_fence(fn: ast.AST, ctx, pkg) -> bool:
-    """``validate_resume_fence`` reached from ``fn`` directly or
-    through one graph-resolvable callee (``load_checkpoint`` funnels
-    the check through ``quorum.validate_resume_fence`` directly; a
-    wrapper one hop up still counts)."""
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
-        if terminal_name(node.func) == "validate_resume_fence":
-            return True
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
-        hit = pkg.graph.resolve_call(ctx, node)
-        if hit is None:
-            continue
-        for sub in ast.walk(hit[1]):
-            if isinstance(sub, ast.Call) and terminal_name(
-                sub.func
+    """``validate_resume_fence`` reached from ``fn`` within K_HOPS
+    graph-resolvable call edges (``load_checkpoint`` funnels the check
+    through ``quorum.validate_resume_fence`` directly; a wrapper two
+    or three hops up still counts — v5 k-bounded walk)."""
+    for fctx, f in _k_reachable(ctx, fn, pkg, K_HOPS):
+        for node in ast.walk(f):
+            if isinstance(node, ast.Call) and terminal_name(
+                node.func
             ) == "validate_resume_fence":
                 return True
     return False

@@ -26,6 +26,7 @@ from tools.lint.engine import (
     Finding,
     PackageContext,
     dotted_name,
+    is_test_path,
     resolve_int,
     resolve_str,
     terminal_name,
@@ -1724,13 +1725,47 @@ class CascadeExhaustivenessRule(Rule):
             elif to is not None:
                 wild_tos.setdefault(chain, set()).add(to)
             elif frm is not None:
-                # Literal frm, dynamic to: treat as a step to the next
-                # stage — the weakest edge the site can mean.
-                idx = stages.index(frm)
-                if idx + 1 < len(stages):
-                    edges.setdefault(chain, set()).add(
-                        (frm, stages[idx + 1])
-                    )
+                # v5 value-range tracking: when every assignment to
+                # the dynamic `to` resolves to a literal, the site is
+                # VERIFIED against each value — multi-rung jumps count
+                # as real edges and bad values flag, exactly like a
+                # literal walk (closes the v4 "modeled as next-stage-
+                # down" residue for resolvable sites).
+                rng = _dynamic_to_range(node, wctx, pkg)
+                if rng:
+                    for val in sorted(rng):
+                        if val not in stages:
+                            yield self.finding(
+                                wctx,
+                                node,
+                                f"dynamic downgrade target resolves "
+                                f"to {val!r}, which is not a stage of "
+                                f"chain {chain!r} (declared order: "
+                                f"{' -> '.join(stages)}); the walk "
+                                "and the CHAINS literal drifted",
+                            )
+                        elif stages.index(val) <= stages.index(frm):
+                            yield self.finding(
+                                wctx,
+                                node,
+                                f"dynamic downgrade target resolves "
+                                f"to {val!r}, walking chain {chain!r} "
+                                f"backward from {frm!r} (declared "
+                                f"order: {' -> '.join(stages)}); "
+                                "cascades are forward-only",
+                            )
+                        else:
+                            edges.setdefault(chain, set()).add(
+                                (frm, val)
+                            )
+                else:
+                    # Unresolvable `to`: fall back to a step to the
+                    # next stage — the weakest edge the site can mean.
+                    idx = stages.index(frm)
+                    if idx + 1 < len(stages):
+                        edges.setdefault(chain, set()).add(
+                            (frm, stages[idx + 1])
+                        )
         for chain in sorted(set(edges) | set(wild_tos)):
             stages, cctx, key = chains[chain]
             if len(stages) < 2:
@@ -1754,6 +1789,67 @@ class CascadeExhaustivenessRule(Rule):
                     "add the missing downgrade edge or shrink the "
                     "declared stage order",
                 )
+
+
+def _stage_range(
+    expr: ast.AST,
+    ctx: FileContext,
+    pkg: PackageContext,
+    fn: Optional[ast.AST],
+    depth: int,
+) -> Optional[Set[str]]:
+    """The set of literal strings ``expr`` can evaluate to inside
+    ``fn`` — None as soon as any component stays dynamic (a partial
+    range would under-claim what the site can do)."""
+    if depth > 4:
+        return None
+    s = resolve_str(expr, ctx, pkg)
+    if s is not None:
+        return {s}
+    if isinstance(expr, ast.IfExp):
+        a = _stage_range(expr.body, ctx, pkg, fn, depth + 1)
+        b = _stage_range(expr.orelse, ctx, pkg, fn, depth + 1)
+        if a is not None and b is not None:
+            return a | b
+        return None
+    if isinstance(expr, ast.Name) and fn is not None:
+        rhss = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                        rhss.append(sub.value)
+            elif (
+                isinstance(sub, ast.AnnAssign)
+                and sub.value is not None
+                and isinstance(sub.target, ast.Name)
+                and sub.target.id == expr.id
+            ):
+                rhss.append(sub.value)
+        if not rhss:
+            return None
+        out: Set[str] = set()
+        for rhs in rhss:
+            sub_range = _stage_range(rhs, ctx, pkg, fn, depth + 1)
+            if sub_range is None:
+                return None
+            out |= sub_range
+        return out
+    return None
+
+
+def _dynamic_to_range(
+    node: ast.Call, ctx: FileContext, pkg: PackageContext
+) -> Optional[Set[str]]:
+    """Value range of a ``downgrade(...)`` call's dynamic ``to``."""
+    to_expr = node.args[2] if len(node.args) > 2 else None
+    for kw in node.keywords:
+        if kw.arg == "to":
+            to_expr = kw.value
+    if to_expr is None:
+        return None
+    fn = ctx.enclosing_functions().get(id(node))
+    return _stage_range(to_expr, ctx, pkg, fn, 0)
 
 
 class FenceDisciplineRule(Rule):
@@ -1786,6 +1882,173 @@ class FenceDisciplineRule(Rule):
             yield self.finding(ctx, node, message)
 
 
+# ---------------------------------------------------------------------------
+# v5 concurrency & liveness rules (tools/lint/concurrency.py): the
+# threaded serving / elastic-mesh tier's "never a hang, never a mixed
+# table, never a stale epoch" contracts, checked statically.
+
+
+class BoundedWaitRule(Rule):
+    """G021 — every blocking primitive carries a finite bound.
+
+    The serving dispatcher, the router's flusher/poller threads, and
+    the quorum heartbeat all promise "never a hang": PR 10's chaos
+    harness samples that at runtime, this rule proves the call shapes
+    at lint time.  A ``.wait()`` / ``.join()`` / queue ``.get()``/
+    ``.put()`` with no finite timeout, and a constant-true sleep loop
+    with no break/return/raise, can park a thread forever — shutdown
+    then deadlocks on ``join``.  Escape hatch (censused, not assumed):
+    an unbounded wait whose enclosing function checks a module-level
+    shutdown sentinel (``_STOP = object()``) that the same file
+    delivers from a ``finally`` suite — the serve ring's hand-off
+    shape, where delivery is guaranteed even on the crash path.
+    tools/ and tests are out of scope (the chaos/CI harnesses park
+    threads on purpose).
+    """
+
+    id = "G021"
+    name = "bounded-wait"
+    aliases = ("wait-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import concurrency as conc
+
+        if (
+            ctx.tree is None
+            or is_test_path(ctx.path)
+            or ctx.path.startswith("tools/")
+        ):
+            return
+        src = ctx.source
+        if not any(
+            s in src
+            for s in (".wait(", ".join(", ".get(", ".put(", "while ")
+        ):
+            return
+        for node, message in conc.liveness_findings(ctx):
+            yield self.finding(ctx, node, message)
+
+
+class SharedStateRule(Rule):
+    """G022 — cross-thread mutable state is lock-guarded.
+
+    A lightweight race detector over the class/field graph: for every
+    class that constructs its own ``threading.Thread``, the rule
+    closes each spawn target over its ``self.X()`` call edges into a
+    thread group, then flags any store to a ``self`` attribute that is
+    (a) reachable from >= 2 groups and (b) not under a ``with
+    self.<lock>:`` region.  ``__init__`` and the spawning methods are
+    exempt (their stores happen-before ``Thread.start``), method
+    CALLS are not stores (``self._ring.append`` and the allocation-
+    free metrics primitives stay legal), and a helper whose every
+    intra-class call site sits inside a guarded region inherits the
+    caller's lock (the ``_shed_locked`` shape).  Reads are deliberately
+    not flagged — the serving tier reads hot fields lock-free.
+    """
+
+    id = "G022"
+    name = "shared-state-guard"
+    aliases = ("race-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import concurrency as conc
+
+        if (
+            ctx.tree is None
+            or is_test_path(ctx.path)
+            or ctx.path.startswith("tools/")
+        ):
+            return
+        if "Thread" not in ctx.source:
+            return
+        for node, attr, cls, n in conc.race_findings(ctx):
+            yield self.finding(
+                ctx,
+                node,
+                f"`self.{attr}` is stored here without the class lock "
+                f"but is reachable from {n} thread contexts of "
+                f"`{cls}` — guard the store with the lock, hand the "
+                "value off through a censused ring/queue, or keep the "
+                "field single-writer",
+            )
+
+
+class SwapBarrierRule(Rule):
+    """G023 — a served model table is installed only through a barrier.
+
+    The dispatcher's swap contract (PR 19): a new ``ServingState``
+    travels the SAME ring as the work items, so the pack/scan/dispatch
+    stages observe it in hand-off order and no batch is ever scored
+    against a mixed table.  A direct ``self.*state = <value>``
+    assignment in a thread-spawning class bypasses that ordering — the
+    rule accepts only marker installs (``self._x = marker.state``, the
+    ring hand-off shape) and swap-named barrier methods
+    (``_commit_swap``, ``swap_all`` staging); everything else flags.
+    """
+
+    id = "G023"
+    name = "swap-barrier"
+    aliases = ("swap-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import concurrency as conc
+
+        if (
+            ctx.tree is None
+            or is_test_path(ctx.path)
+            or ctx.path.startswith("tools/")
+        ):
+            return
+        if "Thread" not in ctx.source:
+            return
+        for node, attr, cls in conc.swap_findings(ctx):
+            yield self.finding(
+                ctx,
+                node,
+                f"served table `self.{attr}` of `{cls}` installed by "
+                "direct assignment — route the install through a "
+                "barrier path (ring marker / `_commit_swap` / "
+                "`swap_all` staging); a direct install mid-batch "
+                "serves a mixed table",
+            )
+
+
+class EpochNamespaceRule(Rule):
+    """G024 — marker/payload paths route through the epoch/seq
+    namespace.
+
+    The elastic-mesh pairing proof is by construction: quorum markers
+    live under ``e<epoch>.<site>`` (``_esite``) so a straggler from an
+    aborted epoch can never be paired with the survivors' round, and
+    router protocol payloads (``req-``/``rsp-``/``swap-``/
+    ``swapped-``/``reset-``) carry the request seq so responses pair
+    with their requests.  This rule checks both halves statically:
+    every ``post_marker``/``peer_marker``/``_exchange_file`` call site
+    must pass an epoch-tainted path (``_esite(...)`` or an f-string
+    referencing the mesh epoch, tracked through local assignments
+    across the closure chain), and every protocol payload f-string
+    must interpolate a seq.  The transport helper bodies themselves
+    are the sanctioned implementation and are exempt.
+    """
+
+    id = "G024"
+    name = "epoch-namespace"
+    aliases = ("epoch-ok",)
+
+    def check(self, ctx, pkg):
+        from tools.lint import concurrency as conc
+
+        if (
+            ctx.tree is None
+            or is_test_path(ctx.path)
+            or ctx.path.startswith("tools/")
+            or not conc.is_proto_file(ctx.path)
+        ):
+            return
+        for node, message in conc.epoch_findings(ctx):
+            yield self.finding(ctx, node, message)
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncRule(),
     CollectiveAxisRule(),
@@ -1807,6 +2070,10 @@ ALL_RULES: Sequence[Rule] = (
     UnclassifiedRaiseRule(),
     CascadeExhaustivenessRule(),
     FenceDisciplineRule(),
+    BoundedWaitRule(),
+    SharedStateRule(),
+    SwapBarrierRule(),
+    EpochNamespaceRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
